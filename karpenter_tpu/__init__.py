@@ -1,0 +1,21 @@
+"""karpenter-tpu: a TPU-native node-autoscaling framework.
+
+A from-scratch re-design of Karpenter core (sigs.k8s.io/karpenter) where the
+two solvers — the provisioning bin-packer and the consolidation search — are
+batched JAX/XLA array programs, while the control plane (watches, lifecycle
+state machines, disruption orchestration) stays host-side.
+
+Layer map (mirrors reference SURVEY.md §1):
+  apis/           CRD-equivalent data model (NodePool, NodeClaim, core shims)
+  scheduling/     requirements set algebra, taints, host ports, volume usage
+  ops/            JAX device kernels: encoding, feasibility, packing, topology
+  parallel/       device mesh / shard_map sharding of the pod axis
+  kube/           in-memory API store with watches (the durable substrate)
+  cloudprovider/  plugin boundary + fake + kwok-equivalent providers
+  controllers/    provisioning, disruption, state, nodeclaim, node, nodepool
+  operator/       options/feature gates + controller manager runtime
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "karpenter.sh"

@@ -1,0 +1,1 @@
+"""API surface: the data model equivalent of the reference's pkg/apis CRDs."""

@@ -1,0 +1,13 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh so all sharding code
+paths (shard_map/pjit over the pod axis) are exercised without TPU hardware.
+Must run before jax is imported anywhere."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,242 @@
+"""Requirements algebra semantics, mirroring the reference's
+pkg/scheduling/suite_test.go behaviors."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorTerm,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+)
+from karpenter_tpu.scheduling.requirements import (
+    Operator,
+    Requirement,
+    Requirements,
+    pod_requirements,
+    requirements_from_dicts,
+    strict_pod_requirements,
+)
+
+
+def r_in(key, *values):
+    return Requirement(key, Operator.IN, values)
+
+
+def r_notin(key, *values):
+    return Requirement(key, Operator.NOT_IN, values)
+
+
+class TestRequirement:
+    def test_in_has(self):
+        r = r_in("key", "a", "b")
+        assert r.has("a") and r.has("b") and not r.has("c")
+
+    def test_notin_has(self):
+        r = r_notin("key", "a")
+        assert not r.has("a") and r.has("b") and r.has("zzz")
+
+    def test_exists(self):
+        r = Requirement("key", Operator.EXISTS)
+        assert r.has("anything")
+        assert r.operator == Operator.EXISTS
+
+    def test_does_not_exist(self):
+        r = Requirement("key", Operator.DOES_NOT_EXIST)
+        assert not r.has("anything")
+        assert len(r) == 0
+
+    def test_gt_lt(self):
+        gt = Requirement("key", Operator.GT, ["5"])
+        lt = Requirement("key", Operator.LT, ["10"])
+        assert gt.has("6") and not gt.has("5") and not gt.has("abc")
+        assert lt.has("9") and not lt.has("10")
+        both = gt.intersection(lt)
+        assert both.has("7") and not both.has("4") and not both.has("11")
+
+    def test_gt_lt_empty(self):
+        gt = Requirement("key", Operator.GT, ["10"])
+        lt = Requirement("key", Operator.LT, ["5"])
+        assert gt.intersection(lt).operator == Operator.DOES_NOT_EXIST
+        assert not gt.has_intersection(lt)
+
+    def test_intersection_in_in(self):
+        got = r_in("k", "a", "b").intersection(r_in("k", "b", "c"))
+        assert got.values == {"b"} and not got.complement
+
+    def test_intersection_in_notin(self):
+        got = r_in("k", "a", "b").intersection(r_notin("k", "b"))
+        assert got.values == {"a"} and not got.complement
+
+    def test_intersection_notin_notin(self):
+        got = r_notin("k", "a").intersection(r_notin("k", "b"))
+        assert got.complement and got.values == {"a", "b"}
+        assert got.has("c") and not got.has("a")
+
+    def test_has_intersection_matches_intersection(self):
+        cases = [
+            r_in("k", "a", "b"),
+            r_in("k", "c"),
+            r_notin("k", "a"),
+            r_notin("k", "c", "d"),
+            Requirement("k", Operator.EXISTS),
+            Requirement("k", Operator.DOES_NOT_EXIST),
+            Requirement("k", Operator.GT, ["3"]),
+            Requirement("k", Operator.LT, ["7"]),
+            r_in("k", "5", "9"),
+        ]
+        for a in cases:
+            for b in cases:
+                fast = a.has_intersection(b)
+                slow = len(a.intersection(b)) != 0
+                assert fast == slow, f"{a!r} vs {b!r}: fast={fast} slow={slow}"
+
+    def test_normalized_keys(self):
+        r = Requirement("beta.kubernetes.io/arch", Operator.IN, ["amd64"])
+        assert r.key == wk.LABEL_ARCH
+
+    def test_min_values_propagates(self):
+        a = Requirement("k", Operator.IN, ["a", "b"], min_values=2)
+        b = r_in("k", "a", "b", "c")
+        assert a.intersection(b).min_values == 2
+        assert b.intersection(a).min_values == 2
+
+
+class TestRequirements:
+    def test_add_intersects(self):
+        reqs = Requirements(r_in("k", "a", "b"))
+        reqs.add(r_in("k", "b", "c"))
+        assert reqs.get("k").values == {"b"}
+
+    def test_get_missing_is_exists(self):
+        reqs = Requirements()
+        assert reqs.get("zone").operator == Operator.EXISTS
+
+    def test_compatible_well_known_undefined_allowed(self):
+        node = Requirements(r_in(wk.LABEL_OS, "linux"))
+        pod = Requirements(r_in(wk.LABEL_TOPOLOGY_ZONE, "zone-1"))
+        # undefined custom label denied
+        assert node.compatible(pod) is not None
+        # well-known undefined allowed
+        assert node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+
+    def test_compatible_custom_label_defined(self):
+        node = Requirements(r_in("team", "a"))
+        assert node.compatible(Requirements(r_in("team", "a"))) is None
+        assert node.compatible(Requirements(r_in("team", "b"))) is not None
+
+    def test_compatible_notin_undefined_ok(self):
+        node = Requirements()
+        assert node.compatible(Requirements(r_notin("team", "b"))) is None
+        assert (
+            node.compatible(Requirements(Requirement("team", Operator.DOES_NOT_EXIST)))
+            is None
+        )
+
+    def test_intersects_double_complement_exemption(self):
+        # NotIn vs DoesNotExist on the same key does not error even though
+        # set-intersection may be empty (requirements.go:253-259)
+        a = Requirements(Requirement("k", Operator.DOES_NOT_EXIST))
+        b = Requirements(r_notin("k", "v"))
+        assert a.intersects(b) is None
+
+    def test_intersects_error(self):
+        a = Requirements(r_in("k", "a"))
+        b = Requirements(r_in("k", "b"))
+        assert a.intersects(b) is not None
+
+    def test_labels_skips_restricted(self):
+        reqs = Requirements(
+            r_in(wk.LABEL_HOSTNAME, "h1"),
+            r_in("team", "a"),
+            r_in(wk.LABEL_TOPOLOGY_ZONE, "z1"),  # well-known => restricted node label
+        )
+        labels = reqs.labels()
+        assert labels == {"team": "a"}
+
+    def test_from_dicts_roundtrip(self):
+        raw = [
+            {"key": "a", "operator": "In", "values": ["1", "2"]},
+            {"key": "b", "operator": "Exists"},
+            {"key": "c", "operator": "Gt", "values": ["4"], "minValues": None},
+        ]
+        reqs = requirements_from_dicts(raw)
+        assert reqs.get("a").values == {"1", "2"}
+        assert reqs.get("b").operator == Operator.EXISTS
+        assert reqs.get("c").has("5") and not reqs.get("c").has("4")
+
+
+class TestPodRequirements:
+    def make_pod(self):
+        return Pod(
+            spec=PodSpec(
+                node_selector={"team": "a"},
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    {
+                                        "key": wk.LABEL_TOPOLOGY_ZONE,
+                                        "operator": "In",
+                                        "values": ["z1", "z2"],
+                                    }
+                                ]
+                            ),
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    {
+                                        "key": wk.LABEL_TOPOLOGY_ZONE,
+                                        "operator": "In",
+                                        "values": ["z3"],
+                                    }
+                                ]
+                            ),
+                        ],
+                        preferred=[
+                            PreferredSchedulingTerm(
+                                weight=1,
+                                preference=NodeSelectorTerm(
+                                    match_expressions=[
+                                        {
+                                            "key": "light",
+                                            "operator": "In",
+                                            "values": ["x"],
+                                        }
+                                    ]
+                                ),
+                            ),
+                            PreferredSchedulingTerm(
+                                weight=10,
+                                preference=NodeSelectorTerm(
+                                    match_expressions=[
+                                        {
+                                            "key": "heavy",
+                                            "operator": "In",
+                                            "values": ["y"],
+                                        }
+                                    ]
+                                ),
+                            ),
+                        ],
+                    )
+                ),
+            )
+        )
+
+    def test_node_selector_and_first_term(self):
+        reqs = pod_requirements(self.make_pod())
+        assert reqs.get("team").values == {"a"}
+        # only first required OR term
+        assert reqs.get(wk.LABEL_TOPOLOGY_ZONE).values == {"z1", "z2"}
+        # heaviest preference included
+        assert reqs.get("heavy").values == {"y"}
+        assert not reqs.has("light")
+
+    def test_strict_excludes_preferences(self):
+        reqs = strict_pod_requirements(self.make_pod())
+        assert not reqs.has("heavy")
+        assert reqs.get(wk.LABEL_TOPOLOGY_ZONE).values == {"z1", "z2"}

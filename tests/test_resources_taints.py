@@ -1,0 +1,125 @@
+from karpenter_tpu.apis.core import (
+    Container,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.apis.core import pod_resource_requests
+from karpenter_tpu.scheduling.taints import Taints
+from karpenter_tpu.utils import resources as r
+
+
+class TestQuantity:
+    def test_parse(self):
+        assert r.parse_quantity("100m") == 0.1
+        assert r.parse_quantity("1") == 1
+        assert r.parse_quantity("1Gi") == 2**30
+        assert r.parse_quantity("1500Mi") == 1500 * 2**20
+        assert r.parse_quantity("2k") == 2000
+        assert r.parse_quantity(2.5) == 2.5
+
+    def test_arithmetic(self):
+        a = {"cpu": 1.0, "memory": 100.0}
+        b = {"cpu": 0.5, "gpu": 1.0}
+        assert r.merge(a, b) == {"cpu": 1.5, "memory": 100.0, "gpu": 1.0}
+        assert r.subtract(a, b) == {"cpu": 0.5, "memory": 100.0, "gpu": -1.0}
+
+    def test_fits(self):
+        assert r.fits({"cpu": 1.0}, {"cpu": 1.0, "memory": 5.0})
+        assert not r.fits({"cpu": 2.0}, {"cpu": 1.0})
+        # extended resource missing from the node => does not fit
+        assert not r.fits({"gpu": 1.0}, {"cpu": 10.0})
+
+
+class TestPodRequests:
+    def test_max_of_init_and_main(self):
+        pod = Pod(
+            spec=PodSpec(
+                containers=[
+                    Container(requests={"cpu": 1.0}),
+                    Container(requests={"cpu": 0.5, "memory": 64.0}),
+                ],
+                init_containers=[Container(requests={"cpu": 2.0})],
+            )
+        )
+        got = pod_resource_requests(pod)
+        assert got["cpu"] == 2.0  # init container dominates
+        assert got["memory"] == 64.0
+        assert got["pods"] == 1.0
+
+    def test_sidecar_counts_as_main(self):
+        pod = Pod(
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 1.0})],
+                init_containers=[
+                    Container(requests={"cpu": 1.0}, restart_policy="Always")
+                ],
+            )
+        )
+        assert pod_resource_requests(pod)["cpu"] == 2.0
+
+
+class TestTaints:
+    def test_tolerates(self):
+        taints = Taints([Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        pod = Pod(spec=PodSpec())
+        assert taints.tolerates_pod(pod) is not None
+
+        pod.spec.tolerations = [Toleration(key="dedicated", operator="Exists")]
+        assert taints.tolerates_pod(pod) is None
+
+        pod.spec.tolerations = [
+            Toleration(key="dedicated", operator="Equal", value="cpu")
+        ]
+        assert taints.tolerates_pod(pod) is not None
+
+        pod.spec.tolerations = [
+            Toleration(key="dedicated", operator="Equal", value="gpu")
+        ]
+        assert taints.tolerates_pod(pod) is None
+
+    def test_empty_key_exists_tolerates_all(self):
+        taints = Taints([Taint(key="a", effect="NoSchedule"), Taint(key="b", effect="NoExecute")])
+        pod = Pod(spec=PodSpec(tolerations=[Toleration(operator="Exists")]))
+        assert taints.tolerates_pod(pod) is None
+
+    def test_effect_scoping(self):
+        taints = Taints([Taint(key="a", effect="NoExecute")])
+        pod = Pod(
+            spec=PodSpec(
+                tolerations=[Toleration(key="a", operator="Exists", effect="NoSchedule")]
+            )
+        )
+        assert taints.tolerates_pod(pod) is not None
+
+    def test_merge(self):
+        a = Taints([Taint(key="x", effect="NoSchedule", value="1")])
+        merged = a.merge([Taint(key="x", effect="NoSchedule", value="2"), Taint(key="y")])
+        assert len(merged) == 2
+        assert merged[0].value == "1"
+
+
+class TestPodRequestsEdgeCases:
+    def test_sidecar_counts_into_init_ceiling(self):
+        # sidecar (cpu=1) runs alongside later init (cpu=2): ceiling = 3
+        pod = Pod(
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 0.5})],
+                init_containers=[
+                    Container(requests={"cpu": 1.0}, restart_policy="Always"),
+                    Container(requests={"cpu": 2.0}),
+                ],
+            )
+        )
+        assert pod_resource_requests(pod)["cpu"] == 3.0
+
+    def test_limits_default_requests(self):
+        pod = Pod(spec=PodSpec(containers=[Container(limits={"cpu": 2.0})]))
+        assert pod_resource_requests(pod)["cpu"] == 2.0
+
+    def test_explicit_request_wins_over_limit(self):
+        pod = Pod(
+            spec=PodSpec(containers=[Container(requests={"cpu": 1.0}, limits={"cpu": 4.0})])
+        )
+        assert pod_resource_requests(pod)["cpu"] == 1.0
