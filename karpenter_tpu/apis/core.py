@@ -12,6 +12,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from karpenter_tpu.utils import resources as r
 from karpenter_tpu.utils.resources import ResourceList
 
 
@@ -79,14 +80,17 @@ class Toleration:
     toleration_seconds: Optional[int] = None
 
     def tolerates(self, taint: Taint) -> bool:
-        """Mirrors corev1.Toleration.ToleratesTaint."""
+        """Mirrors corev1.Toleration.ToleratesTaint: unknown operators never
+        tolerate, and Exists requires an empty value."""
         if self.effect and self.effect != taint.effect:
             return False
         if self.key and self.key != taint.key:
             return False
+        if self.operator in ("", "Equal"):
+            return self.value == taint.value
         if self.operator == "Exists":
-            return True
-        return self.value == taint.value
+            return self.value == ""
+        return False
 
 
 # -- pod --------------------------------------------------------------------
@@ -409,8 +413,6 @@ def pod_resource_requests(pod: Pod) -> ResourceList:
     alongside later init containers and the app. Mirrors the accounting in
     the reference's pkg/utils/resources (Ceiling/podRequests).
     """
-    from karpenter_tpu.utils import resources as r
-
     sidecar_sum: ResourceList = {}
     init_ceiling: ResourceList = {}
     for c in pod.spec.init_containers:

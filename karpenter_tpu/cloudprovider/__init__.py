@@ -1,0 +1,14 @@
+"""Cloud-provider plugin boundary (reference pkg/cloudprovider)."""
+
+from karpenter_tpu.cloudprovider.types import (  # noqa: F401
+    CloudProvider,
+    CreateError,
+    InstanceType,
+    InstanceTypeOverhead,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    NodeClassNotReadyError,
+    Offering,
+    Offerings,
+    RepairPolicy,
+)

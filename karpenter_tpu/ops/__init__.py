@@ -1,0 +1,12 @@
+"""Device kernels: the TPU-native execution backend for the two solvers.
+
+Design (see SURVEY.md §7): label keys and values are interned into a global
+bit-space; every distinct `Requirement` becomes one row of arrays; the hot
+`filterInstanceTypesByRequirements` sweep (reference
+pkg/controllers/provisioning/scheduling/nodeclaim.go:373-441) becomes
+
+    compat[P, I] = all-over-pod-requirements ReqCompat[R, I]
+
+computed as a membership matmul — MXU-shaped — instead of the reference's
+O(pods × instance-types × keys) Go loops.
+"""

@@ -1,0 +1,304 @@
+"""CatalogEngine: the device-resident instance-type catalog and the lazily
+grown requirement-compatibility matrices.
+
+This is the batched execution backend for the reference's
+`filterInstanceTypesByRequirements` (scheduling/nodeclaim.go:373-441): a
+NodeClaim's instance-type filter becomes
+
+    feasible[p, i] = compat[p, i] & fits[p, i] & has_offering[p, i]
+
+where `compat` is an AND over the pod/nodeclaim's distinct Requirement rows
+(computed once per row via `req_rows_vs_sets` and cached), `fits` is a
+resource-vector comparison against allocatable, and `has_offering` reduces
+offering-level compatibility over each instance type's offerings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.ops import encoding as enc
+from karpenter_tpu.ops import feasibility as feas
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+
+DEFAULT_RESOURCE_DIMS = (
+    wk.RESOURCE_CPU,
+    wk.RESOURCE_MEMORY,
+    wk.RESOURCE_EPHEMERAL_STORAGE,
+    wk.RESOURCE_PODS,
+)
+
+
+def _req_cache_key(r: Requirement) -> tuple:
+    return (r.key, r.complement, r.greater_than, r.less_than, frozenset(r.values))
+
+
+@dataclass
+class Feasibility:
+    """Per-(entity, instance-type) feasibility triple plus diagnostics."""
+
+    compat: np.ndarray  # [P, I] bool — requirements intersect
+    fits: np.ndarray  # [P, I] bool — resources fit allocatable
+    has_offering: np.ndarray  # [P, I] bool — an available offering is compatible
+
+    @property
+    def feasible(self) -> np.ndarray:
+        return self.compat & self.fits & self.has_offering
+
+
+class CatalogEngine:
+    """Encodes an instance-type catalog onto the device and evaluates batched
+    feasibility queries against it.
+
+    Requirement rows are deduplicated: each distinct Requirement is one row
+    of the cached `ReqCompat[R, I]` / `OfferCompat[R, O]` matrices, computed
+    on first use. Queries supply sets of row ids (per pod / nodeclaim), and
+    compatibility is an AND-reduce over rows via a membership matmul.
+    """
+
+    def __init__(
+        self,
+        instance_types: Sequence[InstanceType],
+        extra_resources: Sequence[str] = (),
+        vocab: Optional[enc.Vocab] = None,
+    ):
+        self.instance_types = list(instance_types)
+        self.vocab = vocab or enc.Vocab()
+
+        names = list(DEFAULT_RESOURCE_DIMS)
+        for it in self.instance_types:
+            for k in it.capacity:
+                if k not in names:
+                    names.append(k)
+        for k in extra_resources:
+            if k not in names:
+                names.append(k)
+        self.resource_dims = {n: i for i, n in enumerate(names)}
+
+        # Flatten offerings with owner pointers
+        self._offerings = []
+        owners = []
+        for i, it in enumerate(self.instance_types):
+            for o in it.offerings:
+                self._offerings.append(o)
+                owners.append(i)
+        self.num_instances = len(self.instance_types)
+        self.num_offerings = len(self._offerings)
+
+        # Pre-intern all catalog vocab before sizing arrays
+        for it in self.instance_types:
+            self.vocab.observe(it.requirements)
+        for o in self._offerings:
+            self.vocab.observe(o.requirements)
+
+        self._encode_catalog(owners)
+
+        # Requirement-row cache
+        self._row_ids: dict[tuple, int] = {}
+        self._rows: list[Requirement] = []
+        self._computed_rows = 0
+        self._req_compat = np.zeros((0, self.num_instances), dtype=bool)
+        self._offer_compat = np.zeros((0, self.num_offerings), dtype=bool)
+
+    # -- catalog encoding ---------------------------------------------------
+
+    def _encode_catalog(self, owners: list[int]) -> None:
+        v = self.vocab
+        self._key_capacity = v.key_capacity
+        self._word_capacity = v.word_capacity
+        self._inst_sets = enc.encode_requirement_sets(
+            v,
+            [it.requirements for it in self.instance_types],
+            key_capacity=self._key_capacity,
+            word_capacity=self._word_capacity,
+        )
+        self._offer_sets = enc.encode_requirement_sets(
+            v,
+            [o.requirements for o in self._offerings],
+            key_capacity=self._key_capacity,
+            word_capacity=self._word_capacity,
+        )
+        self._tables = v.tables()
+
+        self.allocatable = enc.encode_resource_lists(
+            self.resource_dims, [it.allocatable() for it in self.instance_types]
+        )
+        self.offering_available = np.array(
+            [o.available for o in self._offerings], dtype=bool
+        )
+        self.offering_price = np.array(
+            [o.price for o in self._offerings], dtype=np.float32
+        )
+        self.offering_owner = np.array(owners, dtype=np.int32)
+
+        # Offering custom-key needs for the Compatible() undefined-label rule
+        # (requirements.go:175-191): a non-well-known offering key with an
+        # In/Exists-class operator requires the querying set to define it.
+        K = self._key_capacity
+        self.offering_custom_need = np.zeros((self.num_offerings, K), dtype=bool)
+        for j, o in enumerate(self._offerings):
+            for r in o.requirements:
+                if r.key in wk.WELL_KNOWN_LABELS:
+                    continue
+                if r.operator in (Operator.NOT_IN, Operator.DOES_NOT_EXIST):
+                    continue
+                self.offering_custom_need[j, v.key_id(r.key)] = True
+
+        # owner one-hot for offering→instance any-reduce: [O, I]
+        self._owner_onehot = np.zeros((self.num_offerings, self.num_instances), dtype=bool)
+        self._owner_onehot[np.arange(self.num_offerings), self.offering_owner] = True
+
+    # -- requirement rows ---------------------------------------------------
+
+    def row_id(self, req: Requirement) -> int:
+        key = _req_cache_key(req)
+        rid = self._row_ids.get(key)
+        if rid is None:
+            rid = len(self._rows)
+            self._row_ids[key] = rid
+            self._rows.append(req)
+        return rid
+
+    def rows_for(self, reqs: Requirements) -> list[int]:
+        return [self.row_id(r) for r in reqs]
+
+    @property
+    def num_rows(self) -> int:
+        return len(self._rows)
+
+    def _maybe_reencode(self) -> None:
+        """Re-encode the catalog if the vocabulary outgrew the padded
+        capacities (rare — capacities grow pow2). Previously computed compat
+        matrices remain valid: compatibility depends only on requirement
+        semantics, not slot numbering."""
+        if (
+            self.vocab.key_capacity > self._key_capacity
+            or self.vocab.word_capacity > self._word_capacity
+        ):
+            self._encode_catalog(list(self.offering_owner))
+
+    def _ensure_rows(self) -> None:
+        """Compute compat matrices for any rows added since the last call."""
+        if self._computed_rows == len(self._rows):
+            return
+        new_rows = self._rows[self._computed_rows :]
+        # Interning new rows may grow the vocabulary past the encoded
+        # capacities; encode_requirement_rows interns first, then we re-size.
+        er = enc.encode_requirement_rows(self.vocab, new_rows, None)
+        self._maybe_reencode()
+        if er.mask.shape[1] < self._word_capacity:
+            pad = self._word_capacity - er.mask.shape[1]
+            er.mask = np.pad(er.mask, ((0, 0), (0, pad)))
+
+        row_args = (
+            jnp.asarray(er.key),
+            jnp.asarray(er.complement),
+            jnp.asarray(er.has_values),
+            jnp.asarray(er.gt),
+            jnp.asarray(er.lt),
+            jnp.asarray(er.mask),
+        )
+        tables = (jnp.asarray(self._tables.slot_key), jnp.asarray(self._tables.value_int))
+        inst = self._inst_sets
+        new_inst = np.asarray(
+            feas.req_rows_vs_sets(
+                *row_args,
+                jnp.asarray(inst.present),
+                jnp.asarray(inst.complement),
+                jnp.asarray(inst.has_values),
+                jnp.asarray(inst.gt),
+                jnp.asarray(inst.lt),
+                jnp.asarray(inst.mask),
+                *tables,
+            )
+        )
+        off = self._offer_sets
+        if self.num_offerings:
+            new_off = np.asarray(
+                feas.req_rows_vs_sets(
+                    *row_args,
+                    jnp.asarray(off.present),
+                    jnp.asarray(off.complement),
+                    jnp.asarray(off.has_values),
+                    jnp.asarray(off.gt),
+                    jnp.asarray(off.lt),
+                    jnp.asarray(off.mask),
+                    *tables,
+                )
+            )
+        else:
+            new_off = np.zeros((len(new_rows), 0), dtype=bool)
+        self._req_compat = np.concatenate([self._req_compat, new_inst], axis=0)
+        self._offer_compat = np.concatenate([self._offer_compat, new_off], axis=0)
+        self._computed_rows = len(self._rows)
+
+    # -- queries ------------------------------------------------------------
+
+    def key_presence(self, reqs_list: Sequence[Requirements]) -> np.ndarray:
+        """[P, K] key-defined matrix for the undefined-label offering rule."""
+        for reqs in reqs_list:
+            for r in reqs:
+                self.vocab.key_id(r.key)
+        self._maybe_reencode()
+        out = np.zeros((len(reqs_list), self._key_capacity), dtype=bool)
+        for i, reqs in enumerate(reqs_list):
+            for r in reqs:
+                out[i, self.vocab.key_ids[r.key]] = True
+        return out
+
+    def feasibility(
+        self,
+        row_sets: Sequence[Sequence[int]],
+        requests: np.ndarray,  # [P, D] float32 in self.resource_dims order
+        key_present: Optional[np.ndarray] = None,  # [P, K]
+    ) -> Feasibility:
+        """Batched feasibility of P requirement-sets against the catalog."""
+        self._ensure_rows()
+        P = len(row_sets)
+        R = max(1, self._computed_rows)
+        membership = np.zeros((P, R), dtype=bool)
+        for p, rows in enumerate(row_sets):
+            for rid in rows:
+                membership[p, rid] = True
+
+        req_compat = (
+            self._req_compat
+            if self._computed_rows
+            else np.zeros((1, self.num_instances), dtype=bool)
+        )
+        compat = np.asarray(
+            feas.membership_all(jnp.asarray(membership), jnp.asarray(req_compat))
+        )
+        fits = np.asarray(
+            feas.fits_matrix(jnp.asarray(requests), jnp.asarray(self.allocatable))
+        )
+
+        if self.num_offerings == 0:
+            has_offering = np.zeros((P, self.num_instances), dtype=bool)
+            return Feasibility(compat, fits, has_offering)
+
+        offer_compat = (
+            self._offer_compat
+            if self._computed_rows
+            else np.zeros((1, self.num_offerings), dtype=bool)
+        )
+        offer_rows_ok = np.asarray(
+            feas.membership_all(jnp.asarray(membership), jnp.asarray(offer_compat))
+        )  # [P, O]
+        if key_present is None:
+            undef_ok = ~self.offering_custom_need.any(axis=1)[None, :]  # [1, O]
+        else:
+            # offering needs key k but set doesn't define it -> incompatible
+            bad = self.offering_custom_need.astype(np.float32) @ (~key_present).astype(np.float32).T
+            undef_ok = (bad < 0.5).T  # [P, O]
+        offer_ok = offer_rows_ok & undef_ok & self.offering_available[None, :]
+        has_offering = (
+            offer_ok.astype(np.float32) @ self._owner_onehot.astype(np.float32)
+        ) > 0.5
+        return Feasibility(compat, fits, has_offering)

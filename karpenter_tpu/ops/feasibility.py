@@ -1,0 +1,137 @@
+"""The feasibility kernel: batched requirement-intersection on device.
+
+This is the TPU replacement for the reference's hottest loop,
+`filterInstanceTypesByRequirements` (pkg/controllers/provisioning/scheduling/
+nodeclaim.go:373-441), factorized as:
+
+    ReqCompat[R, I]  — every distinct Requirement row vs every instance type
+    compat[P, I]     — AND over each pod's rows, via membership matmul
+    fits[P, I]       — resource vector comparison
+    offering[P, I]   — any available offering compatible per instance
+
+Set-intersection semantics mirror pkg/scheduling/requirement.go:194-228
+(HasIntersection) and requirements.go:248-268 (Intersects: only shared keys
+constrain; NotIn/DoesNotExist pairs are exempt).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.ops.encoding import NO_GT, NO_LT, NOT_INT, WORD
+
+
+def unpack_mask(words: jnp.ndarray) -> jnp.ndarray:
+    """[..., W] uint32 → [..., W*32] bool."""
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], words.shape[-1] * WORD).astype(bool)
+
+
+def _bounds_ok(gt: jnp.ndarray, lt: jnp.ndarray, value_int: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot integer-bounds admissibility.
+
+    gt/lt: [...] broadcastable against value_int [G]. When neither bound is
+    set every slot passes; otherwise non-integer slots fail
+    (requirement.go:308-324).
+    """
+    unbounded = (gt == NO_GT) & (lt == NO_LT)
+    is_int = value_int != NOT_INT
+    in_range = is_int & (value_int > gt) & (value_int < lt)
+    return unbounded | in_range
+
+
+@functools.partial(jax.jit, static_argnames=())
+def req_rows_vs_sets(
+    # requirement rows [R]
+    row_key: jnp.ndarray,  # [R] int32
+    row_complement: jnp.ndarray,  # [R] bool
+    row_has_values: jnp.ndarray,  # [R] bool
+    row_gt: jnp.ndarray,  # [R] int32
+    row_lt: jnp.ndarray,  # [R] int32
+    row_mask: jnp.ndarray,  # [R, W] uint32
+    # requirement sets [N]
+    set_present: jnp.ndarray,  # [N, K] bool
+    set_complement: jnp.ndarray,  # [N, K] bool
+    set_has_values: jnp.ndarray,  # [N, K] bool
+    set_gt: jnp.ndarray,  # [N, K] int32
+    set_lt: jnp.ndarray,  # [N, K] int32
+    set_mask: jnp.ndarray,  # [N, W] uint32
+    # vocab tables
+    slot_key: jnp.ndarray,  # [G] int32
+    value_int: jnp.ndarray,  # [G] int32
+) -> jnp.ndarray:
+    """compat[R, N]: does requirement row r intersect set n on r's key?
+
+    Mirrors Intersects() semantics: a key the set doesn't constrain is
+    compatible; NotIn/DoesNotExist on both sides is exempt from the
+    intersection test.
+    """
+    R = row_key.shape[0]
+    N = set_present.shape[0]
+
+    # Gather the set's per-key metadata at each row's key: [R, N]
+    present = set_present[:, row_key].T  # [N, K][:, R] -> [N, R] -> T
+    s_comp = set_complement[:, row_key].T
+    s_hasv = set_has_values[:, row_key].T
+    s_gt = set_gt[:, row_key].T
+    s_lt = set_lt[:, row_key].T
+
+    g = jnp.maximum(row_gt[:, None], s_gt)  # [R, N]
+    l = jnp.minimum(row_lt[:, None], s_lt)
+    bounds_empty = (g != NO_GT) & (l != NO_LT) & (g >= l)
+
+    both_complement = row_complement[:, None] & s_comp  # [R, N]
+
+    # Candidate slots: restrict to the row's key, honor complements & bounds.
+    row_bits = unpack_mask(row_mask)  # [R, G]
+    set_bits = unpack_mask(set_mask)  # [N, G]
+    key_slots = slot_key[None, :] == row_key[:, None]  # [R, G]
+    a_bits = jnp.where(row_complement[:, None], ~row_bits, row_bits) & key_slots
+    # set side: complement per (row,key); expand to [R, N, G]
+    b_raw = set_bits[None, :, :]  # [1, N, G]
+    b_bits = jnp.where(s_comp[:, :, None], ~b_raw, b_raw)  # [R, N, G]
+    bounds = _bounds_ok(g[:, :, None], l[:, :, None], value_int[None, None, :])
+    candidates = a_bits[:, None, :] & b_bits & bounds  # [R, N, G]
+    any_candidate = jnp.any(candidates, axis=-1)  # [R, N]
+
+    has_intersection = jnp.where(
+        bounds_empty, False, jnp.where(both_complement, True, any_candidate)
+    )
+
+    # NotIn/DoesNotExist exemption (requirements.go:253-259)
+    row_exempt = (row_complement & row_has_values) | (~row_complement & ~row_has_values)
+    set_exempt = (s_comp & s_hasv) | (~s_comp & ~s_hasv)
+    exempt = row_exempt[:, None] & set_exempt
+
+    return ~present | has_intersection | exempt
+
+
+@jax.jit
+def membership_all(membership: jnp.ndarray, row_ok: jnp.ndarray) -> jnp.ndarray:
+    """all-rows-compatible via matmul.
+
+    membership: [P, R] bool — entity p constrained by requirement row r
+    row_ok:     [R, N] bool — row r compatible with target n
+    returns     [P, N] bool — every row of p compatible with n
+
+    The float matmul counts incompatible rows per (p, n) — this is the
+    MXU-shaped core of the sweep.
+    """
+    bad = membership.astype(jnp.float32) @ (~row_ok).astype(jnp.float32)
+    return bad < 0.5
+
+
+@jax.jit
+def fits_matrix(requests: jnp.ndarray, allocatable: jnp.ndarray) -> jnp.ndarray:
+    """fits[P, I]: requests[p] <= allocatable[i] element-wise.
+
+    requests:    [P, D] float32 (missing resources must be 0)
+    allocatable: [I, D] float32 (resources the node lacks must be 0)
+    Mirrors resources.Fits: a positive request against a zero capacity fails.
+    """
+    return jnp.all(requests[:, None, :] <= allocatable[None, :, :] + 1e-9, axis=-1)

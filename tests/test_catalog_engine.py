@@ -1,0 +1,140 @@
+"""CatalogEngine vs host-algebra oracle on the kwok catalog.
+
+The oracle re-implements filterInstanceTypesByRequirements semantics
+directly with the host Requirements algebra; the engine must agree.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.ops.encoding import encode_resource_lists
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.utils import resources as res
+
+GIB = float(2**30)
+
+
+def oracle_triple(it, reqs, total_requests):
+    """Host-side (compat, fits, has_offering) for one instance type."""
+    compat = it.requirements.intersects(reqs) is None
+    fits = res.fits(total_requests, it.allocatable())
+    has_offering = any(
+        o.available
+        and reqs.is_compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS)
+        for o in it.offerings
+    )
+    return compat, fits, has_offering
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return construct_instance_types()
+
+
+@pytest.fixture(scope="module")
+def engine(catalog):
+    return CatalogEngine(catalog)
+
+
+def run_case(engine, catalog, reqs, requests):
+    rows = engine.rows_for(reqs)
+    req_vec = encode_resource_lists(engine.resource_dims, [requests])
+    f = engine.feasibility([rows], req_vec, engine.key_presence([reqs]))
+    for i, it in enumerate(catalog):
+        ec, ef, eo = oracle_triple(it, reqs, requests)
+        assert f.compat[0, i] == ec, f"{it.name}: compat engine={f.compat[0,i]} host={ec}"
+        assert f.fits[0, i] == ef, f"{it.name}: fits engine={f.fits[0,i]} host={ef}"
+        assert f.has_offering[0, i] == eo, (
+            f"{it.name}: offering engine={f.has_offering[0,i]} host={eo}"
+        )
+
+
+class TestCatalogEngine:
+    def test_simple_cpu_request(self, engine, catalog):
+        reqs = Requirements(
+            Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+            Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+        )
+        run_case(engine, catalog, reqs, {"cpu": 3.0, "memory": 4 * GIB, "pods": 1.0})
+
+    def test_zone_and_capacity_type(self, engine, catalog):
+        reqs = Requirements(
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-2"]),
+            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ["spot"]),
+        )
+        run_case(engine, catalog, reqs, {"cpu": 1.0, "pods": 1.0})
+
+    def test_notin_and_exists(self, engine, catalog):
+        reqs = Requirements(
+            Requirement(wk.LABEL_ARCH, Operator.NOT_IN, ["arm64"]),
+            Requirement(wk.LABEL_INSTANCE_TYPE, Operator.EXISTS),
+        )
+        run_case(engine, catalog, reqs, {"cpu": 100.0, "memory": 300 * GIB, "pods": 1.0})
+
+    def test_huge_request_fits_nothing(self, engine, catalog):
+        reqs = Requirements()
+        rows = engine.rows_for(reqs)
+        req_vec = encode_resource_lists(engine.resource_dims, [{"cpu": 10000.0}])
+        f = engine.feasibility([rows], req_vec, engine.key_presence([reqs]))
+        assert not f.fits.any()
+        assert f.compat.all()
+
+    def test_unknown_extended_resource(self, engine, catalog):
+        # engine must raise if asked to encode an unregistered resource
+        with pytest.raises(KeyError):
+            encode_resource_lists(engine.resource_dims, [{"gpu-vendor.example/gpu": 1.0}])
+
+    def test_custom_label_row(self, engine, catalog):
+        # custom key the catalog doesn't define: compat with every type
+        reqs = Requirements(Requirement("team", Operator.IN, ["a"]))
+        run_case(engine, catalog, reqs, {"cpu": 1.0, "pods": 1.0})
+
+    def test_batched_query_many_sets(self, engine, catalog):
+        all_reqs = [
+            Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"])),
+            Requirements(Requirement(wk.LABEL_ARCH, Operator.IN, ["arm64"])),
+            Requirements(
+                Requirement(wk.LABEL_OS, Operator.IN, ["windows"]),
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]),
+            ),
+            Requirements(),
+        ]
+        requests = [
+            {"cpu": 1.0, "pods": 1.0},
+            {"cpu": 64.0, "memory": 100 * GIB, "pods": 1.0},
+            {"cpu": 0.5, "pods": 1.0},
+            {"cpu": 255.0, "pods": 1.0},
+        ]
+        row_sets = [engine.rows_for(r) for r in all_reqs]
+        req_mat = encode_resource_lists(engine.resource_dims, requests)
+        f = engine.feasibility(row_sets, req_mat, engine.key_presence(all_reqs))
+        for p, (reqs, req) in enumerate(zip(all_reqs, requests)):
+            for i, it in enumerate(catalog):
+                ec, ef, eo = oracle_triple(it, reqs, req)
+                assert (f.compat[p, i], f.fits[p, i], f.has_offering[p, i]) == (
+                    ec,
+                    ef,
+                    eo,
+                ), f"p={p} {it.name}"
+
+    def test_feasible_count_sanity(self, engine, catalog):
+        # 4-cpu linux/amd64 request: only types with >4 allocatable cpu fit
+        reqs = Requirements(
+            Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+            Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+        )
+        rows = engine.rows_for(reqs)
+        req_vec = encode_resource_lists(
+            engine.resource_dims, [{"cpu": 4.0, "pods": 1.0}]
+        )
+        f = engine.feasibility([rows], req_vec, engine.key_presence([reqs]))
+        feasible_names = {
+            catalog[i].name for i in np.flatnonzero(f.feasible[0])
+        }
+        # 12 cpu sizes, sizes >= 8 fit (4+overhead > 4 excludes cpu=4) × 3 families
+        assert all("amd64-linux" in n for n in feasible_names)
+        sizes = {int(n.split("-")[1][:-1]) for n in feasible_names}
+        assert sizes == {8, 16, 32, 48, 64, 96, 128, 192, 256}

@@ -1,0 +1,140 @@
+"""Property tests: device feasibility kernel ≡ host requirements algebra.
+
+The host `Requirements.intersects` is the semantic oracle (itself tested
+against reference behaviors); the kernel must agree on randomized inputs
+including complements, bounds, and exemption cases.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from karpenter_tpu.ops import encoding as enc  # noqa: E402
+from karpenter_tpu.ops import feasibility as feas  # noqa: E402
+from karpenter_tpu.scheduling.requirements import (  # noqa: E402
+    Operator,
+    Requirement,
+    Requirements,
+)
+
+KEYS = ["zone", "arch", "size", "team", "tier"]
+VALUES = {
+    "zone": ["z1", "z2", "z3", "z4"],
+    "arch": ["amd64", "arm64"],
+    "size": ["1", "2", "4", "8", "16"],
+    "team": ["a", "b", "c"],
+    "tier": ["0", "1", "x"],
+}
+
+
+def random_requirement(rng: random.Random, key: str) -> Requirement:
+    op = rng.choice(
+        [Operator.IN, Operator.NOT_IN, Operator.EXISTS, Operator.DOES_NOT_EXIST]
+        + ([Operator.GT, Operator.LT] if key == "size" else [])
+    )
+    vals = VALUES[key]
+    if op in (Operator.IN, Operator.NOT_IN):
+        n = rng.randint(1, len(vals))
+        return Requirement(key, op, rng.sample(vals, n))
+    if op in (Operator.GT, Operator.LT):
+        return Requirement(key, op, [str(rng.choice([0, 1, 2, 3, 5, 9, 20]))])
+    return Requirement(key, op)
+
+
+def random_req_set(rng: random.Random) -> Requirements:
+    n = rng.randint(0, len(KEYS))
+    keys = rng.sample(KEYS, n)
+    return Requirements(*(random_requirement(rng, k) for k in keys))
+
+
+def kernel_compat(rows, sets, vocab):
+    """Run the device kernel for requirement rows vs sets."""
+    er = enc.encode_requirement_rows(vocab, rows)
+    es = enc.encode_requirement_sets(
+        vocab, sets, key_capacity=vocab.key_capacity, word_capacity=vocab.word_capacity
+    )
+    # rows may have interned new slots after their encoding — re-encode to be safe
+    er = enc.encode_requirement_rows(vocab, rows)
+    tables = vocab.tables()
+    out = feas.req_rows_vs_sets(
+        jnp.asarray(er.key),
+        jnp.asarray(er.complement),
+        jnp.asarray(er.has_values),
+        jnp.asarray(er.gt),
+        jnp.asarray(er.lt),
+        jnp.asarray(er.mask),
+        jnp.asarray(es.present),
+        jnp.asarray(es.complement),
+        jnp.asarray(es.has_values),
+        jnp.asarray(es.gt),
+        jnp.asarray(es.lt),
+        jnp.asarray(es.mask),
+        jnp.asarray(tables.slot_key),
+        jnp.asarray(tables.value_int),
+    )
+    return np.asarray(out)
+
+
+class TestKernelMatchesHost:
+    def test_randomized_equivalence(self):
+        rng = random.Random(42)
+        # pre-intern the full value space so capacities are stable
+        vocab = enc.Vocab()
+        for k, vs in VALUES.items():
+            for v in vs:
+                vocab.slot(k, v)
+
+        rows = [random_requirement(rng, rng.choice(KEYS)) for _ in range(60)]
+        sets = [random_req_set(rng) for _ in range(40)]
+        got = kernel_compat(rows, sets, vocab)
+
+        for i, row in enumerate(rows):
+            for j, s in enumerate(sets):
+                # oracle: existing set `s` vs incoming single-row requirements
+                expected = s.intersects(Requirements(row)) is None
+                assert got[i, j] == expected, (
+                    f"row={row!r} set={s!r}: kernel={got[i, j]} host={expected}"
+                )
+
+    def test_unconstrained_key_is_compatible(self):
+        vocab = enc.Vocab()
+        rows = [Requirement("zone", Operator.IN, ["z9"])]
+        sets = [Requirements(Requirement("arch", Operator.IN, ["amd64"]))]
+        assert kernel_compat(rows, sets, vocab)[0, 0]
+
+    def test_bounds_vs_concrete(self):
+        vocab = enc.Vocab()
+        rows = [Requirement("size", Operator.GT, ["4"])]
+        sets = [
+            Requirements(Requirement("size", Operator.IN, ["2", "4"])),
+            Requirements(Requirement("size", Operator.IN, ["8"])),
+        ]
+        got = kernel_compat(rows, sets, vocab)
+        assert not got[0, 0] and got[0, 1]
+
+    def test_membership_all(self):
+        membership = jnp.asarray(
+            np.array([[1, 1, 0], [0, 0, 1], [0, 0, 0]], dtype=bool)
+        )
+        row_ok = jnp.asarray(
+            np.array([[1, 0], [1, 1], [0, 1]], dtype=bool)
+        )
+        got = np.asarray(feas.membership_all(membership, row_ok))
+        # pod0 needs rows {0,1}: target0 -> 1&1=yes, target1 -> 0&1=no
+        # pod1 needs row {2}: target0 -> no, target1 -> yes
+        # pod2 unconstrained: both yes
+        expected = np.array([[True, False], [False, True], [True, True]])
+        assert (got == expected).all()
+
+    def test_fits_matrix(self):
+        req = jnp.asarray(np.array([[1.0, 2.0, 0.0], [4.0, 0.0, 1.0]], np.float32))
+        alloc = jnp.asarray(
+            np.array([[2.0, 2.0, 0.0], [8.0, 8.0, 0.0]], np.float32)
+        )
+        got = np.asarray(feas.fits_matrix(req, alloc))
+        expected = np.array([[True, True], [False, False]])  # gpu=1 never fits
+        assert (got == expected).all()

@@ -1,0 +1,38 @@
+import datetime as dt
+
+from karpenter_tpu.apis.nodepool import Budget, NodePool
+
+
+def ts(y, mo, d, h, mi):
+    return dt.datetime(y, mo, d, h, mi, tzinfo=dt.timezone.utc).timestamp()
+
+
+class TestBudgets:
+    def test_percentage_rounds_up(self):
+        # default 10% must allow 1 disruption even on small nodepools
+        assert Budget(nodes="10%").allowed_disruptions(5, 0.0) == 1
+        assert Budget(nodes="10%").allowed_disruptions(25, 0.0) == 3
+        assert Budget(nodes="0%").allowed_disruptions(5, 0.0) == 0
+
+    def test_absolute(self):
+        assert Budget(nodes="3").allowed_disruptions(100, 0.0) == 3
+
+    def test_schedule_without_duration_fails_closed(self):
+        b = Budget(nodes="100%", schedule="@daily", duration=None)
+        assert b.allowed_disruptions(10, ts(2026, 7, 29, 0, 30)) == 0
+
+    def test_schedule_window(self):
+        b = Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)
+        # inside window: restricted to 0
+        assert b.allowed_disruptions(10, ts(2026, 7, 29, 9, 30)) == 0
+        # outside window: unrestricted
+        assert b.allowed_disruptions(10, ts(2026, 7, 29, 11, 30)) == 10
+
+    def test_nodepool_most_restrictive_and_reasons(self):
+        np_ = NodePool()
+        np_.spec.disruption.budgets = [
+            Budget(nodes="50%"),
+            Budget(nodes="2", reasons=["Drifted"]),
+        ]
+        assert np_.allowed_disruptions("Empty", 10, 0.0) == 5
+        assert np_.allowed_disruptions("Drifted", 10, 0.0) == 2

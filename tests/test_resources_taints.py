@@ -123,3 +123,18 @@ class TestPodRequestsEdgeCases:
             spec=PodSpec(containers=[Container(requests={"cpu": 1.0}, limits={"cpu": 4.0})])
         )
         assert pod_resource_requests(pod)["cpu"] == 1.0
+
+
+class TestTolerationOperators:
+    def test_unknown_operator_never_tolerates(self):
+        t = Toleration(key="a", operator="exists")  # typo'd operator
+        assert not t.tolerates(Taint(key="a", effect="NoSchedule"))
+
+    def test_exists_with_value_never_tolerates(self):
+        t = Toleration(key="a", operator="Exists", value="x")
+        assert not t.tolerates(Taint(key="a", effect="NoSchedule"))
+
+    def test_empty_operator_is_equal(self):
+        t = Toleration(key="a", operator="", value="v")
+        assert t.tolerates(Taint(key="a", value="v", effect="NoSchedule"))
+        assert not t.tolerates(Taint(key="a", value="w", effect="NoSchedule"))
