@@ -1,0 +1,54 @@
+"""Shared status-condition helpers for API objects whose status carries a
+list[Condition] (NodeClaim, NodePool). One implementation so transition-time
+bumping stays consistent (reference: operatorpkg status conditions)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.apis.core import Condition
+
+
+class ConditionedStatus:
+    """Mixin for objects exposing `.status.conditions: list[Condition]`."""
+
+    def get_condition(self, condition_type: str) -> Optional[Condition]:
+        for c in self.status.conditions:
+            if c.type == condition_type:
+                return c
+        return None
+
+    def set_condition(
+        self,
+        condition_type: str,
+        status: str,
+        reason: str = "",
+        message: str = "",
+        now: float = 0.0,
+    ) -> Condition:
+        existing = self.get_condition(condition_type)
+        if existing is not None:
+            if existing.status != status:
+                existing.last_transition_time = now
+            existing.status = status
+            existing.reason = reason
+            existing.message = message
+            return existing
+        c = Condition(
+            type=condition_type,
+            status=status,
+            reason=reason,
+            message=message,
+            last_transition_time=now,
+        )
+        self.status.conditions.append(c)
+        return c
+
+    def clear_condition(self, condition_type: str) -> None:
+        self.status.conditions = [
+            c for c in self.status.conditions if c.type != condition_type
+        ]
+
+    def condition_is_true(self, condition_type: str) -> bool:
+        c = self.get_condition(condition_type)
+        return c is not None and c.status == "True"

@@ -306,6 +306,7 @@ class PodDisruptionBudgetSpec:
     selector: LabelSelector = field(default_factory=LabelSelector)
     min_available: Optional[int | str] = None  # int or percentage string
     max_unavailable: Optional[int | str] = None
+    unhealthy_pod_eviction_policy: Optional[str] = None  # "AlwaysAllow" | None
 
 
 @dataclass

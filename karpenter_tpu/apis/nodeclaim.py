@@ -6,6 +6,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from karpenter_tpu.apis.conditions import ConditionedStatus
 from karpenter_tpu.apis.core import Condition, ObjectMeta, Taint
 from karpenter_tpu.utils.resources import ResourceList
 
@@ -63,50 +64,9 @@ class NodeClaimStatus:
 
 
 @dataclass
-class NodeClaim:
+class NodeClaim(ConditionedStatus):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)
     status: NodeClaimStatus = field(default_factory=NodeClaimStatus)
 
     KIND = "NodeClaim"
-
-    def get_condition(self, condition_type: str) -> Optional[Condition]:
-        for c in self.status.conditions:
-            if c.type == condition_type:
-                return c
-        return None
-
-    def set_condition(
-        self,
-        condition_type: str,
-        status: str,
-        reason: str = "",
-        message: str = "",
-        now: float = 0.0,
-    ) -> Condition:
-        existing = self.get_condition(condition_type)
-        if existing is not None:
-            if existing.status != status:
-                existing.last_transition_time = now
-            existing.status = status
-            existing.reason = reason
-            existing.message = message
-            return existing
-        c = Condition(
-            type=condition_type,
-            status=status,
-            reason=reason,
-            message=message,
-            last_transition_time=now,
-        )
-        self.status.conditions.append(c)
-        return c
-
-    def clear_condition(self, condition_type: str) -> None:
-        self.status.conditions = [
-            c for c in self.status.conditions if c.type != condition_type
-        ]
-
-    def condition_is_true(self, condition_type: str) -> bool:
-        c = self.get_condition(condition_type)
-        return c is not None and c.status == "True"

@@ -8,6 +8,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Optional
 
+from karpenter_tpu.apis.conditions import ConditionedStatus
 from karpenter_tpu.apis.core import ObjectMeta
 from karpenter_tpu.apis.nodeclaim import NodeClaimSpec
 from karpenter_tpu.utils.resources import ResourceList
@@ -98,7 +99,7 @@ class NodePoolStatus:
 
 
 @dataclass
-class NodePool:
+class NodePool(ConditionedStatus):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodePoolSpec = field(default_factory=NodePoolSpec)
     status: NodePoolStatus = field(default_factory=NodePoolStatus)
@@ -135,9 +136,3 @@ class NodePool:
                 continue
             allowed = min(allowed, budget.allowed_disruptions(total_nodes, now))
         return allowed
-
-    def get_condition(self, condition_type: str):
-        for c in self.status.conditions:
-            if c.type == condition_type:
-                return c
-        return None

@@ -1,0 +1,9 @@
+from karpenter_tpu.metrics.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Store,
+    global_registry,
+    measure,
+)

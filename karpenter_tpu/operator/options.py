@@ -1,0 +1,126 @@
+"""Operator configuration: flags with env fallbacks and feature gates.
+
+Mirrors the reference's pkg/operator/options/options.go:56-206 — the same
+option set (batch windows, feature gates, batch sizing) exposed as a
+dataclass, parseable from argv/env, with the context-injection pattern
+replaced by explicit passing (Python has no ctx plumbing to avoid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+@dataclass
+class FeatureGates:
+    """options.go:56-63; defaults match ParseFeatureGates (options.go:170-193)."""
+
+    node_repair: bool = False
+    reserved_capacity: bool = True
+    spot_to_spot_consolidation: bool = False
+    node_overlay: bool = False
+
+    @classmethod
+    def parse(cls, raw: str) -> "FeatureGates":
+        gates = cls()
+        mapping = {
+            "NodeRepair": "node_repair",
+            "ReservedCapacity": "reserved_capacity",
+            "SpotToSpotConsolidation": "spot_to_spot_consolidation",
+            "NodeOverlay": "node_overlay",
+        }
+        for part in filter(None, (p.strip() for p in raw.split(","))):
+            key, _, value = part.partition("=")
+            attr = mapping.get(key)
+            if attr is not None:
+                setattr(gates, attr, value.lower() == "true")
+        return gates
+
+
+@dataclass
+class Options:
+    """options.go:66-127. Durations are seconds."""
+
+    service_name: str = ""
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    kube_client_qps: float = 200.0
+    kube_client_burst: int = 300
+    enable_profiling: bool = False
+    disable_leader_election: bool = False
+    memory_limit: int = -1
+    log_level: str = "info"
+    batch_max_duration: float = 10.0
+    batch_idle_duration: float = 1.0
+    preferences_policy: str = "Respect"  # "Respect" | "Ignore"
+    min_values_policy: str = "Strict"  # "Strict" | "BestEffort"
+    cluster_name: str = ""
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+
+    # TPU-solver knobs (ours, not the reference's)
+    solver_backend: str = "tpu"  # "tpu" | "host"
+    solver_pod_shard_axis: int = 1  # devices to shard the pod axis over
+
+    @classmethod
+    def parse(cls, argv: Optional[list[str]] = None, env: Optional[dict] = None) -> "Options":
+        env = dict(os.environ if env is None else env)
+        parser = argparse.ArgumentParser(prog="karpenter-tpu", add_help=True)
+        parser.add_argument("--karpenter-service", dest="service_name")
+        parser.add_argument("--metrics-port", type=int)
+        parser.add_argument("--health-probe-port", type=int)
+        parser.add_argument("--kube-client-qps", type=float)
+        parser.add_argument("--kube-client-burst", type=int)
+        parser.add_argument("--enable-profiling", action="store_true", default=None)
+        parser.add_argument("--disable-leader-election", action="store_true", default=None)
+        parser.add_argument("--memory-limit", type=int)
+        parser.add_argument("--log-level")
+        parser.add_argument("--batch-max-duration", type=float)
+        parser.add_argument("--batch-idle-duration", type=float)
+        parser.add_argument("--preferences-policy")
+        parser.add_argument("--min-values-policy")
+        parser.add_argument("--cluster-name")
+        parser.add_argument("--feature-gates", dest="feature_gates_raw")
+        parser.add_argument("--solver-backend")
+        parser.add_argument("--solver-pod-shard-axis", type=int)
+        ns = parser.parse_args(argv or [])
+
+        opts = cls()
+        env_map = {
+            "service_name": "KARPENTER_SERVICE",
+            "metrics_port": "METRICS_PORT",
+            "health_probe_port": "HEALTH_PROBE_PORT",
+            "kube_client_qps": "KUBE_CLIENT_QPS",
+            "kube_client_burst": "KUBE_CLIENT_BURST",
+            "log_level": "LOG_LEVEL",
+            "batch_max_duration": "BATCH_MAX_DURATION",
+            "batch_idle_duration": "BATCH_IDLE_DURATION",
+            "preferences_policy": "PREFERENCES_POLICY",
+            "min_values_policy": "MIN_VALUES_POLICY",
+            "cluster_name": "CLUSTER_NAME",
+            "solver_backend": "SOLVER_BACKEND",
+        }
+        for f in fields(cls):
+            if f.name == "feature_gates":
+                continue
+            env_key = env_map.get(f.name)
+            if env_key and env_key in env:
+                raw = env[env_key]
+                current = getattr(opts, f.name)
+                if isinstance(current, bool):
+                    setattr(opts, f.name, raw.lower() == "true")
+                elif isinstance(current, int):
+                    setattr(opts, f.name, int(raw))
+                elif isinstance(current, float):
+                    setattr(opts, f.name, float(raw))
+                else:
+                    setattr(opts, f.name, raw)
+            flag_val = getattr(ns, f.name, None)
+            if flag_val is not None:
+                setattr(opts, f.name, flag_val)
+        raw_gates = ns.feature_gates_raw or env.get("FEATURE_GATES", "")
+        if raw_gates:
+            opts.feature_gates = FeatureGates.parse(raw_gates)
+        return opts

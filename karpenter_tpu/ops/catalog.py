@@ -125,7 +125,11 @@ class CatalogEngine:
             word_capacity=self._word_capacity,
         )
         self._tables = v.tables()
+        self._tables_version = v.version
+        self._device_cache: dict[str, jnp.ndarray] = {}
 
+        # float64 so byte-scale memory comparisons match the host oracle
+        # exactly (float32 loses ~512B at 8GiB).
         self.allocatable = enc.encode_resource_lists(
             self.resource_dims, [it.allocatable() for it in self.instance_types]
         )
@@ -192,6 +196,11 @@ class CatalogEngine:
         # capacities; encode_requirement_rows interns first, then we re-size.
         er = enc.encode_requirement_rows(self.vocab, new_rows, None)
         self._maybe_reencode()
+        # New slots may have been interned without outgrowing the padded
+        # capacities; the per-slot tables must still reflect them.
+        if self.vocab.version != self._tables_version:
+            self._tables = self.vocab.tables()
+            self._tables_version = self.vocab.version
         if er.mask.shape[1] < self._word_capacity:
             pad = self._word_capacity - er.mask.shape[1]
             er.mask = np.pad(er.mask, ((0, 0), (0, pad)))
@@ -237,6 +246,17 @@ class CatalogEngine:
         self._req_compat = np.concatenate([self._req_compat, new_inst], axis=0)
         self._offer_compat = np.concatenate([self._offer_compat, new_off], axis=0)
         self._computed_rows = len(self._rows)
+        self._device_cache.pop("req_compat", None)
+        self._device_cache.pop("offer_compat", None)
+
+    def _dev(self, name: str, host_array: np.ndarray) -> jnp.ndarray:
+        """Device-resident copy of a catalog matrix, uploaded once per
+        (re)encode instead of on every query."""
+        arr = self._device_cache.get(name)
+        if arr is None:
+            arr = jnp.asarray(host_array)
+            self._device_cache[name] = arr
+        return arr
 
     # -- queries ------------------------------------------------------------
 
@@ -267,38 +287,39 @@ class CatalogEngine:
             for rid in rows:
                 membership[p, rid] = True
 
-        req_compat = (
-            self._req_compat
-            if self._computed_rows
-            else np.zeros((1, self.num_instances), dtype=bool)
-        )
-        compat = np.asarray(
-            feas.membership_all(jnp.asarray(membership), jnp.asarray(req_compat))
-        )
-        fits = np.asarray(
-            feas.fits_matrix(jnp.asarray(requests), jnp.asarray(self.allocatable))
+        if self._computed_rows:
+            req_compat = self._dev("req_compat", self._req_compat)
+        else:
+            req_compat = jnp.zeros((1, self.num_instances), dtype=bool)
+        membership_dev = jnp.asarray(membership)
+        compat = np.asarray(feas.membership_all(membership_dev, req_compat))
+        # fits stays host-side in float64: exact parity with resources.fits
+        # at byte magnitudes; it's an O(P*I*D) elementwise op, not the matmul.
+        fits = np.all(
+            requests.astype(np.float64)[:, None, :]
+            <= self.allocatable[None, :, :] + 1e-9,
+            axis=-1,
         )
 
         if self.num_offerings == 0:
             has_offering = np.zeros((P, self.num_instances), dtype=bool)
             return Feasibility(compat, fits, has_offering)
 
-        offer_compat = (
-            self._offer_compat
-            if self._computed_rows
-            else np.zeros((1, self.num_offerings), dtype=bool)
-        )
-        offer_rows_ok = np.asarray(
-            feas.membership_all(jnp.asarray(membership), jnp.asarray(offer_compat))
-        )  # [P, O]
         if key_present is None:
-            undef_ok = ~self.offering_custom_need.any(axis=1)[None, :]  # [1, O]
-        else:
-            # offering needs key k but set doesn't define it -> incompatible
-            bad = self.offering_custom_need.astype(np.float32) @ (~key_present).astype(np.float32).T
-            undef_ok = (bad < 0.5).T  # [P, O]
-        offer_ok = offer_rows_ok & undef_ok & self.offering_available[None, :]
-        has_offering = (
-            offer_ok.astype(np.float32) @ self._owner_onehot.astype(np.float32)
-        ) > 0.5
+            key_present = np.zeros((P, self._key_capacity), dtype=bool)
+        offer_compat = (
+            self._dev("offer_compat", self._offer_compat)
+            if self._computed_rows
+            else jnp.zeros((1, self.num_offerings), dtype=bool)
+        )
+        has_offering = np.asarray(
+            feas.offering_reduce(
+                membership_dev,
+                offer_compat,
+                self._dev("custom_need", self.offering_custom_need),
+                jnp.asarray(key_present),
+                self._dev("available", self.offering_available),
+                self._dev("owner_onehot", self._owner_onehot),
+            )
+        )
         return Feasibility(compat, fits, has_offering)

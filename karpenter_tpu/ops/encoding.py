@@ -248,9 +248,11 @@ def encode_resource_dims(resource_names: Sequence[str]) -> dict[str, int]:
 def encode_resource_lists(
     dims: dict[str, int], items: Sequence[dict], missing: float = 0.0
 ) -> np.ndarray:
-    """[N, R] float32 resource matrix; unknown resource names must be
-    registered in `dims` by the caller beforehand."""
-    out = np.full((len(items), len(dims)), missing, dtype=np.float32)
+    """[N, R] float64 resource matrix; unknown resource names must be
+    registered in `dims` by the caller beforehand. float64 so byte-scale
+    memory values stay exact — the device packer quantizes separately
+    (feasibility.quantize_resources)."""
+    out = np.full((len(items), len(dims)), missing, dtype=np.float64)
     for i, rl in enumerate(items):
         for name, v in rl.items():
             out[i, dims[name]] = v

@@ -130,8 +130,44 @@ def membership_all(membership: jnp.ndarray, row_ok: jnp.ndarray) -> jnp.ndarray:
 def fits_matrix(requests: jnp.ndarray, allocatable: jnp.ndarray) -> jnp.ndarray:
     """fits[P, I]: requests[p] <= allocatable[i] element-wise.
 
-    requests:    [P, D] float32 (missing resources must be 0)
-    allocatable: [I, D] float32 (resources the node lacks must be 0)
+    requests:    [P, D] (missing resources must be 0)
+    allocatable: [I, D] (resources the node lacks must be 0)
     Mirrors resources.Fits: a positive request against a zero capacity fails.
+    Callers on the exact-parity path must pass integer-quantized units (see
+    quantize_resources) — float32 alone loses ~512B at 8GiB scale.
     """
-    return jnp.all(requests[:, None, :] <= allocatable[None, :, :] + 1e-9, axis=-1)
+    return jnp.all(requests[:, None, :] <= allocatable[None, :, :], axis=-1)
+
+
+def quantize_resources(values: np.ndarray, ceil: bool) -> np.ndarray:
+    """float64 [., D] resources → int64 milli-units, rounded conservatively.
+
+    Requests round up, capacities round down, so an integer comparison can
+    only be stricter than the host float64 oracle, never looser. Milli-units
+    keep cpu ("100m") exact; memory bytes are already integral.
+    """
+    scaled = values * 1000.0
+    out = np.ceil(scaled - 1e-6) if ceil else np.floor(scaled + 1e-6)
+    return out.astype(np.int64)
+
+
+@jax.jit
+def offering_reduce(
+    membership: jnp.ndarray,  # [P, R] bool
+    offer_compat: jnp.ndarray,  # [R, O] bool — row r compatible with offering o
+    custom_need: jnp.ndarray,  # [O, K] bool — offering needs custom key k defined
+    key_present: jnp.ndarray,  # [P, K] bool — query set defines key k
+    available: jnp.ndarray,  # [O] bool
+    owner_onehot: jnp.ndarray,  # [O, I] bool
+) -> jnp.ndarray:
+    """has_offering[P, I]: any available, fully-compatible offering per type.
+
+    Fuses the three offering gates (row compat, undefined-custom-label rule,
+    availability) and the offering→instance any-reduce into one device
+    program (scheduling/nodeclaim.go:414-433 semantics).
+    """
+    offer_rows_ok = membership_all(membership, offer_compat)  # [P, O]
+    bad = custom_need.astype(jnp.float32) @ (~key_present).astype(jnp.float32).T
+    undef_ok = (bad < 0.5).T  # [P, O]
+    offer_ok = offer_rows_ok & undef_ok & available[None, :]
+    return (offer_ok.astype(jnp.float32) @ owner_onehot.astype(jnp.float32)) > 0.5

@@ -1,0 +1,1 @@
+from karpenter_tpu.runtime.store import Event, Store  # noqa: F401

@@ -1,0 +1,39 @@
+"""Injectable clock, mirroring the reference's use of k8s.io/utils/clock.
+
+Every controller takes a Clock so tests can drive time deterministically
+(reference test pattern: clock.NewFakeClock in every suite_test.go).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.time()
+
+    def since(self, t: float) -> float:
+        return self.now() - t
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Settable clock for tests (k8s.io/utils/clock/testing.FakeClock)."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.step(seconds)
+
+    def step(self, seconds: float) -> None:
+        self._now += seconds
+
+    def set_time(self, t: float) -> None:
+        self._now = t

@@ -138,3 +138,33 @@ class TestCatalogEngine:
         assert all("amd64-linux" in n for n in feasible_names)
         sizes = {int(n.split("-")[1][:-1]) for n in feasible_names}
         assert sizes == {8, 16, 32, 48, 64, 96, 128, 192, 256}
+
+
+class TestRegressions:
+    def test_late_interned_slot_updates_tables(self, catalog):
+        """A value first seen in a query row (not the catalog) must still
+        resolve through the per-slot tables (stale-tables regression)."""
+        engine = CatalogEngine(catalog)
+        # Seed some rows so tables are computed, then query a brand-new value
+        # that fits inside the padded word capacity.
+        reqs0 = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        run_case(engine, catalog, reqs0, {"cpu": 1.0})
+        reqs = Requirements(
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.NOT_IN, ["definitely-new-zone"])
+        )
+        run_case(engine, catalog, reqs, {"cpu": 1.0})
+        # NotIn a value no instance type has: everything stays compatible
+        rows = engine.rows_for(reqs)
+        req_vec = encode_resource_lists(engine.resource_dims, [{"cpu": 1.0}])
+        f = engine.feasibility([rows], req_vec, engine.key_presence([reqs]))
+        assert f.compat.all()
+
+    def test_fits_byte_precision_matches_host(self, catalog):
+        """A request a few hundred bytes over allocatable must fail exactly
+        like the float64 host oracle (float32-precision regression)."""
+        engine = CatalogEngine(catalog)
+        it = catalog[0]
+        alloc_mem = it.allocatable()[wk.RESOURCE_MEMORY]
+        for delta in (-1024.0, 1024.0):
+            requests = {wk.RESOURCE_MEMORY: alloc_mem + delta, "cpu": 0.1}
+            run_case(engine, catalog, Requirements(), requests)

@@ -1,0 +1,146 @@
+"""In-memory store: versioning, finalizers, watches; events; metrics."""
+
+import pytest
+
+from karpenter_tpu.apis.core import ObjectMeta, Pod
+from karpenter_tpu.events.recorder import Event, Recorder
+from karpenter_tpu.metrics.registry import Registry, Store as MetricStore
+from karpenter_tpu.runtime.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    Store,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def pod(name="p"):
+    return Pod(metadata=ObjectMeta(name=name))
+
+
+class TestStore:
+    def test_create_get_list(self):
+        s = Store()
+        s.create(pod("a"))
+        s.create(pod("b"))
+        assert s.get("Pod", "a").metadata.name == "a"
+        assert len(s.list("Pod")) == 2
+        with pytest.raises(AlreadyExists):
+            s.create(pod("a"))
+
+    def test_versions_bump(self):
+        s = Store()
+        p = s.create(pod())
+        v1 = p.metadata.resource_version
+        s.update(p)
+        assert p.metadata.resource_version > v1
+
+    def test_optimistic_conflict(self):
+        s = Store()
+        p = s.create(pod())
+        stale = p.metadata.resource_version
+        s.update(p)
+        with pytest.raises(Conflict):
+            s.update(p, expect_version=stale)
+
+    def test_delete_without_finalizer_removes(self):
+        s = Store()
+        p = s.create(pod())
+        s.delete(p)
+        with pytest.raises(NotFound):
+            s.get("Pod", "p")
+
+    def test_delete_with_finalizer_sets_timestamp(self):
+        s = Store(clock=FakeClock(5.0))
+        p = pod()
+        p.metadata.finalizers.append("karpenter.sh/termination")
+        s.create(p)
+        s.delete(p)
+        assert s.get("Pod", "p").metadata.deletion_timestamp == 5.0
+        # removing the finalizer completes deletion
+        s.remove_finalizer(p, "karpenter.sh/termination")
+        with pytest.raises(NotFound):
+            s.get("Pod", "p")
+
+    def test_watch_streams_events_in_order(self):
+        s = Store()
+        w = s.watch(["Pod"])
+        p = s.create(pod())
+        s.update(p)
+        s.delete(p)
+        events = w.drain()
+        assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+
+    def test_watch_kind_filter(self):
+        s = Store()
+        w = s.watch(["Node"])
+        s.create(pod())
+        assert len(w.drain()) == 0
+
+
+class TestRecorder:
+    def test_dedupes_within_ttl(self):
+        clock = FakeClock()
+        r = Recorder(clock=clock)
+        p = pod()
+        for _ in range(5):
+            r.publish(Event(p, "Normal", "Launched", "launched"))
+        assert len(r.events) == 1
+        clock.step(121.0)
+        r.publish(Event(p, "Normal", "Launched", "launched"))
+        assert len(r.events) == 2
+
+    def test_rate_limiter(self):
+        clock = FakeClock()
+        r = Recorder(clock=clock)
+        r.rate_limit("Nominate", rate=1.0, burst=2)
+        for i in range(5):
+            r.publish(Event(pod(f"p{i}"), "Normal", "Nominate", f"m{i}"))
+        assert r.calls("Nominate") == 2
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = Registry()
+        c = reg.counter("pods_total", labels=["phase"])
+        c.inc({"phase": "pending"})
+        c.inc({"phase": "pending"})
+        assert c.value({"phase": "pending"}) == 2
+        g = reg.gauge("limit")
+        g.set(5.0)
+        assert g.value() == 5.0
+        h = reg.histogram("latency")
+        h.observe(0.2)
+        assert h.count() == 1
+        text = reg.expose()
+        assert "pods_total" in text and "latency_count" in text
+
+    def test_store_replaces_series(self):
+        reg = Registry()
+        g = reg.gauge("node_capacity", labels=["node", "resource"])
+        ms = MetricStore()
+        ms.update("node-1", [(g, {"node": "node-1", "resource": "cpu"}, 4.0)])
+        assert g.value({"node": "node-1", "resource": "cpu"}) == 4.0
+        ms.update("node-1", [(g, {"node": "node-1", "resource": "memory"}, 8.0)])
+        assert g.value({"node": "node-1", "resource": "cpu"}) == 0.0
+        ms.delete("node-1")
+        assert g.value({"node": "node-1", "resource": "memory"}) == 0.0
+
+
+class TestOptions:
+    def test_defaults_env_flags(self):
+        from karpenter_tpu.operator.options import Options
+
+        opts = Options.parse([], env={})
+        assert opts.batch_idle_duration == 1.0
+        assert opts.feature_gates.reserved_capacity is True
+        opts = Options.parse(
+            ["--batch-idle-duration", "2.5", "--feature-gates", "SpotToSpotConsolidation=true"],
+            env={"BATCH_MAX_DURATION": "20"},
+        )
+        assert opts.batch_idle_duration == 2.5
+        assert opts.batch_max_duration == 20.0
+        assert opts.feature_gates.spot_to_spot_consolidation is True

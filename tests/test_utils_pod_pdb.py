@@ -1,0 +1,208 @@
+"""Pod classification + PDB limits, mirroring reference pkg/utils/pod and
+pkg/utils/pdb suites."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Condition,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+    PodSpec,
+    PodStatus,
+    Toleration,
+)
+from karpenter_tpu.scheduling.hostportusage import HostPortUsage, get_host_ports
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.pdb import Limits
+
+
+def make_pod(name="p", labels=None, **kw):
+    return Pod(metadata=ObjectMeta(name=name, labels=labels or {}), **kw)
+
+
+def unschedulable(pod):
+    pod.status.conditions.append(
+        Condition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return pod
+
+
+class TestPodClassification:
+    def test_provisionable_requires_unschedulable_condition(self):
+        pod = make_pod()
+        assert not podutil.is_provisionable(pod)
+        assert podutil.is_provisionable(unschedulable(pod))
+
+    def test_scheduled_pod_not_provisionable(self):
+        pod = unschedulable(make_pod())
+        pod.spec.node_name = "node-1"
+        assert not podutil.is_provisionable(pod)
+
+    def test_preempting_pod_not_provisionable(self):
+        pod = unschedulable(make_pod())
+        pod.status.nominated_node_name = "node-1"
+        assert not podutil.is_provisionable(pod)
+
+    def test_daemonset_pod_not_provisionable(self):
+        pod = unschedulable(make_pod())
+        pod.metadata.owner_references.append(
+            OwnerReference(kind="DaemonSet", name="ds", uid="x")
+        )
+        assert not podutil.is_provisionable(pod)
+        assert not podutil.is_reschedulable(pod)
+
+    def test_terminal_pod_not_reschedulable(self):
+        pod = make_pod()
+        pod.status.phase = "Succeeded"
+        assert not podutil.is_reschedulable(pod)
+
+    def test_terminating_statefulset_pod_is_reschedulable(self):
+        pod = make_pod()
+        pod.metadata.deletion_timestamp = 123.0
+        assert not podutil.is_reschedulable(pod)
+        pod.metadata.owner_references.append(
+            OwnerReference(kind="StatefulSet", name="ss", uid="x")
+        )
+        assert podutil.is_reschedulable(pod)
+
+    def test_do_not_disrupt_pod_not_evictable(self):
+        pod = make_pod()
+        assert podutil.is_evictable(pod)
+        pod.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        assert not podutil.is_evictable(pod)
+        # ...but still drainable: drain stalls on it
+        assert podutil.is_drainable(pod, FakeClock())
+
+    def test_tolerating_disrupted_taint_not_evictable(self):
+        pod = make_pod()
+        pod.spec.tolerations.append(
+            Toleration(key=wk.DISRUPTED_TAINT_KEY, operator="Exists")
+        )
+        assert not podutil.is_evictable(pod)
+        assert not podutil.is_drainable(pod, FakeClock())
+
+    def test_stuck_terminating(self):
+        clock = FakeClock(start=1000.0)
+        pod = make_pod()
+        pod.metadata.deletion_timestamp = 1000.0
+        assert not podutil.is_stuck_terminating(pod, clock)
+        clock.step(100.0)
+        assert podutil.is_stuck_terminating(pod, clock)
+        assert not podutil.is_drainable(pod, clock)
+
+
+class TestPdbLimits:
+    def pdb(self, name="pdb", labels=None, allowed=1, max_unavailable=None, min_available=None):
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(name=name),
+            spec=PodDisruptionBudgetSpec(
+                selector=LabelSelector(match_labels=labels or {"app": "x"}),
+                max_unavailable=max_unavailable,
+                min_available=min_available,
+            ),
+            status=PodDisruptionBudgetStatus(disruptions_allowed=allowed),
+        )
+
+    def test_can_evict_when_disruptions_allowed(self):
+        limits = Limits.from_pdbs([self.pdb(allowed=1)])
+        pod = make_pod(labels={"app": "x"})
+        _, ok = limits.can_evict_pods([pod])
+        assert ok
+
+    def test_blocked_when_zero_disruptions(self):
+        limits = Limits.from_pdbs([self.pdb(allowed=0)])
+        pod = make_pod(labels={"app": "x"})
+        keys, ok = limits.can_evict_pods([pod])
+        assert not ok and keys == [("default", "pdb")]
+
+    def test_multiple_matching_pdbs_block(self):
+        limits = Limits.from_pdbs([self.pdb("a", allowed=5), self.pdb("b", allowed=5)])
+        pod = make_pod(labels={"app": "x"})
+        _, ok = limits.can_evict_pods([pod])
+        assert not ok
+
+    def test_non_matching_pdb_ignored(self):
+        limits = Limits.from_pdbs([self.pdb(labels={"app": "other"}, allowed=0)])
+        pod = make_pod(labels={"app": "x"})
+        _, ok = limits.can_evict_pods([pod])
+        assert ok
+
+    def test_fully_blocking_pdb_prevents_reschedule(self):
+        pod = make_pod(labels={"app": "x"})
+        limits = Limits.from_pdbs([self.pdb(allowed=0, max_unavailable=0)])
+        assert not limits.is_currently_reschedulable(pod)
+        limits = Limits.from_pdbs([self.pdb(allowed=0, min_available="100%")])
+        assert not limits.is_currently_reschedulable(pod)
+        # zero-allowed but not structurally blocking => still reschedulable
+        limits = Limits.from_pdbs([self.pdb(allowed=0, min_available=3)])
+        assert limits.is_currently_reschedulable(pod)
+
+    def test_unhealthy_eviction_policy(self):
+        pdb = self.pdb(allowed=0)
+        pdb.spec.unhealthy_pod_eviction_policy = "AlwaysAllow"
+        limits = Limits.from_pdbs([pdb])
+        pod = make_pod(labels={"app": "x"})
+        pod.status.conditions.append(Condition(type="Ready", status="False"))
+        _, ok = limits.can_evict_pods([pod])
+        assert ok
+
+    def test_non_evictable_pod_skips_pdb(self):
+        limits = Limits.from_pdbs([self.pdb(allowed=0)])
+        pod = make_pod(labels={"app": "x"})
+        pod.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        _, ok = limits.can_evict_pods([pod])
+        assert ok
+
+
+class TestHostPorts:
+    def pod_with_port(self, name, port, ip="", protocol="TCP"):
+        pod = make_pod(name)
+        pod.spec.containers.append(
+            Container(ports=[ContainerPort(container_port=80, host_port=port, host_ip=ip, protocol=protocol)])
+        )
+        return pod
+
+    def test_same_port_conflicts(self):
+        usage = HostPortUsage()
+        p1 = self.pod_with_port("p1", 8080)
+        usage.add(p1, get_host_ports(p1))
+        p2 = self.pod_with_port("p2", 8080)
+        assert usage.conflicts(p2, get_host_ports(p2)) is not None
+
+    def test_different_port_ok(self):
+        usage = HostPortUsage()
+        p1 = self.pod_with_port("p1", 8080)
+        usage.add(p1, get_host_ports(p1))
+        p2 = self.pod_with_port("p2", 8081)
+        assert usage.conflicts(p2, get_host_ports(p2)) is None
+
+    def test_distinct_ips_ok_but_wildcard_conflicts(self):
+        usage = HostPortUsage()
+        p1 = self.pod_with_port("p1", 8080, ip="10.0.0.1")
+        usage.add(p1, get_host_ports(p1))
+        p2 = self.pod_with_port("p2", 8080, ip="10.0.0.2")
+        assert usage.conflicts(p2, get_host_ports(p2)) is None
+        p3 = self.pod_with_port("p3", 8080)  # defaults to 0.0.0.0
+        assert usage.conflicts(p3, get_host_ports(p3)) is not None
+
+    def test_protocol_disambiguates(self):
+        usage = HostPortUsage()
+        p1 = self.pod_with_port("p1", 8080, protocol="TCP")
+        usage.add(p1, get_host_ports(p1))
+        p2 = self.pod_with_port("p2", 8080, protocol="UDP")
+        assert usage.conflicts(p2, get_host_ports(p2)) is None
+
+    def test_delete_pod_releases(self):
+        usage = HostPortUsage()
+        p1 = self.pod_with_port("p1", 8080)
+        usage.add(p1, get_host_ports(p1))
+        usage.delete_pod("default", "p1")
+        p2 = self.pod_with_port("p2", 8080)
+        assert usage.conflicts(p2, get_host_ports(p2)) is None
