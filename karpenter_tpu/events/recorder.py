@@ -66,6 +66,7 @@ class Recorder:
         for event in events:
             key = event.dedupe_key()
             now = self.clock.now()
+            self._maybe_evict(now)
             last = self._seen.get(key)
             if last is not None and now - last < DEDUPE_TTL:
                 continue
@@ -75,6 +76,13 @@ class Recorder:
             self._seen[key] = now
             event.timestamp = now
             self.events.append(event)
+
+    def _maybe_evict(self, now: float) -> None:
+        """Prune expired dedupe entries so the map is bounded by the TTL
+        window (the reference uses an expiring cache, recorder.go:48-58)."""
+        if len(self._seen) < 4096:
+            return
+        self._seen = {k: t for k, t in self._seen.items() if now - t < DEDUPE_TTL}
 
     def reset(self) -> None:
         self.events.clear()

@@ -183,7 +183,7 @@ class Store:
     Update replaces the full series set produced for an owner key, so series
     for deleted objects are removed on the next reconcile."""
 
-    def __init__(self, gauge_resolver=None):
+    def __init__(self):
         self._owned: dict[str, list[tuple[Gauge, tuple]]] = {}
 
     def update(self, key: str, series: list[tuple[Gauge, dict[str, str], float]]) -> None:

@@ -66,6 +66,10 @@ class Options:
 
     @classmethod
     def parse(cls, argv: Optional[list[str]] = None, env: Optional[dict] = None) -> "Options":
+        import sys
+
+        if argv is None:
+            argv = sys.argv[1:]
         env = dict(os.environ if env is None else env)
         parser = argparse.ArgumentParser(prog="karpenter-tpu", add_help=True)
         parser.add_argument("--karpenter-service", dest="service_name")
@@ -85,7 +89,7 @@ class Options:
         parser.add_argument("--feature-gates", dest="feature_gates_raw")
         parser.add_argument("--solver-backend")
         parser.add_argument("--solver-pod-shard-axis", type=int)
-        ns = parser.parse_args(argv or [])
+        ns = parser.parse_args(argv)
 
         opts = cls()
         env_map = {

@@ -1,0 +1,2 @@
+from karpenter_tpu.state.cluster import Cluster  # noqa: F401
+from karpenter_tpu.state.statenode import PodBlockEvictionError, StateNode  # noqa: F401

@@ -1,0 +1,56 @@
+"""State informers: pipe store watch events into Cluster.
+
+The reference runs five thin controllers (pkg/controllers/state/informer/
+{node,pod,nodeclaim,nodepool,daemonset}.go) fed by the controller-runtime
+cache. Here a single informer drains one watch subscription and dispatches
+per kind — same ingestion semantics, one linearized stream.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.runtime.store import ADDED, DELETED, MODIFIED, Event, Store
+from karpenter_tpu.state.cluster import Cluster
+
+WATCHED_KINDS = ("Node", "Pod", "NodeClaim", "NodePool", "DaemonSet")
+
+
+class StateInformer:
+    def __init__(self, store: Store, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+        self._watch = store.watch(WATCHED_KINDS)
+
+    def flush(self) -> int:
+        """Apply all pending watch events to cluster state; returns count."""
+        events = self._watch.drain()
+        for event in events:
+            self._apply(event)
+        return len(events)
+
+    def _apply(self, event: Event) -> None:
+        obj = event.obj
+        kind = event.kind
+        if kind == "Node":
+            if event.type == DELETED:
+                self.cluster.delete_node(obj.metadata.name)
+            else:
+                self.cluster.update_node(obj)
+        elif kind == "Pod":
+            if event.type == DELETED:
+                self.cluster.delete_pod(obj.metadata.namespace, obj.metadata.name)
+            else:
+                self.cluster.update_pod(obj)
+        elif kind == "NodeClaim":
+            if event.type == DELETED:
+                self.cluster.delete_node_claim(obj.metadata.name)
+            else:
+                self.cluster.update_node_claim(obj)
+        elif kind == "NodePool":
+            # NodePool changes invalidate consolidation decisions
+            # (informer/nodepool.go:45-55).
+            self.cluster.mark_unconsolidated()
+        elif kind == "DaemonSet":
+            if event.type == DELETED:
+                self.cluster.delete_daemonset(obj.metadata.namespace, obj.metadata.name)
+            else:
+                self.cluster.update_daemonset(obj)

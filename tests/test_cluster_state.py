@@ -1,0 +1,246 @@
+"""Cluster state + StateNode, mirroring reference pkg/controllers/state
+suite behaviors."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Condition,
+    Container,
+    DaemonSet,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodSpec,
+    Taint,
+)
+from karpenter_tpu.apis.nodeclaim import (
+    CONDITION_INSTANCE_TERMINATING,
+    NodeClaim,
+    NodeClaimStatus,
+)
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import NODE_RESOURCE, Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.state.statenode import PodBlockEvictionError, StateNode
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.pdb import Limits
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock=clock)
+    cluster = Cluster(clock, store, cloud_provider=None)
+    informer = StateInformer(store, cluster)
+    return clock, store, cluster, informer
+
+
+def make_node(name="node-1", pid=None, pool="default-pool", registered=True, initialized=True):
+    labels = {wk.NODEPOOL_LABEL_KEY: pool, wk.LABEL_INSTANCE_TYPE: "t-2-8"}
+    if registered:
+        labels[wk.NODE_REGISTERED_LABEL_KEY] = "true"
+    if initialized:
+        labels[wk.NODE_INITIALIZED_LABEL_KEY] = "true"
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=NodeSpec(provider_id=pid or f"kwok://{name}"),
+        status=NodeStatus(
+            capacity={"cpu": 4.0, "memory": 8.0 * 2**30, "pods": 110.0},
+            allocatable={"cpu": 3.8, "memory": 7.5 * 2**30, "pods": 110.0},
+        ),
+    )
+
+
+def make_claim(name="claim-1", pid="kwok://node-1", pool="default-pool"):
+    nc = NodeClaim(metadata=ObjectMeta(name=name, labels={wk.NODEPOOL_LABEL_KEY: pool}))
+    nc.status.provider_id = pid
+    nc.status.capacity = {"cpu": 4.0, "memory": 8.0 * 2**30}
+    nc.status.allocatable = {"cpu": 3.8, "memory": 7.5 * 2**30}
+    return nc
+
+
+def bound_pod(name, node_name, cpu=1.0):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(node_name=node_name, containers=[Container(requests={"cpu": cpu})]),
+    )
+
+
+class TestClusterIngestion:
+    def test_node_then_pods_tracked(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        store.create(bound_pod("p1", "node-1", cpu=1.0))
+        store.create(bound_pod("p2", "node-1", cpu=0.5))
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert n.total_pod_requests()["cpu"] == pytest.approx(1.5)
+        assert n.available()["cpu"] == pytest.approx(3.8 - 1.5)
+
+    def test_claim_then_node_merge(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_claim())
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert n.node is None and n.managed()
+        # capacity falls back to claim status pre-initialization
+        assert n.capacity()["cpu"] == 4.0
+        store.create(make_node())
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert n.node is not None and n.node_claim is not None
+        assert n.registered() and n.initialized()
+
+    def test_unregistered_claim_uses_claim_labels(self, env):
+        clock, store, cluster, informer = env
+        claim = make_claim()
+        claim.metadata.labels["foo"] = "bar"
+        store.create(claim)
+        store.create(make_node(registered=False, initialized=False))
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert not n.registered()
+        assert n.labels().get("foo") == "bar"
+
+    def test_ephemeral_taints_hidden_until_initialized(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_claim())
+        node = make_node(registered=True, initialized=False)
+        node.spec.taints = [
+            Taint(key=wk.TAINT_NODE_NOT_READY, effect="NoSchedule"),
+            Taint(key="custom", effect="NoSchedule"),
+        ]
+        store.create(node)
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert [t.key for t in n.taints()] == ["custom"]
+
+    def test_pod_deletion_releases_usage(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        p = store.create(bound_pod("p1", "node-1"))
+        informer.flush()
+        store.delete(p)
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert n.total_pod_requests() == {}
+
+    def test_pod_rebind_moves_usage(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node("node-1"))
+        store.create(make_node("node-2"))
+        p = store.create(bound_pod("p1", "node-1"))
+        informer.flush()
+        p.spec.node_name = "node-2"
+        store.update(p)
+        informer.flush()
+        nodes = {n.name(): n for n in cluster.state_nodes()}
+        assert nodes["node-1"].total_pod_requests() == {}
+        assert nodes["node-2"].total_pod_requests()["cpu"] == 1.0
+
+    def test_nodepool_resources_accounting(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node("node-1"))
+        store.create(make_node("node-2"))
+        informer.flush()
+        rl = cluster.nodepool_resources_for("default-pool")
+        assert rl["cpu"] == 8.0 and rl[NODE_RESOURCE] == 2.0
+        cluster.mark_for_deletion("kwok://node-1")
+        rl = cluster.nodepool_resources_for("default-pool")
+        assert rl["cpu"] == 4.0 and rl[NODE_RESOURCE] == 1.0
+        cluster.unmark_for_deletion("kwok://node-1")
+        assert cluster.nodepool_resources_for("default-pool")["cpu"] == 8.0
+
+    def test_node_deletion_cleanup(self, env):
+        clock, store, cluster, informer = env
+        node = store.create(make_node())
+        informer.flush()
+        store.delete(node)
+        informer.flush()
+        assert cluster.state_nodes() == []
+        assert cluster.nodepool_resources_for("default-pool") == {}
+
+    def test_synced_gate(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        claim = make_claim(pid="")
+        store.create(claim)
+        informer.flush()
+        assert not cluster.synced()  # claim has no provider id yet
+        claim.status.provider_id = "kwok://node-1"
+        store.update(claim)
+        informer.flush()
+        assert cluster.synced()
+
+    def test_daemonset_pod_cache(self, env):
+        clock, store, cluster, informer = env
+        ds = DaemonSet(metadata=ObjectMeta(name="ds"))
+        pod = bound_pod("ds-pod", "node-1")
+        pod.metadata.owner_references.append(OwnerReference(kind="DaemonSet", name="ds", uid="u"))
+        store.create(pod)
+        store.create(ds)
+        informer.flush()
+        assert cluster.get_daemonset_pod(ds).metadata.name == "ds-pod"
+
+    def test_consolidation_timestamp(self, env):
+        clock, store, cluster, informer = env
+        t0 = cluster.mark_unconsolidated()
+        assert cluster.consolidation_state() == t0
+        clock.step(301.0)
+        assert cluster.consolidation_state() > t0
+
+    def test_nomination(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        informer.flush()
+        cluster.nominate_node_for_pod("kwok://node-1")
+        assert cluster.is_node_nominated("kwok://node-1")
+        clock.step(25.0)
+        assert not cluster.is_node_nominated("kwok://node-1")
+
+
+class TestStateNodeDisruption:
+    def build(self, env, **kw):
+        clock, store, cluster, informer = env
+        store.create(make_claim())
+        store.create(make_node(**kw))
+        informer.flush()
+        return cluster.state_nodes()[0]
+
+    def test_disruptable_ok(self, env):
+        clock = env[0]
+        n = self.build(env)
+        n.validate_node_disruptable(clock.now())
+
+    def test_uninitialized_not_disruptable(self, env):
+        clock = env[0]
+        n = self.build(env, initialized=False)
+        with pytest.raises(ValueError, match="initialized"):
+            n.validate_node_disruptable(clock.now())
+
+    def test_deleting_claim_not_disruptable(self, env):
+        clock = env[0]
+        n = self.build(env)
+        n.node_claim.set_condition(CONDITION_INSTANCE_TERMINATING, "True")
+        with pytest.raises(ValueError, match="marked for deletion"):
+            n.validate_node_disruptable(clock.now())
+
+    def test_do_not_disrupt_annotation(self, env):
+        clock = env[0]
+        n = self.build(env)
+        n.node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        with pytest.raises(ValueError, match="annotation"):
+            n.validate_node_disruptable(clock.now())
+
+    def test_pods_disruptable_blocked_by_do_not_disrupt_pod(self, env):
+        clock, store, cluster, informer = env
+        n = self.build(env)
+        pod = bound_pod("p", "node-1")
+        pod.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        store.create(pod)
+        with pytest.raises(PodBlockEvictionError):
+            n.validate_pods_disruptable(store, Limits())
