@@ -202,8 +202,9 @@ class TopologySpreadConstraint:
     when_unsatisfiable: str  # "DoNotSchedule" | "ScheduleAnyway"
     label_selector: Optional[LabelSelector] = None
     min_domains: Optional[int] = None
-    node_affinity_policy: str = "Honor"  # "Honor" | "Ignore"
-    node_taints_policy: str = "Ignore"  # "Honor" | "Ignore"
+    node_affinity_policy: Optional[str] = None  # "Honor" | "Ignore"; None = Honor
+    node_taints_policy: Optional[str] = None  # "Honor" | "Ignore"; None = Ignore
+    match_label_keys: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -247,7 +248,7 @@ class PodStatus:
     nominated_node_name: str = ""
 
 
-@dataclass
+@dataclass(eq=False)
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
@@ -274,7 +275,7 @@ class NodeStatus:
     phase: str = ""
 
 
-@dataclass
+@dataclass(eq=False)
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodeSpec = field(default_factory=NodeSpec)
@@ -293,7 +294,7 @@ class DaemonSetSpec:
     template_spec: PodSpec = field(default_factory=PodSpec)
 
 
-@dataclass
+@dataclass(eq=False)
 class DaemonSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
@@ -317,7 +318,7 @@ class PodDisruptionBudgetStatus:
     expected_pods: int = 0
 
 
-@dataclass
+@dataclass(eq=False)
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
@@ -329,7 +330,7 @@ class PodDisruptionBudget:
 # -- storage (volume topology) ---------------------------------------------
 
 
-@dataclass
+@dataclass(eq=False)
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
@@ -340,7 +341,7 @@ class StorageClass:
     KIND = "StorageClass"
 
 
-@dataclass
+@dataclass(eq=False)
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     storage_class_name: Optional[str] = None
@@ -350,7 +351,7 @@ class PersistentVolumeClaim:
     KIND = "PersistentVolumeClaim"
 
 
-@dataclass
+@dataclass(eq=False)
 class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     node_affinity_required: list[NodeSelectorTerm] = field(default_factory=list)
@@ -365,7 +366,7 @@ class CSINodeDriver:
     allocatable_count: Optional[int] = None
 
 
-@dataclass
+@dataclass(eq=False)
 class CSINode:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     drivers: list[CSINodeDriver] = field(default_factory=list)
@@ -373,7 +374,7 @@ class CSINode:
     KIND = "CSINode"
 
 
-@dataclass
+@dataclass(eq=False)
 class VolumeAttachment:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     attacher: str = ""
@@ -383,7 +384,7 @@ class VolumeAttachment:
     KIND = "VolumeAttachment"
 
 
-@dataclass
+@dataclass(eq=False)
 class Namespace:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
 

@@ -63,7 +63,7 @@ class NodeClaimStatus:
     last_pod_event_time: float = 0.0
 
 
-@dataclass
+@dataclass(eq=False)
 class NodeClaim(ConditionedStatus):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodeClaimSpec = field(default_factory=NodeClaimSpec)

@@ -98,7 +98,7 @@ class NodePoolStatus:
     conditions: list = field(default_factory=list)
 
 
-@dataclass
+@dataclass(eq=False)
 class NodePool(ConditionedStatus):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodePoolSpec = field(default_factory=NodePoolSpec)

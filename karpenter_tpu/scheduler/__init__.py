@@ -1,0 +1,1 @@
+from karpenter_tpu.scheduler.scheduler import Results, Scheduler  # noqa: F401

@@ -1,0 +1,108 @@
+"""ExistingNode: scheduling simulation view of a live/in-flight node.
+
+Mirrors the reference's scheduling/existingnode.go:29-101.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Pod, Taint
+from karpenter_tpu.scheduler.topology import Topology
+from karpenter_tpu.scheduling.hostportusage import get_host_ports
+from karpenter_tpu.scheduling.requirements import (
+    Operator,
+    Requirement,
+    Requirements,
+)
+from karpenter_tpu.scheduling.taints import Taints
+from karpenter_tpu.scheduling.volumeusage import Volumes
+from karpenter_tpu.state.statenode import StateNode
+from karpenter_tpu.utils import resources as res
+from karpenter_tpu.utils.resources import ResourceList
+
+
+class ExistingNode:
+    def __init__(
+        self,
+        state_node: StateNode,
+        topology: Topology,
+        taints: Sequence[Taint],
+        daemon_resources: ResourceList,
+    ):
+        self.state_node = state_node
+        self.topology = topology
+        self.cached_taints = list(taints)
+        self.pods: list[Pod] = []
+        # Daemon resources not yet accounted on the node still need headroom
+        # (existingnode.go:41-48).
+        pending_daemons = res.non_negative(
+            res.subtract(daemon_resources, state_node.total_daemonset_requests())
+        )
+        available = state_node.available()
+        self.cached_available = available
+        self.remaining_resources = res.subtract(available, pending_daemons)
+        self.requirements = Requirements.from_labels(state_node.labels())
+        self.requirements.add(
+            Requirement(wk.LABEL_HOSTNAME, Operator.IN, [state_node.hostname()])
+        )
+        topology.register(wk.LABEL_HOSTNAME, state_node.hostname())
+
+    # pass-throughs
+    def name(self) -> str:
+        return self.state_node.name()
+
+    def provider_id(self) -> str:
+        return self.state_node.provider_id()
+
+    def initialized(self) -> bool:
+        return self.state_node.initialized()
+
+    def managed(self) -> bool:
+        return self.state_node.managed()
+
+    def labels(self) -> dict[str, str]:
+        return self.state_node.labels()
+
+    @property
+    def node_claim(self):
+        return self.state_node.node_claim
+
+    def can_add(self, pod: Pod, pod_data, volumes: Volumes) -> Requirements:
+        """Raises on infeasibility; returns updated node requirements
+        (existingnode.go:63-88)."""
+        err = Taints(self.cached_taints).tolerates_pod(pod)
+        if err is not None:
+            raise ValueError(err)
+        vol_err = self.state_node.volume_usage.exceeds_limits(volumes)
+        if vol_err is not None:
+            raise ValueError(f"checking volume usage, {vol_err}")
+        hostports = get_host_ports(pod)
+        conflict = self.state_node.hostport_usage.conflicts(pod, hostports)
+        if conflict is not None:
+            raise ValueError(f"checking host port usage, {conflict}")
+        if not res.fits(pod_data.requests, self.remaining_resources):
+            raise ValueError("exceeds node resources")
+        compat_err = self.requirements.compatible(pod_data.requirements)
+        if compat_err is not None:
+            raise ValueError(compat_err)
+        node_requirements = Requirements(*self.requirements.values())
+        node_requirements.add(*pod_data.requirements.values())
+
+        topology_requirements = self.topology.add_requirements(
+            pod, self.cached_taints, pod_data.strict_requirements, node_requirements
+        )
+        topo_err = node_requirements.compatible(topology_requirements)
+        if topo_err is not None:
+            raise ValueError(topo_err)
+        node_requirements.add(*topology_requirements.values())
+        return node_requirements
+
+    def add(self, pod: Pod, pod_data, node_requirements: Requirements, volumes: Volumes) -> None:
+        self.pods.append(pod)
+        self.remaining_resources = res.subtract(self.remaining_resources, pod_data.requests)
+        self.requirements = node_requirements
+        self.topology.record(pod, self.cached_taints, node_requirements)
+        self.state_node.hostport_usage.add(pod, get_host_ports(pod))
+        self.state_node.volume_usage.add(pod, volumes)

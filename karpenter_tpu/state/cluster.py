@@ -446,7 +446,7 @@ class Cluster:
             new_resources = dict(new_resources)
             new_resources[NODE_RESOURCE] = 1.0
         if old_name:
-            self.nodepool_resources[old_name] = res.subtract(
+            self.nodepool_resources[old_name] = res.subtract_into(
                 self.nodepool_resources.get(old_name, {}), old_resources
             )
         if new_name:

@@ -65,7 +65,13 @@ def merge(*resource_lists: Mapping[str, float]) -> ResourceList:
 
 
 def subtract(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
-    """a - b over the union of keys (reference resources.Subtract)."""
+    """a - b over a's keys ONLY (reference resources.Subtract keeps LHS keys
+    — a nodepool with no limits stays unlimited after subtracting usage)."""
+    return {k: v - b.get(k, 0.0) for k, v in a.items()}
+
+
+def subtract_into(a: Mapping[str, float], b: Mapping[str, float]) -> ResourceList:
+    """a - b over the union of keys (reference resources.SubtractFrom)."""
     out: ResourceList = dict(a)
     for k, v in b.items():
         out[k] = out.get(k, 0.0) - v

@@ -1,0 +1,136 @@
+"""Object builders for tests, mirroring the reference's pkg/test builders
+(test.Pod, test.NodePool, test.UnschedulablePod...)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Condition,
+    Container,
+    DaemonSet,
+    LabelSelector,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodSpec,
+)
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.scheduling.requirements import Operator
+from karpenter_tpu.utils.resources import parse_resource_list
+
+_counter = [0]
+
+
+def _name(prefix: str) -> str:
+    _counter[0] += 1
+    return f"{prefix}-{_counter[0]}"
+
+
+def unschedulable_pod(
+    name: Optional[str] = None,
+    requests: Optional[dict] = None,
+    labels: Optional[dict] = None,
+    node_selector: Optional[dict] = None,
+    **spec_kwargs,
+) -> Pod:
+    pod = Pod(
+        metadata=ObjectMeta(name=name or _name("pod"), labels=labels or {}),
+        spec=PodSpec(
+            node_selector=node_selector or {},
+            containers=[Container(requests=parse_resource_list(requests or {"cpu": "100m"}))],
+            **spec_kwargs,
+        ),
+    )
+    pod.status.conditions.append(
+        Condition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return pod
+
+
+def nodepool(
+    name: Optional[str] = None,
+    requirements: Sequence[dict] = (),
+    labels: Optional[dict] = None,
+    taints: Sequence = (),
+    limits: Optional[dict] = None,
+    weight: int = 0,
+) -> NodePool:
+    np = NodePool(metadata=ObjectMeta(name=name or _name("nodepool")))
+    np.spec.template.spec.requirements = list(requirements)
+    np.spec.template.labels = dict(labels or {})
+    np.spec.template.spec.taints = list(taints)
+    np.spec.weight = weight
+    if limits:
+        np.spec.limits = parse_resource_list(limits)
+    return np
+
+
+def daemonset(name: Optional[str] = None, requests: Optional[dict] = None) -> DaemonSet:
+    ds = DaemonSet(metadata=ObjectMeta(name=name or _name("daemonset")))
+    ds.spec.template_spec.containers = [
+        Container(requests=parse_resource_list(requests or {"cpu": "100m"}))
+    ]
+    return ds
+
+
+def daemonset_pod(ds: DaemonSet, node_name: str = "") -> Pod:
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=_name(f"{ds.metadata.name}-pod"),
+            namespace=ds.metadata.namespace,
+            owner_references=[
+                OwnerReference(kind="DaemonSet", name=ds.metadata.name, uid=ds.metadata.uid)
+            ],
+        ),
+        spec=PodSpec(
+            node_name=node_name,
+            containers=[Container(requests=dict(c.requests)) for c in ds.spec.template_spec.containers],
+        ),
+    )
+    return pod
+
+
+def registered_node(
+    name: Optional[str] = None,
+    pool: str = "default",
+    instance_type: str = "t-4-16",
+    zone: str = "kwok-zone-1",
+    capacity: Optional[dict] = None,
+    allocatable: Optional[dict] = None,
+    labels: Optional[dict] = None,
+    taints: Sequence = (),
+) -> Node:
+    name = name or _name("node")
+    node_labels = {
+        wk.NODEPOOL_LABEL_KEY: pool,
+        wk.LABEL_INSTANCE_TYPE: instance_type,
+        wk.LABEL_TOPOLOGY_ZONE: zone,
+        wk.NODE_REGISTERED_LABEL_KEY: "true",
+        wk.NODE_INITIALIZED_LABEL_KEY: "true",
+        wk.LABEL_HOSTNAME: name,
+    }
+    node_labels.update(labels or {})
+    cap = parse_resource_list(capacity or {"cpu": "4", "memory": "16Gi", "pods": "110"})
+    return Node(
+        metadata=ObjectMeta(name=name, labels=node_labels),
+        spec=NodeSpec(provider_id=f"kwok://{name}", taints=list(taints)),
+        status=NodeStatus(
+            capacity=cap,
+            allocatable=parse_resource_list(allocatable) if allocatable else dict(cap),
+        ),
+    )
+
+
+def bind_pod(pod: Pod, node: Node) -> Pod:
+    pod.spec.node_name = node.metadata.name
+    pod.status.conditions = [
+        c for c in pod.status.conditions if c.type != "PodScheduled"
+    ]
+    pod.status.conditions.append(Condition(type="PodScheduled", status="True"))
+    return pod

@@ -23,7 +23,9 @@ class TestQuantity:
         a = {"cpu": 1.0, "memory": 100.0}
         b = {"cpu": 0.5, "gpu": 1.0}
         assert r.merge(a, b) == {"cpu": 1.5, "memory": 100.0, "gpu": 1.0}
-        assert r.subtract(a, b) == {"cpu": 0.5, "memory": 100.0, "gpu": -1.0}
+        # subtract keeps LHS keys only (reference resources.Subtract)
+        assert r.subtract(a, b) == {"cpu": 0.5, "memory": 100.0}
+        assert r.subtract_into(a, b) == {"cpu": 0.5, "memory": 100.0, "gpu": -1.0}
 
     def test_fits(self):
         assert r.fits({"cpu": 1.0}, {"cpu": 1.0, "memory": 5.0})

@@ -1,0 +1,611 @@
+"""Scheduler.Solve behaviors, mirroring the reference's provisioning/
+scheduling suite (scheduler.go / topology.go / nodeclaim.go specs)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorTerm,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.scheduler.scheduler import Scheduler
+from karpenter_tpu.scheduler.topology import Topology
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import (
+    bind_pod,
+    daemonset,
+    daemonset_pod,
+    nodepool,
+    registered_node,
+    unschedulable_pod,
+)
+
+CATALOG = construct_instance_types()
+
+
+class Env:
+    def __init__(self, node_pools=None, state_nodes=(), daemonset_pods=(), pods=(),
+                 catalog=None, **scheduler_kwargs):
+        self.clock = FakeClock()
+        self.store = Store(clock=self.clock)
+        self.cluster = Cluster(self.clock, self.store, cloud_provider=None)
+        self.informer = StateInformer(self.store, self.cluster)
+        self.recorder = Recorder(clock=self.clock)
+        self.node_pools = node_pools if node_pools is not None else [nodepool("default")]
+        for np in self.node_pools:
+            self.store.create(np)
+        for obj in state_nodes:
+            self.store.create(obj)
+        for p in pods:
+            self.store.create(p)
+        self.informer.flush()
+        self.instance_types = {
+            np.metadata.name: list(catalog or CATALOG) for np in self.node_pools
+        }
+        self.daemonset_pods = list(daemonset_pods)
+        self.scheduler_kwargs = scheduler_kwargs
+
+    def schedule(self, pods):
+        state_nodes = self.cluster.state_nodes()
+        topology = Topology(
+            self.store, self.cluster, state_nodes, self.node_pools,
+            self.instance_types, pods,
+            preference_policy=self.scheduler_kwargs.get("preference_policy", "Respect"),
+        )
+        scheduler = Scheduler(
+            self.store, self.node_pools, self.cluster, state_nodes, topology,
+            self.instance_types, self.daemonset_pods, self.recorder, self.clock,
+            **self.scheduler_kwargs,
+        )
+        return scheduler.solve(pods)
+
+
+class TestBasicScheduling:
+    def test_single_pod_new_nodeclaim(self):
+        env = Env()
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert len(results.new_node_claims) == 1
+        assert not results.pod_errors
+        nc = results.new_node_claims[0]
+        assert len(nc.pods) == 1
+        assert nc.instance_type_options
+
+    def test_pods_pack_onto_one_claim(self):
+        env = Env()
+        pods = [unschedulable_pod(requests={"cpu": "1"}) for _ in range(4)]
+        results = env.schedule(pods)
+        assert len(results.new_node_claims) == 1
+        assert len(results.new_node_claims[0].pods) == 4
+
+    def test_huge_pod_fails(self):
+        env = Env()
+        results = env.schedule([unschedulable_pod(requests={"cpu": "10000"})])
+        assert len(results.pod_errors) == 1
+        assert "enough resources" in str(list(results.pod_errors.values())[0])
+
+    def test_node_selector_filters_instance_types(self):
+        env = Env()
+        pod = unschedulable_pod(node_selector={wk.LABEL_ARCH: "arm64"})
+        results = env.schedule([pod])
+        [nc] = results.new_node_claims
+        for it in nc.instance_type_options:
+            assert it.requirements.get(wk.LABEL_ARCH).has("arm64")
+
+    def test_incompatible_node_selector_fails(self):
+        env = Env(node_pools=[nodepool("default", requirements=[
+            {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]}
+        ])])
+        pod = unschedulable_pod(node_selector={wk.LABEL_ARCH: "arm64"})
+        results = env.schedule([pod])
+        assert len(results.pod_errors) == 1
+
+    def test_unknown_nodeselector_label_fails(self):
+        env = Env()
+        pod = unschedulable_pod(node_selector={"custom-label": "value"})
+        results = env.schedule([pod])
+        assert len(results.pod_errors) == 1
+
+    def test_nodepool_custom_label_allows(self):
+        env = Env(node_pools=[nodepool("default", labels={"custom-label": "value"})])
+        pod = unschedulable_pod(node_selector={"custom-label": "value"})
+        results = env.schedule([pod])
+        assert not results.pod_errors
+
+    def test_ffd_order_large_pods_first(self):
+        env = Env()
+        small = [unschedulable_pod(requests={"cpu": "100m"}) for _ in range(3)]
+        large = unschedulable_pod(requests={"cpu": "200"})
+        results = env.schedule(small + [large])
+        # the big pod forces a large instance type; smalls ride along
+        assert not results.pod_errors
+
+
+class TestExistingNodes:
+    def test_pod_lands_on_existing_node(self):
+        node = registered_node(pool="default")
+        env = Env(state_nodes=[node])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert len(results.new_node_claims) == 0
+        [en] = [e for e in results.existing_nodes if e.pods]
+        assert en.name() == node.metadata.name
+
+    def test_full_existing_node_overflows_to_new_claim(self):
+        node = registered_node(pool="default", capacity={"cpu": "2", "memory": "8Gi", "pods": "110"})
+        env = Env(state_nodes=[node])
+        pods = [unschedulable_pod(requests={"cpu": "1500m"}) for _ in range(2)]
+        results = env.schedule(pods)
+        assert len(results.new_node_claims) == 1
+        assert sum(len(e.pods) for e in results.existing_nodes) == 1
+
+    def test_existing_node_usage_respected(self):
+        node = registered_node(pool="default", capacity={"cpu": "4", "memory": "16Gi", "pods": "110"})
+        running = bind_pod(unschedulable_pod(requests={"cpu": "3"}), node)
+        env = Env(state_nodes=[node], pods=[running])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "2"})])
+        assert len(results.new_node_claims) == 1  # only 1 cpu left on node
+
+    def test_tainted_node_needs_toleration(self):
+        node = registered_node(pool="default", taints=[Taint(key="team", value="a")])
+        env = Env(state_nodes=[node])
+        results = env.schedule([unschedulable_pod()])
+        assert len(results.new_node_claims) == 1  # can't use the node
+        tolerant = unschedulable_pod()
+        tolerant.spec.tolerations = [Toleration(key="team", value="a")]
+        env2 = Env(state_nodes=[registered_node(pool="default", taints=[Taint(key="team", value="a")])])
+        results2 = env2.schedule([tolerant])
+        assert len(results2.new_node_claims) == 0
+
+
+class TestTaints:
+    def test_nodepool_taint_requires_toleration(self):
+        env = Env(node_pools=[nodepool("default", taints=[Taint(key="dedicated", value="gpu")])])
+        results = env.schedule([unschedulable_pod()])
+        assert len(results.pod_errors) == 1
+        pod = unschedulable_pod()
+        pod.spec.tolerations = [Toleration(key="dedicated", operator="Exists")]
+        env2 = Env(node_pools=[nodepool("default", taints=[Taint(key="dedicated", value="gpu")])])
+        results2 = env2.schedule([pod])
+        assert not results2.pod_errors
+
+    def test_prefer_no_schedule_taint_relaxes(self):
+        env = Env(node_pools=[nodepool("default", taints=[
+            Taint(key="soft", value="x", effect="PreferNoSchedule")
+        ])])
+        results = env.schedule([unschedulable_pod()])
+        assert not results.pod_errors
+
+
+class TestNodePoolSelection:
+    def test_weight_order_wins(self):
+        heavy = nodepool("heavy", weight=100, labels={"pool": "heavy"})
+        light = nodepool("light", weight=1, labels={"pool": "light"})
+        # light listed FIRST: the scheduler must sort by weight itself
+        env = Env(node_pools=[light, heavy])
+        results = env.schedule([unschedulable_pod()])
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "heavy"
+
+    def test_fallback_to_compatible_pool(self):
+        amd = nodepool("amd", weight=100, requirements=[
+            {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]}
+        ])
+        arm = nodepool("arm", weight=1, requirements=[
+            {"key": wk.LABEL_ARCH, "operator": "In", "values": ["arm64"]}
+        ])
+        env = Env(node_pools=[amd, arm])
+        pod = unschedulable_pod(node_selector={wk.LABEL_ARCH: "arm64"})
+        results = env.schedule([pod])
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "arm"
+
+    def test_limits_exclude_pool(self):
+        limited = nodepool("limited", weight=100, limits={"cpu": "1"})
+        open_pool = nodepool("open", weight=1)
+        env = Env(node_pools=[limited, open_pool])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "2"})])
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "open"
+
+    def test_limits_tracked_pessimistically_across_claims(self):
+        limited = nodepool("limited", limits={"cpu": "4"})
+        env = Env(node_pools=[limited])
+        # Each pod needs its own node (hostports conflict)
+        pods = []
+        for _ in range(3):
+            p = unschedulable_pod(requests={"cpu": "1"})
+            p.spec.containers[0].ports = [ContainerPort(container_port=80, host_port=8080)]
+            pods.append(p)
+        results = env.schedule(pods)
+        # 4-cpu budget and the smallest viable type is 1cpu, but subtractMax
+        # subtracts the LARGEST compatible capacity -> only some pods fit
+        assert len(results.pod_errors) >= 1
+
+
+class TestHostPortsAndDaemons:
+    def test_hostport_conflict_forces_two_nodes(self):
+        env = Env()
+        pods = []
+        for _ in range(2):
+            p = unschedulable_pod(requests={"cpu": "100m"})
+            p.spec.containers[0].ports = [ContainerPort(container_port=80, host_port=8080)]
+            pods.append(p)
+        results = env.schedule(pods)
+        assert len(results.new_node_claims) == 2
+
+    def test_daemon_overhead_added(self):
+        ds = daemonset(requests={"cpu": "1"})
+        ds_pod = daemonset_pod(ds)
+        env = Env(daemonset_pods=[ds_pod])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        [nc] = results.new_node_claims
+        # requests include daemon overhead: 1 (daemon) + 1 (pod) + pods
+        assert nc.requests["cpu"] == pytest.approx(2.0)
+
+    def test_incompatible_daemon_not_counted(self):
+        ds = daemonset(requests={"cpu": "1"})
+        ds_pod = daemonset_pod(ds)
+        # contradicts the nodepool's explicit arch requirement -> not counted
+        ds_pod.spec.node_selector = {wk.LABEL_ARCH: "arm64"}
+        env = Env(
+            node_pools=[nodepool("default", requirements=[
+                {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]}
+            ])],
+            daemonset_pods=[ds_pod],
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        [nc] = results.new_node_claims
+        assert nc.requests["cpu"] == pytest.approx(1.0)
+
+    def test_daemon_single_required_term_not_relaxed_away(self):
+        # A daemon whose ONLY required node-affinity term contradicts the
+        # pool must NOT be counted (its last term is not removable,
+        # reference preferences.go:70-83)
+        from karpenter_tpu.apis.core import Affinity, NodeAffinity, NodeSelectorTerm
+        ds = daemonset(requests={"cpu": "1"})
+        ds_pod = daemonset_pod(ds)
+        ds_pod.spec.affinity = Affinity(node_affinity=NodeAffinity(required=[
+            NodeSelectorTerm(match_expressions=[
+                {"key": wk.LABEL_ARCH, "operator": "In", "values": ["arm64"]}])]))
+        env = Env(
+            node_pools=[nodepool("default", requirements=[
+                {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]}
+            ])],
+            daemonset_pods=[ds_pod],
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        [nc] = results.new_node_claims
+        assert nc.requests["cpu"] == pytest.approx(1.0)
+
+    def test_spread_without_selector_is_inert(self):
+        # nil selector matches nothing (labels.Nothing()): other pods are not
+        # counted and the constraint never forces a spread
+        node = registered_node(pool="default", zone="kwok-zone-1")
+        existing = bind_pod(unschedulable_pod(labels={"app": "other"}, requests={"cpu": "100m"}), node)
+        env = Env(state_nodes=[node], pods=[existing])
+        pod = unschedulable_pod(
+            requests={"cpu": "1"},
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=None,
+                )
+            ],
+        )
+        results = env.schedule([pod])
+        assert not results.pod_errors
+
+
+class TestTopologySpread:
+    def zone_spread_pod(self, labels=None, max_skew=1):
+        return unschedulable_pod(
+            labels=labels or {"app": "web"},
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=max_skew,
+                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": "web"}),
+                )
+            ],
+        )
+
+    def test_zone_spread_across_claims(self):
+        env = Env()
+        pods = [self.zone_spread_pod() for _ in range(4)]
+        # force separate nodes via hostports
+        for p in pods:
+            p.spec.containers[0].ports = [ContainerPort(container_port=80, host_port=8080)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        zones = []
+        for nc in results.new_node_claims:
+            zone_req = nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE)
+            zones.append(tuple(zone_req.values_list()))
+        # 4 kwok zones, 4 pods with maxSkew 1 -> all distinct zones
+        assert len(set(zones)) == 4
+
+    def test_hostname_spread_forces_new_nodes(self):
+        env = Env()
+        pods = [
+            unschedulable_pod(
+                labels={"app": "web"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_HOSTNAME,
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        # maxSkew 1 on hostname: pods spread 2/1 at most -> >= 2 claims
+        assert len(results.new_node_claims) >= 2
+
+    def test_existing_pods_counted_in_spread(self):
+        node = registered_node(pool="default", zone="kwok-zone-1")
+        existing = bind_pod(unschedulable_pod(labels={"app": "web"}, requests={"cpu": "100m"}), node)
+        env = Env(state_nodes=[node], pods=[existing])
+        pod = self.zone_spread_pod()
+        results = env.schedule([pod])
+        assert not results.pod_errors
+        # zone-1 already has 1 pod; new pod must go to another zone
+        if results.new_node_claims:
+            zone_req = results.new_node_claims[0].requirements.get(wk.LABEL_TOPOLOGY_ZONE)
+            assert "kwok-zone-1" not in zone_req.values_list()
+
+    def test_schedule_anyway_relaxed(self):
+        env = Env(node_pools=[nodepool("default", requirements=[
+            {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In", "values": ["kwok-zone-1"]}
+        ])])
+        pods = [
+            unschedulable_pod(
+                labels={"app": "web"},
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        for p in pods:
+            p.spec.containers[0].ports = [ContainerPort(container_port=80, host_port=8080)]
+        results = env.schedule(pods)
+        # only one zone available; DoNotSchedule would fail, ScheduleAnyway relaxes
+        assert not results.pod_errors
+
+
+class TestPodAffinity:
+    def affinity_pod(self, labels=None, key=wk.LABEL_TOPOLOGY_ZONE, anti=False):
+        term = PodAffinityTerm(
+            topology_key=key,
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+        affinity = (
+            Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+            if anti
+            else Affinity(pod_affinity=PodAffinity(required=[term]))
+        )
+        return unschedulable_pod(labels=labels or {"app": "web"}, affinity=affinity)
+
+    def test_affinity_colocates(self):
+        env = Env()
+        pods = [self.affinity_pod() for _ in range(3)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        zones = set()
+        for nc in results.new_node_claims:
+            zones.update(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list())
+        for en in results.existing_nodes:
+            if en.pods:
+                zones.update(en.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list())
+        assert len(zones) == 1
+
+    def test_anti_affinity_separates_hostname(self):
+        env = Env()
+        pods = [self.affinity_pod(key=wk.LABEL_HOSTNAME, anti=True) for _ in range(3)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 3
+
+    def test_anti_affinity_zone_late_committal(self):
+        env = Env()
+        # Late committal (reference topology_test.go:2696-2700): the first
+        # anti-affine pod's claim could collapse to ANY zone, so within one
+        # batch only one zonal anti-affine pod schedules.
+        pods = [self.affinity_pod(anti=True) for _ in range(5)]
+        results = env.schedule(pods)
+        assert len(results.pod_errors) == 4
+        assert len(results.new_node_claims) == 1
+
+    def test_inverse_anti_affinity_blocks_new_pods(self):
+        # an existing pod with anti-affinity to app=web on the node's zone
+        node = registered_node(pool="default", zone="kwok-zone-1")
+        repeller = unschedulable_pod(
+            labels={"app": "repeller"},
+            affinity=Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required=[
+                        PodAffinityTerm(
+                            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "web"}),
+                        )
+                    ]
+                )
+            ),
+        )
+        bind_pod(repeller, node)
+        env = Env(state_nodes=[node], pods=[repeller])
+        pod = unschedulable_pod(labels={"app": "web"})
+        results = env.schedule([pod])
+        assert not results.pod_errors
+        # new pod must avoid kwok-zone-1
+        for nc in results.new_node_claims:
+            assert "kwok-zone-1" not in nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list()
+
+
+class TestPreferences:
+    def test_preferred_node_affinity_respected_then_relaxed(self):
+        env = Env()
+        pod = unschedulable_pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=[
+                                    {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                                     "values": ["nonexistent-zone"]}
+                                ]
+                            ),
+                        )
+                    ]
+                )
+            )
+        )
+        results = env.schedule([pod])
+        assert not results.pod_errors  # preference relaxed away
+
+    def test_ignore_preference_policy(self):
+        env = Env(preference_policy="Ignore")
+        pod = unschedulable_pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=[
+                                    {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                                     "values": ["nonexistent-zone"]}
+                                ]
+                            ),
+                        )
+                    ]
+                )
+            )
+        )
+        results = env.schedule([pod])
+        assert not results.pod_errors
+        # with Ignore, preference was never applied, so no relaxation needed
+        [nc] = results.new_node_claims
+        assert "nonexistent-zone" not in nc.requirements.get(
+            wk.LABEL_TOPOLOGY_ZONE
+        ).values_list()
+
+    def test_required_affinity_multiple_or_terms(self):
+        env = Env()
+        pod = unschedulable_pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    required=[
+                        NodeSelectorTerm(match_expressions=[
+                            {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                             "values": ["nonexistent"]}
+                        ]),
+                        NodeSelectorTerm(match_expressions=[
+                            {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                             "values": ["kwok-zone-2"]}
+                        ]),
+                    ]
+                )
+            )
+        )
+        results = env.schedule([pod])
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list() == ["kwok-zone-2"]
+
+
+class TestResults:
+    def test_truncate_instance_types(self):
+        env = Env()
+        results = env.schedule([unschedulable_pod()])
+        [nc] = results.new_node_claims
+        assert len(nc.instance_type_options) > 60
+        results.truncate_instance_types(60)
+        assert len(results.new_node_claims[0].instance_type_options) == 60
+        # cheapest kept
+        prices = [
+            min(o.price for o in it.offerings if o.available)
+            for it in results.new_node_claims[0].instance_type_options
+        ]
+        assert prices == sorted(prices)
+
+    def test_nodepool_to_pod_mapping(self):
+        env = Env()
+        pods = [unschedulable_pod() for _ in range(2)]
+        results = env.schedule(pods)
+        mapping = results.nodepool_to_pod_mapping()
+        assert sum(len(v) for v in mapping.values()) == 2
+
+
+class TestEngineParity:
+    """The batched device path must produce byte-identical decisions to the
+    host oracle (BASELINE.json decision-parity requirement)."""
+
+    def _decisions(self, results):
+        out = []
+        for nc in sorted(results.new_node_claims, key=lambda n: n.hostname):
+            out.append((
+                nc.nodepool_name,
+                sorted(it.name for it in nc.instance_type_options),
+                sorted(p.metadata.name for p in nc.pods),
+            ))
+        errors = sorted(p.metadata.name for p in results.pod_errors)
+        return out, errors
+
+    def test_identical_decisions_with_engine(self):
+        from karpenter_tpu.ops.catalog import CatalogEngine
+        import karpenter_tpu.scheduler.nodeclaim as snc
+
+        pods_spec = []
+        for i in range(12):
+            kwargs = {"requests": {"cpu": f"{(i % 4) + 1}"}}
+            if i % 3 == 0:
+                kwargs["node_selector"] = {wk.LABEL_ARCH: "arm64"}
+            if i % 5 == 0:
+                kwargs["node_selector"] = {wk.LABEL_OS: "linux",
+                                           wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"}
+            pods_spec.append(kwargs)
+
+        def build_pods():
+            return [unschedulable_pod(name=f"p-{i}", **kw) for i, kw in enumerate(pods_spec)]
+
+        host_results = Env().schedule(build_pods())
+        engine = CatalogEngine(CATALOG)
+        old_min = snc.ENGINE_MIN_CATALOG
+        snc.ENGINE_MIN_CATALOG = 1  # force engine path
+        try:
+            engine_results = Env(engine=engine).schedule(build_pods())
+        finally:
+            snc.ENGINE_MIN_CATALOG = old_min
+        assert self._decisions(host_results) == self._decisions(engine_results)
