@@ -1,0 +1,1 @@
+from karpenter_tpu.controllers.provisioning.provisioner import Provisioner  # noqa: F401

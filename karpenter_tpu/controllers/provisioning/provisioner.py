@@ -1,0 +1,318 @@
+"""Provisioner: the singleton loop turning pending pods into NodeClaims.
+
+Mirrors the reference's provisioning/provisioner.go:100-515 — batch pending
+pods, gate on cluster sync, build a scheduler over ready nodepools, solve,
+truncate, create claims with a limits re-check.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Affinity, NodeAffinity, ObjectMeta, Pod, new_uid
+from karpenter_tpu.apis.nodeclaim import NodeClaim as APINodeClaim
+from karpenter_tpu.controllers.provisioning.batcher import Batcher
+from karpenter_tpu.events.recorder import Event, Recorder
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.scheduler.nodeclaim import NodeClaim as SchedNodeClaim
+from karpenter_tpu.scheduler.scheduler import Results, Scheduler
+from karpenter_tpu.scheduler.topology import Topology
+from karpenter_tpu.scheduler.volumetopology import VolumeTopology
+from karpenter_tpu.scheduling.requirements import Operator, pod_requirements
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.statenode import StateNode, active, deleting
+from karpenter_tpu.utils import nodepool as nodepoolutil
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.pdb import Limits
+
+PROVISIONED_REASON = "provisioned"
+
+_NODECLAIMS_CREATED = global_registry.counter(
+    "karpenter_nodeclaims_created_total",
+    "nodeclaims created",
+    labels=["reason", "nodepool", "min_values_relaxed"],
+)
+_IGNORED_PODS = global_registry.gauge(
+    "karpenter_scheduler_ignored_pod_count", "pods ignored by validation"
+)
+
+SOLVE_TIMEOUT = 60.0  # provisioner.go:343-345
+
+
+class NoNodePoolsError(Exception):
+    pass
+
+
+class Provisioner:
+    def __init__(
+        self,
+        store: Store,
+        cloud_provider: CloudProvider,
+        cluster: Cluster,
+        recorder: Recorder,
+        clock: Clock,
+        options: Optional[Options] = None,
+        engine_factory=None,
+    ):
+        self.store = store
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.recorder = recorder
+        self.clock = clock
+        self.options = options or Options()
+        self.batcher: Batcher[str] = Batcher(
+            clock,
+            idle_duration=self.options.batch_idle_duration,
+            max_duration=self.options.batch_max_duration,
+        )
+        self.volume_topology = VolumeTopology(store)
+        # Optional CatalogEngine factory for the device-backed filter path
+        self.engine_factory = engine_factory
+
+    def trigger(self, uid: str) -> None:
+        self.batcher.trigger(uid)
+
+    # -- reconcile loop (provisioner.go:116-142) ----------------------------
+
+    def reconcile(self) -> Optional[Results]:
+        if not self.batcher.consume():
+            return None
+        if not self.cluster.synced():
+            return None
+        results = self.schedule()
+        if results is None or not results.new_node_claims:
+            return results
+        self.create_node_claims(
+            results.new_node_claims, reason=PROVISIONED_REASON, record_pod_nomination=True
+        )
+        return results
+
+    # -- scheduling ---------------------------------------------------------
+
+    def get_pending_pods(self) -> list[Pod]:
+        """Provisionable pods passing validation (provisioner.go:161-183)."""
+        pods = self.store.list("Pod", predicate=podutil.is_provisionable)
+        accepted = []
+        rejected = 0
+        for pod in pods:
+            err = self.validate(pod)
+            if err is not None:
+                self.cluster.mark_pod_scheduling_decisions(
+                    {pod: ValueError(f"ignoring pod, {err}")}, {}, {}
+                )
+                rejected += 1
+                continue
+            accepted.append(pod)
+        _IGNORED_PODS.set(float(rejected))
+        return accepted
+
+    def validate(self, pod: Pod) -> Optional[str]:
+        """provisioner.go:482-515."""
+        for req in pod_requirements(pod):
+            if req.key == wk.NODEPOOL_LABEL_KEY and req.operator == Operator.DOES_NOT_EXIST:
+                return "configured to not run on a Karpenter provisioned node"
+        err = _validate_requirement_terms(pod)
+        if err is not None:
+            return err
+        return self.volume_topology.validate_persistent_volume_claims(pod)
+
+    def get_daemonset_pods(self) -> list[Pod]:
+        """Template pods for daemon overhead (provisioner.go:399-420),
+        preferring a live pod cached in cluster state."""
+        out = []
+        for ds in self.store.list("DaemonSet"):
+            pod = self.cluster.get_daemonset_pod(ds)
+            if pod is None:
+                pod = Pod(
+                    metadata=ObjectMeta(
+                        name=f"{ds.metadata.name}-template",
+                        namespace=ds.metadata.namespace,
+                    ),
+                    spec=copy.deepcopy(ds.spec.template_spec),
+                )
+            else:
+                pod = copy.deepcopy(pod)
+            template_aff = ds.spec.template_spec.affinity
+            if template_aff is not None and template_aff.node_affinity is not None and template_aff.node_affinity.required:
+                if pod.spec.affinity is None:
+                    pod.spec.affinity = Affinity()
+                if pod.spec.affinity.node_affinity is None:
+                    pod.spec.affinity.node_affinity = NodeAffinity()
+                pod.spec.affinity.node_affinity.required = copy.deepcopy(
+                    template_aff.node_affinity.required
+                )
+            out.append(pod)
+        return out
+
+    def new_scheduler(
+        self,
+        pods: list[Pod],
+        state_nodes: Sequence[StateNode],
+        reserved_offering_mode: str = "Strict",
+        ready_only: bool = True,
+    ) -> Scheduler:
+        """provisioner.go:220-279."""
+        node_pools = nodepoolutil.order_by_weight(
+            nodepoolutil.list_managed(self.store, ready_only=ready_only)
+        )
+        if not node_pools:
+            raise NoNodePoolsError("no nodepools found")
+        instance_types = {}
+        for np in node_pools:
+            its = self.cloud_provider.get_instance_types(np)
+            if its:
+                instance_types[np.metadata.name] = its
+        for pod in pods:
+            self.volume_topology.inject(pod)
+        topology = Topology(
+            self.store,
+            self.cluster,
+            state_nodes,
+            node_pools,
+            instance_types,
+            pods,
+            preference_policy=self.options.preferences_policy,
+        )
+        engine = self.engine_factory(instance_types) if self.engine_factory else None
+        return Scheduler(
+            self.store,
+            node_pools,
+            self.cluster,
+            state_nodes,
+            topology,
+            instance_types,
+            self.get_daemonset_pods(),
+            self.recorder,
+            self.clock,
+            preference_policy=self.options.preferences_policy,
+            min_values_policy=self.options.min_values_policy,
+            reserved_offering_mode=reserved_offering_mode,
+            reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
+            engine=engine,
+        )
+
+    def schedule(self) -> Optional[Results]:
+        """provisioner.go:281-383."""
+        nodes = self.cluster.state_nodes()
+        pending = self.get_pending_pods()
+        pdbs = Limits.from_pdbs(self.store.list("PodDisruptionBudget"))
+        deleting_node_pods = [
+            p
+            for n in deleting(nodes)
+            for p in n.currently_reschedulable_pods(self.store, pdbs)
+        ]
+        pods = pending + deleting_node_pods
+        if not pods:
+            return None
+        try:
+            scheduler = self.new_scheduler(pods, active(nodes))
+        except NoNodePoolsError:
+            self.cluster.mark_pod_scheduling_decisions(
+                {p: NoNodePoolsError("no nodepools found") for p in pods}, {}, {}
+            )
+            return None
+        results = scheduler.solve(pods, timeout=SOLVE_TIMEOUT)
+        results.truncate_instance_types()
+        self.cluster.mark_pod_scheduling_decisions(
+            results.pod_errors,
+            results.nodepool_to_pod_mapping(),
+            results.existing_node_to_pod_mapping(),
+        )
+        results.record(self.recorder, self.cluster)
+        return results
+
+    # -- claim creation (provisioner.go:146-158, 385-438) -------------------
+
+    def create_node_claims(
+        self,
+        node_claims: Sequence[SchedNodeClaim],
+        reason: str = PROVISIONED_REASON,
+        record_pod_nomination: bool = False,
+    ) -> list[str]:
+        names = []
+        errs = []
+        for nc in node_claims:
+            try:
+                names.append(self.create(nc, reason, record_pod_nomination))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        if errs:
+            raise RuntimeError("; ".join(str(e) for e in errs))
+        return names
+
+    def create(
+        self,
+        n: SchedNodeClaim,
+        reason: str = PROVISIONED_REASON,
+        record_pod_nomination: bool = False,
+    ) -> str:
+        latest = self.store.try_get("NodePool", n.nodepool_name)
+        if latest is None:
+            raise ValueError(f"nodepool {n.nodepool_name} not found")
+        # Limits re-check at create: state may have moved since the solve
+        # (provisioner.go:396-399).
+        err = nodepoolutil.limits_exceeded_by(
+            latest.spec.limits, self.cluster.nodepool_resources_for(n.nodepool_name)
+        )
+        if err is not None:
+            raise ValueError(err)
+        claim = n.to_api_nodeclaim()
+        claim.metadata.name = f"{n.nodepool_name}-{new_uid()[:8]}"
+        self.store.create(claim)
+        self.cluster.pod_to_node_claim.update(
+            {
+                (p.metadata.namespace, p.metadata.name): claim.metadata.name
+                for p in n.pods
+            }
+        )
+        _NODECLAIMS_CREATED.inc(
+            {
+                "reason": reason,
+                "nodepool": claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, ""),
+                "min_values_relaxed": claim.metadata.annotations.get(
+                    wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY, "false"
+                ),
+            }
+        )
+        self.cluster.update_node_claim(claim)
+        if record_pod_nomination:
+            for pod in n.pods:
+                self.recorder.publish(
+                    Event(
+                        pod,
+                        "Normal",
+                        "Nominated",
+                        f"Pod should schedule on nodeclaim {claim.metadata.name}",
+                    )
+                )
+        return claim.metadata.name
+
+
+def _validate_requirement_terms(pod: Pod) -> Optional[str]:
+    """Restricted-label validation of nodeSelector + required affinity terms
+    (provisioner.go:441-480)."""
+    exprs = [
+        {"key": k, "operator": "In", "values": [v]}
+        for k, v in pod.spec.node_selector.items()
+    ]
+    aff = pod.spec.affinity
+    if aff is not None and aff.node_affinity is not None:
+        for term in aff.node_affinity.required:
+            exprs.extend(term.match_expressions)
+        for pref in aff.node_affinity.preferred:
+            exprs.extend(pref.preference.match_expressions)
+    for expr in exprs:
+        err = wk.is_restricted_label(expr["key"])
+        if err is not None:
+            return err
+        try:
+            Operator(expr["operator"])
+        except ValueError:
+            return f"unknown operator {expr['operator']}"
+    return None

@@ -139,16 +139,34 @@ def fits_matrix(requests: jnp.ndarray, allocatable: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(requests[:, None, :] <= allocatable[None, :, :], axis=-1)
 
 
-def quantize_resources(values: np.ndarray, ceil: bool) -> np.ndarray:
-    """float64 [., D] resources → int64 milli-units, rounded conservatively.
+_BYTE_SCALE_PREFIXES = ("memory", "ephemeral-storage", "hugepages-")
 
-    Requests round up, capacities round down, so an integer comparison can
-    only be stricter than the host float64 oracle, never looser. Milli-units
-    keep cpu ("100m") exact; memory bytes are already integral.
-    """
-    scaled = values * 1000.0
-    out = np.ceil(scaled - 1e-6) if ceil else np.floor(scaled + 1e-6)
-    return out.astype(np.int64)
+
+def resource_scales(dims: dict[str, int]) -> np.ndarray:
+    """Per-dimension quantization multipliers keeping values in int32 range:
+    byte-denominated resources quantize to MiB, everything else to
+    milli-units (cpu "100m" stays exact; 2 PiB memory still fits int32)."""
+    scales = np.full(len(dims), 1000.0)
+    for name, i in dims.items():
+        if name.startswith(_BYTE_SCALE_PREFIXES):
+            scales[i] = 1.0 / float(2**20)
+    return scales
+
+
+def quantize_resources(
+    values: np.ndarray, ceil: bool, scales: np.ndarray | float = 1000.0
+) -> np.ndarray:
+    """float64 [., D] resources → int32-safe integer units, rounded
+    conservatively: requests round up, capacities round down, so the integer
+    comparison can only be stricter than the float64 host oracle, never
+    looser. Saturation is asymmetric for the same reason — an oversized
+    request clips ABOVE any clipped capacity, so it can never falsely fit."""
+    scaled = values * scales
+    if ceil:
+        out = np.ceil(scaled - 1e-6)
+        return np.clip(out, -(2**31) + 1, 2**31 - 1).astype(np.int64)
+    out = np.floor(scaled + 1e-6)
+    return np.clip(out, -(2**31) + 1, 2**30).astype(np.int64)
 
 
 @jax.jit

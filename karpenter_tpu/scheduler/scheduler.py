@@ -188,9 +188,9 @@ class Scheduler:
 
         # Weighted order decides which pool hosts a pod when several can
         # (reference sorts via nodepoolutils.OrderByWeight, provisioner.go:244).
-        node_pools = sorted(
-            node_pools, key=lambda np: (-np.spec.weight, np.metadata.name)
-        )
+        from karpenter_tpu.utils.nodepool import order_by_weight
+
+        node_pools = order_by_weight(node_pools)
         tolerate_prefer_no_schedule = any(
             t.effect == "PreferNoSchedule"
             for np in node_pools
@@ -371,12 +371,10 @@ class Scheduler:
     def _add_to_existing_node(self, pod: Pod) -> None:
         volumes = get_volumes(self.store, pod)
         pod_data = self.cached_pod_data[pod.metadata.uid]
-        errs = []
         for node in self.existing_nodes:
             try:
                 requirements = node.can_add(pod, pod_data, volumes)
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
+            except Exception:  # noqa: BLE001 — per-node misses are expected
                 continue
             node.add(pod, pod_data, requirements, volumes)
             return

@@ -653,7 +653,9 @@ class Topology:
                 domains = requirements.get(tg.key)
                 if tg.type == TYPE_ANTI_AFFINITY:
                     tg.record(*domains.values_list())
-                elif len(domains.values_list()) == 1:
+                # cardinality 1 — complement sets (NotIn) are infinite and
+                # must NOT record their excluded value (Len(), not Values())
+                elif len(domains) == 1:
                     tg.record(domains.values_list()[0])
         for tg in self.inverse_topology_groups.values():
             if tg.is_owned_by(p.metadata.uid):

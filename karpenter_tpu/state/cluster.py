@@ -97,7 +97,9 @@ class Cluster:
     # -- reads --------------------------------------------------------------
 
     def state_nodes(self) -> list[StateNode]:
-        return list(self.nodes.values())
+        """Deep copies: callers (solvers) mutate usage on them
+        (cluster.go:203-209)."""
+        return [n.deep_copy() for n in self.nodes.values()]
 
     def node_for_pod(self, pod: Pod) -> Optional[StateNode]:
         name = self.bindings.get((pod.metadata.namespace, pod.metadata.name))

@@ -233,6 +233,25 @@ class StateNode:
         self.pod_requests.pop((namespace, name), None)
         self.daemonset_requests.pop((namespace, name), None)
 
+    def deep_copy(self) -> "StateNode":
+        """Copy with independent usage tracking, for scheduling simulations
+        (reference Cluster.Nodes() deep-copies, cluster.go:203-209): the
+        solver mutates hostports/volumes/requests on its copy, never the
+        live mirror. The Node/NodeClaim objects stay shared — simulations
+        only read them."""
+        import copy as _copy
+
+        out = StateNode.__new__(StateNode)
+        out.node = self.node
+        out.node_claim = self.node_claim
+        out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}
+        out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
+        out.hostport_usage = _copy.deepcopy(self.hostport_usage)
+        out.volume_usage = _copy.deepcopy(self.volume_usage)
+        out.marked_for_deletion = self.marked_for_deletion
+        out.nominated_until = self.nominated_until
+        return out
+
     def shallow_copy(self) -> "StateNode":
         out = StateNode.__new__(StateNode)
         out.node = self.node

@@ -1,13 +1,19 @@
 """Test bootstrap: force an 8-device virtual CPU mesh so all sharding code
 paths (shard_map/pjit over the pod axis) are exercised without TPU hardware.
-Must run before jax is imported anywhere."""
+Must run before jax is used anywhere; the axon sitecustomize may have
+force-registered a TPU backend via jax.config.update, so we override the
+config (not just the env) too."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

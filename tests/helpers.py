@@ -68,6 +68,7 @@ def nodepool(
     np.spec.weight = weight
     if limits:
         np.spec.limits = parse_resource_list(limits)
+    np.set_condition("Ready", "True")
     return np
 
 

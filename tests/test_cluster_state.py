@@ -244,3 +244,24 @@ class TestStateNodeDisruption:
         store.create(pod)
         with pytest.raises(PodBlockEvictionError):
             n.validate_pods_disruptable(store, Limits())
+
+
+class TestSimulationIsolation:
+    def test_state_nodes_are_copies(self, env):
+        """Solver mutations on state_nodes() must not leak into the live
+        mirror (regression: simulation corrupted hostport/volume usage)."""
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        informer.flush()
+        [copy_node] = cluster.state_nodes()
+        copy_node.pod_requests[("default", "phantom")] = {"cpu": 1.0}
+        from karpenter_tpu.scheduling.hostportusage import HostPort
+        copy_node.hostport_usage.add(
+            bound_pod("phantom", "node-1"), [HostPort("0.0.0.0", 8080, "TCP")]
+        )
+        [live] = cluster.state_nodes()
+        assert ("default", "phantom") not in live.pod_requests
+        p2 = bound_pod("p2", "node-1")
+        assert live.hostport_usage.conflicts(
+            p2, [HostPort("0.0.0.0", 8080, "TCP")]
+        ) is None

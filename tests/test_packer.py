@@ -1,0 +1,105 @@
+"""Device group solver: host-oracle parity + sharded-vs-single parity."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.ops.packer import GroupSolver, encode_pods_for_packer
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+
+
+@pytest.fixture(scope="module")
+def setup():
+    catalog = construct_instance_types()
+    engine = CatalogEngine(catalog)
+    rng = np.random.RandomState(3)
+    shapes = []
+    zones = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+    for i in range(20):
+        reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        if i % 2:
+            reqs.add(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+        if i % 3 == 0:
+            reqs.add(Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [zones[i % 4]]))
+        shapes.append(reqs)
+    picks = rng.randint(len(shapes), size=500)
+    reqs_list = [shapes[i] for i in picks]
+    requests = np.zeros((500, len(engine.resource_dims)), dtype=np.float64)
+    cpu_d = engine.resource_dims[wk.RESOURCE_CPU]
+    mem_d = engine.resource_dims[wk.RESOURCE_MEMORY]
+    pods_d = engine.resource_dims[wk.RESOURCE_PODS]
+    requests[:, cpu_d] = rng.choice([0.1, 0.5, 1.0, 2.0], size=500)
+    requests[:, mem_d] = rng.choice([128, 512, 1024], size=500) * 2**20
+    requests[:, pods_d] = 1.0
+    return catalog, engine, reqs_list, requests
+
+
+class TestGroupSolver:
+    def test_choice_matches_host_oracle(self, setup):
+        catalog, engine, reqs_list, requests = setup
+        grouped = encode_pods_for_packer(engine, reqs_list, requests)
+        solver = GroupSolver(engine)
+        choice, feasible, nodes, unsched = solver.solve(grouped)
+        assert feasible.all() and unsched.sum() == 0
+        # verify each group's chosen type against the host algebra: it must
+        # be feasible and cheapest among feasible
+        from karpenter_tpu.scheduler.nodeclaim import _triples_host
+
+        for g in range(min(10, grouped.membership.shape[0])):
+            pod_idx = int(np.where(grouped.group_of_pod == g)[0][0])
+            reqs = reqs_list[pod_idx]
+            rl = {
+                name: requests[pod_idx][d]
+                for name, d in engine.resource_dims.items()
+                if requests[pod_idx][d] > 0
+            }
+            triples = _triples_host(catalog, reqs, rl)
+            feasible_idx = [i for i, t in enumerate(triples) if all(t)]
+            assert int(choice[g]) in feasible_idx
+            best_price = min(solver.price[i] for i in feasible_idx)
+            assert solver.price[int(choice[g])] == pytest.approx(best_price)
+
+    def test_sharded_matches_single_device(self, setup):
+        import jax
+        from jax.sharding import Mesh
+
+        catalog, engine, reqs_list, requests = setup
+        grouped = encode_pods_for_packer(engine, reqs_list, requests)
+        solver = GroupSolver(engine)
+        single = solver.solve(grouped)
+        mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("pods",))
+        sharded = solver.solve_sharded(grouped, mesh)
+        for a, b in zip(single, sharded):
+            np.testing.assert_array_equal(a, b)
+
+    def test_node_count_packing_math(self, setup):
+        catalog, engine, reqs_list, requests = setup
+        # one group: 10 pods of 2 cpu onto nodes; cheapest feasible type is
+        # 1-cpu-smallest that fits 2 cpu => type cpu>=2; pods-per-node math
+        reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        req_vec = np.zeros((10, len(engine.resource_dims)))
+        req_vec[:, engine.resource_dims[wk.RESOURCE_CPU]] = 2.0
+        req_vec[:, engine.resource_dims[wk.RESOURCE_PODS]] = 1.0
+        grouped = encode_pods_for_packer(engine, [reqs] * 10, req_vec)
+        solver = GroupSolver(engine)
+        choice, feasible, nodes, unsched = solver.solve(grouped)
+        assert grouped.membership.shape[0] == 1
+        it = engine.instance_types[int(choice[0])]
+        cpu = it.allocatable()[wk.RESOURCE_CPU]
+        pods_per_node = int(cpu // 2.0)
+        assert int(nodes[0]) == -(-10 // pods_per_node)
+
+    def test_infeasible_group_reports_unschedulable(self, setup):
+        catalog, engine, reqs_list, requests = setup
+        reqs = Requirements(
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["nonexistent-zone"])
+        )
+        req_vec = np.zeros((3, len(engine.resource_dims)))
+        req_vec[:, engine.resource_dims[wk.RESOURCE_CPU]] = 1.0
+        grouped = encode_pods_for_packer(engine, [reqs] * 3, req_vec)
+        solver = GroupSolver(engine)
+        choice, feasible, nodes, unsched = solver.solve(grouped)
+        assert not feasible.any()
+        assert unsched.sum() == 3
