@@ -45,7 +45,10 @@ class FakeCloudProvider(CloudProvider):
         if self.next_create_err is not None:
             err, self.next_create_err = self.next_create_err, None
             raise err
+        from karpenter_tpu.utils import resources as res
+
         reqs = requirements_from_dicts(node_claim.spec.requirements)
+        requests = node_claim.spec.resources.requests
         compatible = [
             it
             for it in self.get_instance_types_by_name(
@@ -53,6 +56,7 @@ class FakeCloudProvider(CloudProvider):
             )
             if it.requirements.intersects(reqs) is None
             and it.offerings.available().has_compatible(reqs)
+            and res.fits(requests, it.allocatable())
         ]
         if not compatible:
             from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
@@ -70,6 +74,9 @@ class FakeCloudProvider(CloudProvider):
         created.status.provider_id = f"fake://{node_claim.metadata.name}-{self._counter}"
         created.status.capacity = dict(it.capacity)
         created.status.allocatable = dict(it.allocatable())
+        # requirement-derived labels first; the chosen offering's zone and
+        # capacity type must win (the node IS where it launched)
+        created.metadata.labels.update(reqs.labels())
         created.metadata.labels.update(
             {
                 wk.LABEL_INSTANCE_TYPE: it.name,
@@ -77,7 +84,6 @@ class FakeCloudProvider(CloudProvider):
                 wk.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type,
             }
         )
-        created.metadata.labels.update(reqs.labels())
         created.status.image_id = "fake-image"
         self.created[created.status.provider_id] = created
         return created

@@ -1,0 +1,9 @@
+from karpenter_tpu.controllers.disruption.controller import Controller  # noqa: F401
+from karpenter_tpu.controllers.disruption.queue import Queue  # noqa: F401
+from karpenter_tpu.controllers.disruption.types import (  # noqa: F401
+    Candidate,
+    Command,
+    DECISION_DELETE,
+    DECISION_NOOP,
+    DECISION_REPLACE,
+)

@@ -1,0 +1,151 @@
+"""Disruption controller: 10s polling loop running the methods in order;
+first success wins.
+
+Mirrors the reference's disruption/controller.go:55-250.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from karpenter_tpu.apis.nodeclaim import CONDITION_DISRUPTION_REASON
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.controllers.disruption.consolidation import Consolidation
+from karpenter_tpu.controllers.disruption.helpers import (
+    build_disruption_budget_mapping,
+    get_candidates,
+)
+from karpenter_tpu.controllers.disruption.methods import (
+    Drift,
+    Emptiness,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.queue import Queue
+from karpenter_tpu.controllers.disruption.types import DECISION_NOOP
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.metrics import global_registry, measure
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.statenode import (
+    clear_node_claims_condition,
+    require_no_schedule_taint,
+)
+from karpenter_tpu.utils.clock import Clock
+
+POLLING_PERIOD = 10.0  # controller.go:66
+
+_ELIGIBLE_NODES = global_registry.gauge(
+    "karpenter_voluntary_disruption_eligible_nodes",
+    "nodes eligible for disruption per reason",
+    labels=["reason"],
+)
+_EVAL_DURATION = global_registry.histogram(
+    "karpenter_voluntary_disruption_decision_evaluation_duration_seconds",
+    "disruption method evaluation duration",
+    labels=["reason", "consolidation_type"],
+)
+
+
+def new_methods(clock, cluster, store, provisioner, cloud_provider, recorder, queue):
+    """controller.go:94-103: Emptiness → Drift → MultiNode → SingleNode.
+
+    Each method gets its OWN Consolidation (the reference embeds the struct
+    by value, so lastConsolidationState is per-method — one method's no-op
+    must not short-circuit the others)."""
+
+    def c():
+        return Consolidation(
+            clock, cluster, store, provisioner, cloud_provider, recorder, queue
+        )
+
+    return [
+        Emptiness(c()),
+        Drift(store, cluster, provisioner, recorder),
+        MultiNodeConsolidation(c()),
+        SingleNodeConsolidation(c()),
+    ]
+
+
+class Controller:
+    def __init__(
+        self,
+        clock: Clock,
+        store: Store,
+        provisioner,
+        cloud_provider: CloudProvider,
+        recorder: Recorder,
+        cluster: Cluster,
+        queue: Queue,
+        methods: Optional[Sequence] = None,
+    ):
+        self.clock = clock
+        self.store = store
+        self.provisioner = provisioner
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder
+        self.cluster = cluster
+        self.queue = queue
+        self.methods = (
+            list(methods)
+            if methods is not None
+            else new_methods(
+                clock, cluster, store, provisioner, cloud_provider, recorder, queue
+            )
+        )
+        self._next_run = 0.0
+
+    def reconcile(self) -> bool:
+        """One pass; returns True if a command was started (requeue fast)."""
+        if self.clock.now() < self._next_run:
+            return False
+        if not self.cluster.synced():
+            return False
+        # Clean leftover disruption taints/conditions from restarts or
+        # abandoned commands (controller.go:131-152).
+        outdated = [
+            n
+            for n in self.cluster.state_nodes()
+            if not self.queue.has_any(n.provider_id()) and not n.is_marked_for_deletion()
+        ]
+        require_no_schedule_taint(self.store, False, *outdated)
+        clear_node_claims_condition(self.store, CONDITION_DISRUPTION_REASON, *outdated)
+
+        for method in self.methods:
+            if self._disrupt(method):
+                return True
+        self._next_run = self.clock.now() + POLLING_PERIOD
+        return False
+
+    def _disrupt(self, method) -> bool:
+        """controller.go:169-206."""
+        labels = {
+            "reason": method.reason().lower(),
+            "consolidation_type": method.consolidation_type(),
+        }
+        with measure(_EVAL_DURATION, labels):
+            candidates = get_candidates(
+                self.store,
+                self.cluster,
+                self.recorder,
+                self.clock,
+                self.cloud_provider,
+                method.should_disrupt,
+                method.disruption_class(),
+                self.queue,
+            )
+            _ELIGIBLE_NODES.set(
+                float(len(candidates)), {"reason": method.reason().lower()}
+            )
+            if not candidates:
+                return False
+            budgets = build_disruption_budget_mapping(
+                self.store, self.cluster, self.clock, self.recorder, method.reason()
+            )
+            cmd = method.compute_command(budgets, *candidates)
+            if cmd.decision() == DECISION_NOOP:
+                return False
+            cmd.creation_timestamp = self.clock.now()
+            cmd.method = method
+            self.queue.start_command(cmd)
+            return True

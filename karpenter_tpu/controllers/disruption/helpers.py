@@ -1,0 +1,185 @@
+"""Disruption helpers: SimulateScheduling, candidate discovery, budgets.
+
+Mirrors the reference's disruption/helpers.go:50-281.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Optional
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType
+from karpenter_tpu.controllers.disruption.types import Candidate, new_candidate
+from karpenter_tpu.events.recorder import Event, Recorder
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.scheduler.scheduler import Results
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.statenode import StateNode, active, deleting
+from karpenter_tpu.utils import nodepool as nodepoolutil
+from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.utils.pdb import Limits
+
+if TYPE_CHECKING:
+    from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
+
+_ALLOWED_DISRUPTIONS = global_registry.gauge(
+    "karpenter_nodepools_allowed_disruptions",
+    "allowed disruptions per nodepool/reason",
+    labels=["nodepool", "reason"],
+)
+
+
+class CandidateDeletingError(Exception):
+    """A candidate started deleting mid-simulation (helpers.go:47)."""
+
+
+class UninitializedNodeError(Exception):
+    """Simulation placed a pod on an uninitialized node (helpers.go:143-160)."""
+
+
+def simulate_scheduling(
+    store: Store,
+    cluster: Cluster,
+    provisioner: "Provisioner",
+    *candidates: Candidate,
+) -> Results:
+    """Re-run the provisioning solver with the candidates' nodes removed and
+    their reschedulable pods pending (helpers.go:50-141)."""
+    candidate_names = {c.name() for c in candidates}
+    nodes = cluster.state_nodes()
+    deleting_nodes = deleting(nodes)
+    state_nodes = [n for n in active(nodes) if n.name() not in candidate_names]
+    if any(n.name() in candidate_names for n in deleting_nodes):
+        raise CandidateDeletingError()
+
+    pods = provisioner.get_pending_pods()
+    pdbs = Limits.from_pdbs(store.list("PodDisruptionBudget"))
+    for c in candidates:
+        pods.extend(
+            p for p in c.reschedulable_pods if pdbs.is_currently_reschedulable(p)
+        )
+    deleting_node_pods = [
+        p
+        for n in deleting_nodes
+        for p in n.currently_reschedulable_pods(store, pdbs)
+    ]
+    pods.extend(deleting_node_pods)
+    deleting_pod_keys = {
+        (p.metadata.namespace, p.metadata.name) for p in deleting_node_pods
+    }
+
+    scheduler = provisioner.new_scheduler(pods, state_nodes)
+    results = scheduler.solve(pods, timeout=60.0)
+    results.truncate_instance_types()
+    # Pods landing on uninitialized nodes are speculative — fail them so
+    # consolidation doesn't rely on capacity that may never materialize.
+    for en in results.existing_nodes:
+        if not en.initialized():
+            for p in en.pods:
+                if (p.metadata.namespace, p.metadata.name) not in deleting_pod_keys:
+                    results.pod_errors[p] = UninitializedNodeError(
+                        f"would schedule against uninitialized node {en.name()}"
+                    )
+    return results
+
+
+def instance_types_are_subset(
+    lhs: list[InstanceType], rhs: list[InstanceType]
+) -> bool:
+    rhs_names = {it.name for it in rhs}
+    return all(it.name in rhs_names for it in lhs)
+
+
+def build_nodepool_map(
+    store: Store, cloud_provider: CloudProvider
+) -> tuple[dict[str, NodePool], dict[str, dict[str, InstanceType]]]:
+    """helpers.go:191-222."""
+    nodepool_map: dict[str, NodePool] = {}
+    nodepool_its: dict[str, dict[str, InstanceType]] = {}
+    for np in nodepoolutil.list_managed(store):
+        nodepool_map[np.metadata.name] = np
+        its = cloud_provider.get_instance_types(np)
+        if its:
+            nodepool_its[np.metadata.name] = {it.name: it for it in its}
+    return nodepool_map, nodepool_its
+
+
+def get_candidates(
+    store: Store,
+    cluster: Cluster,
+    recorder: Recorder,
+    clock: Clock,
+    cloud_provider: CloudProvider,
+    should_disrupt: Callable[[Candidate], bool],
+    disruption_class: str,
+    queue,
+) -> list[Candidate]:
+    """helpers.go:164-189."""
+    nodepool_map, nodepool_its = build_nodepool_map(store, cloud_provider)
+    pdbs = Limits.from_pdbs(store.list("PodDisruptionBudget"))
+    candidates = []
+    for node in cluster.state_nodes():
+        try:
+            c = new_candidate(
+                store, recorder, clock, node, pdbs, nodepool_map, nodepool_its,
+                queue, disruption_class,
+            )
+        except Exception:  # noqa: BLE001 — non-candidates are expected
+            continue
+        if should_disrupt(c):
+            candidates.append(c)
+    return candidates
+
+
+def build_disruption_budget_mapping(
+    store: Store,
+    cluster: Cluster,
+    clock: Clock,
+    recorder: Recorder,
+    reason: str,
+) -> dict[str, int]:
+    """nodepool -> remaining allowed disruptions now (helpers.go:225-273)."""
+    from karpenter_tpu.apis.nodeclaim import CONDITION_INSTANCE_TERMINATING
+
+    num_nodes: dict[str, int] = {}
+    disrupting: dict[str, int] = {}
+    for node in cluster.state_nodes():
+        if not node.managed() or not node.initialized():
+            continue
+        if node.node_claim.condition_is_true(CONDITION_INSTANCE_TERMINATING):
+            continue
+        pool = node.labels().get(wk.NODEPOOL_LABEL_KEY, "")
+        num_nodes[pool] = num_nodes.get(pool, 0) + 1
+        ready = True
+        if node.node is not None:
+            cond = next(
+                (c for c in node.node.status.conditions if c.type == "Ready"), None
+            )
+            ready = cond is None or cond.status == "True"
+        if not ready or node.is_marked_for_deletion():
+            disrupting[pool] = disrupting.get(pool, 0) + 1
+    mapping: dict[str, int] = {}
+    for np in nodepoolutil.list_managed(store):
+        name = np.metadata.name
+        allowed = np.allowed_disruptions(reason, num_nodes.get(name, 0), clock.now())
+        mapping[name] = max(allowed - disrupting.get(name, 0), 0)
+        _ALLOWED_DISRUPTIONS.set(
+            float(allowed), {"nodepool": name, "reason": reason}
+        )
+        if num_nodes.get(name, 0) != 0 and allowed == 0:
+            recorder.publish(
+                Event(
+                    np,
+                    "Normal",
+                    "DisruptionBlocked",
+                    f"No allowed disruptions for disruption reason {reason}",
+                )
+            )
+    return mapping
+
+
+def map_candidates(proposed: list[Candidate], current: list[Candidate]) -> list[Candidate]:
+    names = {c.name() for c in proposed}
+    return [c for c in current if c.name() in names]

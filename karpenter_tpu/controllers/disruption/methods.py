@@ -1,0 +1,325 @@
+"""The four disruption methods, tried in order: Emptiness → Drift →
+MultiNodeConsolidation → SingleNodeConsolidation.
+
+Mirrors emptiness.go:40-133, drift.go:52-111, multinodeconsolidation.go:40-226
+(binary search over a sorted candidate prefix), and
+singlenodeconsolidation.go:40-150 (cheapest-first with nodepool fairness).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from karpenter_tpu.apis.nodepool import (
+    DISRUPTION_REASON_DRIFTED,
+    DISRUPTION_REASON_EMPTY,
+    DISRUPTION_REASON_UNDERUTILIZED,
+)
+from karpenter_tpu.controllers.disruption.consolidation import (
+    CONSOLIDATION_TTL,
+    Consolidation,
+)
+from karpenter_tpu.controllers.disruption.helpers import (
+    CandidateDeletingError,
+    simulate_scheduling,
+)
+from karpenter_tpu.controllers.disruption.types import (
+    Candidate,
+    Command,
+    DECISION_DELETE,
+    DECISION_NOOP,
+    DECISION_REPLACE,
+    EVENTUAL_DISRUPTION_CLASS,
+    GRACEFUL_DISRUPTION_CLASS,
+    replacements_from_node_claims,
+)
+from karpenter_tpu.controllers.disruption.validation import (
+    ConsolidationValidator,
+    EmptinessValidator,
+    ValidationError,
+)
+from karpenter_tpu.events.recorder import Event
+from karpenter_tpu.scheduling.requirements import Requirements
+
+MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:36
+SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0  # singlenodeconsolidation.go:34
+MAX_PARALLEL_CONSOLIDATION = 100  # multinodeconsolidation.go:85-87
+
+
+class Emptiness:
+    """Delete nodes with no reschedulable pods (emptiness.go)."""
+
+    def __init__(self, c: Consolidation, validator=None):
+        self.c = c
+        self.validator = validator or EmptinessValidator(c)
+
+    def reason(self) -> str:
+        return DISRUPTION_REASON_EMPTY
+
+    def disruption_class(self) -> str:
+        return GRACEFUL_DISRUPTION_CLASS
+
+    def consolidation_type(self) -> str:
+        return "empty"
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        if candidate.node_pool.spec.disruption.consolidate_after is None:
+            self.c._unconsolidatable(candidate, "NodePool has consolidation disabled")
+            return False
+        from karpenter_tpu.apis.nodeclaim import CONDITION_CONSOLIDATABLE
+
+        return not candidate.reschedulable_pods and candidate.node_claim.condition_is_true(
+            CONDITION_CONSOLIDATABLE
+        )
+
+    def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        if self.c.is_consolidated():
+            return Command()
+        candidates = self.c.sort_candidates(list(candidates))
+        empty = []
+        constrained = False
+        for candidate in candidates:
+            if candidate.reschedulable_pods:
+                continue
+            if budgets.get(candidate.node_pool.metadata.name, 0) == 0:
+                constrained = True
+                continue
+            empty.append(candidate)
+            budgets[candidate.node_pool.metadata.name] -= 1
+        if not empty:
+            if not constrained:
+                self.c.mark_consolidated()
+            return Command()
+        cmd = Command(candidates=empty)
+        try:
+            return self.validator.validate(cmd, CONSOLIDATION_TTL)
+        except ValidationError:
+            return Command()
+
+
+class Drift:
+    """Replace NodeClaims whose Drifted condition is true, oldest-drift first
+    (drift.go:52-111)."""
+
+    def __init__(self, store, cluster, provisioner, recorder):
+        self.store = store
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.recorder = recorder
+
+    def reason(self) -> str:
+        return DISRUPTION_REASON_DRIFTED
+
+    def disruption_class(self) -> str:
+        return EVENTUAL_DISRUPTION_CLASS
+
+    def consolidation_type(self) -> str:
+        return ""
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        return candidate.node_claim.condition_is_true(self.reason())
+
+    def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        def drift_time(c: Candidate) -> float:
+            cond = c.node_claim.get_condition(self.reason())
+            return cond.last_transition_time if cond else 0.0
+
+        for candidate in sorted(candidates, key=drift_time):
+            if not candidate.reschedulable_pods:
+                continue
+            if budgets.get(candidate.node_pool.metadata.name, 0) == 0:
+                continue
+            try:
+                results = simulate_scheduling(
+                    self.store, self.cluster, self.provisioner, candidate
+                )
+            except CandidateDeletingError:
+                continue
+            if not results.all_non_pending_pods_scheduled():
+                self.recorder.publish(
+                    Event(
+                        candidate.node_claim,
+                        "Normal",
+                        "DisruptionBlocked",
+                        results.non_pending_pod_scheduling_errors(),
+                    )
+                )
+                continue
+            return Command(
+                candidates=[candidate],
+                replacements=replacements_from_node_claims(results.new_node_claims),
+                results=results,
+            )
+        return Command()
+
+
+class MultiNodeConsolidation:
+    """Binary search for the largest simultaneously-consolidatable prefix of
+    the ≤100 cheapest-to-disrupt candidates (multinodeconsolidation.go)."""
+
+    def __init__(self, c: Consolidation, validator=None):
+        self.c = c
+        self.validator = validator or ConsolidationValidator(c, self, "multi")
+
+    def reason(self) -> str:
+        return DISRUPTION_REASON_UNDERUTILIZED
+
+    def disruption_class(self) -> str:
+        return GRACEFUL_DISRUPTION_CLASS
+
+    def consolidation_type(self) -> str:
+        return "multi"
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        return self.c.should_disrupt(candidate)
+
+    def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        if self.c.is_consolidated():
+            return Command()
+        candidates = self.c.sort_candidates(list(candidates))
+        disruptable = []
+        constrained = False
+        for candidate in candidates:
+            if budgets.get(candidate.node_pool.metadata.name, 0) == 0:
+                constrained = True
+                continue
+            if not candidate.reschedulable_pods:
+                continue
+            disruptable.append(candidate)
+            budgets[candidate.node_pool.metadata.name] -= 1
+        max_parallel = min(len(disruptable), MAX_PARALLEL_CONSOLIDATION)
+        cmd = self._first_n_consolidation_option(disruptable, max_parallel)
+        if cmd.decision() == DECISION_NOOP:
+            if not constrained:
+                self.c.mark_consolidated()
+            return cmd
+        try:
+            return self.validator.validate(cmd, CONSOLIDATION_TTL)
+        except ValidationError:
+            return Command()
+
+    def _first_n_consolidation_option(
+        self, candidates: list[Candidate], max_n: int
+    ) -> Command:
+        """multinodeconsolidation.go:117-170."""
+        if len(candidates) < 2:
+            return Command()
+        lo_n, hi_n = 1, min(max_n, len(candidates) - 1)
+        last_saved = Command()
+        deadline = self.c.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
+        while lo_n <= hi_n:
+            if self.c.clock.now() > deadline:
+                return last_saved
+            mid = (lo_n + hi_n) // 2
+            prefix = candidates[: mid + 1]
+            cmd = self.c.compute_consolidation(*prefix)
+            ok = cmd.decision() == DECISION_DELETE
+            if cmd.decision() == DECISION_REPLACE:
+                try:
+                    _filter_out_same_type(cmd.replacements[0], prefix)
+                    ok = bool(cmd.replacements[0].node_claim.instance_type_options)
+                except ValueError:
+                    ok = False
+            if ok:
+                last_saved = cmd
+                lo_n = mid + 1
+            else:
+                hi_n = mid - 1
+        return last_saved
+
+
+def _filter_out_same_type(replacement, consolidate: list[Candidate]) -> None:
+    """Replacement must be cheaper than the cheapest current price of any
+    shared instance type, or it would flap (multinodeconsolidation.go:188-226)."""
+    existing_types = set()
+    prices_by_type: dict[str, float] = {}
+    for c in consolidate:
+        existing_types.add(c.instance_type.name)
+        from karpenter_tpu.cloudprovider.types import Offerings
+
+        compatible = Offerings(c.instance_type.offerings).compatible(
+            Requirements.from_labels(c.state_node.labels())
+        )
+        if not compatible:
+            continue
+        p = compatible.cheapest().price
+        if p < prices_by_type.get(c.instance_type.name, math.inf):
+            prices_by_type[c.instance_type.name] = p
+    max_price = math.inf
+    for it in replacement.node_claim.instance_type_options:
+        if it.name in existing_types:
+            max_price = min(max_price, prices_by_type.get(it.name, math.inf))
+    replacement.node_claim.remove_instance_type_options_by_price_and_min_values(
+        replacement.node_claim.requirements, max_price
+    )
+
+
+class SingleNodeConsolidation:
+    """One candidate at a time, cheapest-disruption-first with nodepool
+    fairness across timeouts (singlenodeconsolidation.go)."""
+
+    def __init__(self, c: Consolidation, validator=None):
+        self.c = c
+        self.validator = validator or ConsolidationValidator(c, self, "single")
+        self.previously_unseen_nodepools: set[str] = set()
+
+    def reason(self) -> str:
+        return DISRUPTION_REASON_UNDERUTILIZED
+
+    def disruption_class(self) -> str:
+        return GRACEFUL_DISRUPTION_CLASS
+
+    def consolidation_type(self) -> str:
+        return "single"
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        return self.c.should_disrupt(candidate)
+
+    def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        if self.c.is_consolidated():
+            return Command()
+        candidates = self.sort_candidates(list(candidates))
+        deadline = self.c.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+        constrained = False
+        unseen = {c.node_pool.metadata.name for c in candidates}
+        for i, candidate in enumerate(candidates):
+            if self.c.clock.now() > deadline:
+                self.previously_unseen_nodepools = unseen
+                return Command()
+            unseen.discard(candidate.node_pool.metadata.name)
+            if budgets.get(candidate.node_pool.metadata.name, 0) == 0:
+                constrained = True
+                continue
+            if not candidate.reschedulable_pods:
+                continue
+            cmd = self.c.compute_consolidation(candidate)
+            if cmd.decision() == DECISION_NOOP:
+                continue
+            try:
+                self.validator.validate(cmd, CONSOLIDATION_TTL)
+            except ValidationError:
+                return Command()
+            return cmd
+        if not constrained:
+            self.c.mark_consolidated()
+        self.previously_unseen_nodepools = unseen
+        return Command()
+
+    def sort_candidates(self, candidates: list[Candidate]) -> list[Candidate]:
+        """Cost-sorted, round-robin interleaved across nodepools with unseen
+        pools first (singlenodeconsolidation.go:122-150)."""
+        candidates = sorted(candidates, key=lambda c: c.disruption_cost)
+        by_pool: dict[str, list[Candidate]] = {}
+        for c in candidates:
+            by_pool.setdefault(c.node_pool.metadata.name, []).append(c)
+        pools = sorted(self.previously_unseen_nodepools & set(by_pool)) + sorted(
+            set(by_pool) - self.previously_unseen_nodepools
+        )
+        result = []
+        longest = max((len(v) for v in by_pool.values()), default=0)
+        for i in range(longest):
+            for pool in pools:
+                if i < len(by_pool[pool]):
+                    result.append(by_pool[pool][i])
+        return result

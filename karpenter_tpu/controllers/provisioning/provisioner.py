@@ -81,10 +81,13 @@ class Provisioner:
     # -- reconcile loop (provisioner.go:116-142) ----------------------------
 
     def reconcile(self) -> Optional[Results]:
-        if not self.batcher.consume():
+        if not self.batcher.ready():
             return None
+        # Gate BEFORE consuming: an unsynced cluster keeps the batch pending
+        # so the next loop pass retries it instead of dropping it.
         if not self.cluster.synced():
             return None
+        self.batcher.consume()
         results = self.schedule()
         if results is None or not results.new_node_claims:
             return results
@@ -303,10 +306,11 @@ def _validate_requirement_terms(pod: Pod) -> Optional[str]:
     ]
     aff = pod.spec.affinity
     if aff is not None and aff.node_affinity is not None:
+        # Only REQUIRED terms are validated — a bad preference is relaxed
+        # away by the scheduler, not grounds for ignoring the pod
+        # (provisioner.go:535-547).
         for term in aff.node_affinity.required:
             exprs.extend(term.match_expressions)
-        for pref in aff.node_affinity.preferred:
-            exprs.extend(pref.preference.match_expressions)
     for expr in exprs:
         err = wk.is_restricted_label(expr["key"])
         if err is not None:

@@ -52,52 +52,6 @@ class GroupedPods:
     group_of_pod: np.ndarray  # [P] int32
 
 
-def group_pods(
-    engine: CatalogEngine,
-    pod_rows: Sequence[Sequence[int]],
-    requests: np.ndarray,  # [P, D] float64
-    key_present: Optional[np.ndarray] = None,
-) -> GroupedPods:
-    """Collapse pods into (rows, quantized-requests) groups."""
-    scales = feas.resource_scales(engine.resource_dims)
-    requests_q = feas.quantize_resources(requests, ceil=True, scales=scales)
-    signatures: dict[tuple, int] = {}
-    group_of_pod = np.zeros(len(pod_rows), dtype=np.int32)
-    rows_list: list[Sequence[int]] = []
-    req_list: list[np.ndarray] = []
-    kp_list: list[np.ndarray] = []
-    counts: list[int] = []
-    for p, rows in enumerate(pod_rows):
-        sig = (tuple(sorted(rows)), requests_q[p].tobytes())
-        g = signatures.get(sig)
-        if g is None:
-            g = len(rows_list)
-            signatures[sig] = g
-            rows_list.append(rows)
-            req_list.append(requests_q[p])
-            kp_list.append(
-                key_present[p]
-                if key_present is not None
-                else np.zeros(engine._key_capacity, dtype=bool)
-            )
-            counts.append(0)
-        counts[g] += 1
-        group_of_pod[p] = g
-    G = len(rows_list)
-    R = max(1, engine.num_rows)
-    membership = np.zeros((G, R), dtype=bool)
-    for g, rows in enumerate(rows_list):
-        for rid in rows:
-            membership[g, rid] = True
-    return GroupedPods(
-        membership=membership,
-        requests_q=np.stack(req_list) if req_list else np.zeros((0, requests.shape[1]), np.int64),
-        key_present=np.stack(kp_list) if kp_list else np.zeros((0, engine._key_capacity), bool),
-        counts=np.asarray(counts, dtype=np.int32),
-        group_of_pod=group_of_pod,
-    )
-
-
 def _solve_block(
     group_bools,  # [G, R+K] bool — membership | key_present packed
     group_ints,  # [G, D+1] int32 — requests_q | counts packed
@@ -189,6 +143,7 @@ class GroupSolver:
         ).astype(np.int32)
         self._dev_args = None
         self._dev_rows = -1
+        self._sharded_fns: dict[tuple, object] = {}
 
     def _catalog_args(self):
         """Device-resident catalog matrices, uploaded once per row-set."""
@@ -232,17 +187,23 @@ class GroupSolver:
         in_specs = (P(axis), P(axis)) + tuple(P() for _ in catalog_args)
         out_specs = P(axis)
 
-        fn = shard_map(
-            _solve_block, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
-        )
+        fn_key = (id(mesh), axis)
+        fn = self._sharded_fns.get(fn_key)
+        if fn is None:
+            fn = jax.jit(
+                shard_map(
+                    _solve_block, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_rep=False,
+                )
+            )
+            self._sharded_fns[fn_key] = fn
         sharding = NamedSharding(mesh, P(axis))
         rep = NamedSharding(mesh, P())
         dev_args = [
             jax.device_put(group_bools, sharding),
             jax.device_put(group_ints, sharding),
         ] + [jax.device_put(np.asarray(a), rep) for a in catalog_args]
-        out = np.asarray(jax.jit(fn)(*dev_args))
+        out = np.asarray(fn(*dev_args))
         return (
             out[:G, 0],
             out[:G, 1].astype(bool),

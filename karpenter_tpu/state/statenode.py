@@ -275,3 +275,40 @@ def active(nodes: list[StateNode]) -> list[StateNode]:
 
 def deleting(nodes: list[StateNode]) -> list[StateNode]:
     return [n for n in nodes if n.is_marked_for_deletion()]
+
+
+def require_no_schedule_taint(store: Store, add: bool, *nodes: StateNode) -> None:
+    """Add/remove the karpenter.sh/disrupted:NoSchedule taint on the Node
+    objects (statenode.go:483-534). Idempotent; deleting nodes keep it."""
+    from karpenter_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+
+    for sn in nodes:
+        if sn.node is None or sn.node_claim is None:
+            continue
+        node = store.try_get("Node", sn.node.metadata.name)
+        if node is None:
+            continue
+        has = any(t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints)
+        if has and node.metadata.deletion_timestamp is not None:
+            continue
+        if not add and has:
+            node.spec.taints = [
+                t for t in node.spec.taints if not t.match(DISRUPTED_NO_SCHEDULE_TAINT)
+            ]
+            store.update(node)
+        elif add and not has:
+            node.spec.taints = list(node.spec.taints) + [DISRUPTED_NO_SCHEDULE_TAINT]
+            store.update(node)
+
+
+def clear_node_claims_condition(store: Store, condition_type: str, *nodes: StateNode) -> None:
+    """Strip a status condition from the nodes' NodeClaims
+    (statenode.go ClearNodeClaimsCondition)."""
+    for sn in nodes:
+        if sn.node_claim is None:
+            continue
+        claim = store.try_get("NodeClaim", sn.node_claim.metadata.name)
+        if claim is None or claim.get_condition(condition_type) is None:
+            continue
+        claim.clear_condition(condition_type)
+        store.update(claim)

@@ -135,3 +135,52 @@ def bind_pod(pod: Pod, node: Node) -> Pod:
     ]
     pod.status.conditions.append(Condition(type="PodScheduled", status="True"))
     return pod
+
+
+def node_claim_pair(
+    name: str,
+    pool: str = "default",
+    instance_type: str = "s-4x-amd64-linux",
+    zone: str = "kwok-zone-1",
+    capacity_type: str = wk.CAPACITY_TYPE_ON_DEMAND,
+    capacity: Optional[dict] = None,
+    consolidatable: bool = True,
+):
+    """A registered+initialized Node and its NodeClaim, as the lifecycle
+    controllers would leave them."""
+    cap = parse_resource_list(capacity or {"cpu": "4", "memory": "16Gi", "pods": "110"})
+    labels = {
+        wk.NODEPOOL_LABEL_KEY: pool,
+        wk.LABEL_INSTANCE_TYPE: instance_type,
+        wk.LABEL_TOPOLOGY_ZONE: zone,
+        wk.CAPACITY_TYPE_LABEL_KEY: capacity_type,
+        wk.LABEL_OS: "linux",
+        wk.LABEL_ARCH: "amd64",
+        wk.NODE_REGISTERED_LABEL_KEY: "true",
+        wk.NODE_INITIALIZED_LABEL_KEY: "true",
+        wk.LABEL_HOSTNAME: name,
+    }
+    node = Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=NodeSpec(provider_id=f"kwok://{name}"),
+        status=NodeStatus(capacity=dict(cap), allocatable=dict(cap)),
+    )
+    node.status.conditions.append(Condition(type="Ready", status="True"))
+    claim = NodeClaim(
+        metadata=ObjectMeta(
+            name=f"{name}-claim",
+            labels={k: v for k, v in labels.items()
+                    if k not in (wk.NODE_REGISTERED_LABEL_KEY, wk.NODE_INITIALIZED_LABEL_KEY,
+                                 wk.LABEL_HOSTNAME)},
+        )
+    )
+    claim.status.provider_id = f"kwok://{name}"
+    claim.status.node_name = name
+    claim.status.capacity = dict(cap)
+    claim.status.allocatable = dict(cap)
+    claim.set_condition("Launched", "True")
+    claim.set_condition("Registered", "True")
+    claim.set_condition("Initialized", "True")
+    if consolidatable:
+        claim.set_condition("Consolidatable", "True")
+    return node, claim

@@ -1,0 +1,292 @@
+"""Disruption stack: emptiness, consolidation (single/multi), drift,
+budgets, validation, orchestration queue. Mirrors the reference's
+disruption suite behaviors."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import (
+    CONDITION_DISRUPTION_REASON,
+    CONDITION_INITIALIZED,
+)
+from karpenter_tpu.apis.nodepool import Budget
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.disruption import Controller as DisruptionController
+from karpenter_tpu.controllers.disruption import Queue as DisruptionQueue
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import bind_pod, node_claim_pair, nodepool, unschedulable_pod
+
+
+class Env:
+    def __init__(self):
+        self.clock = FakeClock()
+        self.store = Store(clock=self.clock)
+        self.provider = FakeCloudProvider()
+        self.cluster = Cluster(self.clock, self.store, self.provider)
+        self.informer = StateInformer(self.store, self.cluster)
+        self.recorder = Recorder(clock=self.clock)
+        self.provisioner = Provisioner(
+            self.store, self.provider, self.cluster, self.recorder, self.clock, Options()
+        )
+        self.queue = DisruptionQueue(
+            self.store, self.recorder, self.cluster, self.clock, self.provisioner
+        )
+        self.controller = DisruptionController(
+            self.clock, self.store, self.provisioner, self.provider,
+            self.recorder, self.cluster, self.queue,
+        )
+
+    def add_pair(self, name, pods=(), **kw):
+        node, claim = node_claim_pair(name, **kw)
+        self.store.create(claim)
+        self.store.create(node)
+        for p in pods:
+            bind_pod(p, node)
+            self.store.create(p)
+        self.informer.flush()
+        return node, claim
+
+    def reconcile(self):
+        self.informer.flush()
+        out = self.controller.reconcile()
+        self.informer.flush()
+        return out
+
+
+class TestEmptiness:
+    def test_empty_node_deleted(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair("empty-1")
+        assert env.reconcile() is True
+        # command started: node tainted, claim has DisruptionReason
+        node = env.store.get("Node", "empty-1")
+        assert any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        claim = env.store.get("NodeClaim", "empty-1-claim")
+        assert claim.condition_is_true(CONDITION_DISRUPTION_REASON)
+        # queue drains: no replacements -> delete candidates immediately
+        env.queue.reconcile()
+        env.informer.flush()
+        assert env.store.try_get("NodeClaim", "empty-1-claim") is None
+
+    def test_node_with_pods_not_empty(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        env.add_pair("busy-1", pods=[unschedulable_pod(requests={"cpu": "1"})])
+        # emptiness skips; consolidation may run but a single node with pods
+        # can't consolidate to nothing cheaper here (it's the cheapest shape)
+
+    def test_not_consolidatable_skipped(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        env.add_pair("e-1", consolidatable=False)
+        assert env.reconcile() is False
+
+    def test_consolidation_disabled_nodepool(self):
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.consolidate_after = None
+        env.store.create(np)
+        env.add_pair("e-2")
+        assert env.reconcile() is False
+
+    def test_budget_zero_blocks(self):
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="0")]
+        env.store.create(np)
+        env.add_pair("e-3")
+        assert env.reconcile() is False
+
+
+class TestSingleNodeConsolidation:
+    def test_replace_underutilized_with_cheaper(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        # big node (32 cpu) with one small pod -> cheaper replacement exists
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        env.add_pair(
+            "big-1",
+            pods=[pod],
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert cmd.decision() == "replace"
+        assert len(cmd.replacements) == 1
+        # replacement claim created in store
+        claims = [
+            c for c in env.store.list("NodeClaim") if c.metadata.name != "big-1-claim"
+        ]
+        assert len(claims) == 1
+        # every replacement option launches cheaper than the candidate's
+        # on-demand price; with spot still cheaper the capacity type is
+        # pinned to spot (consolidation.go:216-219)
+        from karpenter_tpu.cloudprovider.types import Offerings
+        replacement = cmd.replacements[0].node_claim
+        candidate_price = 0.025 * 32 + 0.001 * 128
+        for it in replacement.instance_type_options:
+            worst = Offerings(it.offerings).available().worst_launch_price(
+                replacement.requirements
+            )
+            assert worst < candidate_price
+        ct = replacement.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        assert ct.values_list() == [wk.CAPACITY_TYPE_SPOT]
+
+    def test_replacement_initialization_completes_command(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        env.add_pair(
+            "big-2",
+            pods=[pod],
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        replacement_name = cmd.replacements[0].name
+        env.queue.reconcile()  # replacement not initialized yet
+        assert env.store.try_get("NodeClaim", "big-2-claim") is not None
+        rep = env.store.get("NodeClaim", replacement_name)
+        rep.set_condition(CONDITION_INITIALIZED, "True")
+        env.store.update(rep)
+        env.queue.reconcile()
+        assert env.store.try_get("NodeClaim", "big-2-claim") is None
+        assert env.queue.is_empty()
+
+    def test_command_timeout_rolls_back(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        node, claim = env.add_pair(
+            "big-3",
+            pods=[pod],
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        env.clock.step(601.0)  # maxRetryDuration
+        env.queue.reconcile()
+        env.informer.flush()
+        # candidate survived, taint removed, condition cleared, unmarked
+        node = env.store.get("Node", "big-3")
+        assert not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        claim = env.store.get("NodeClaim", "big-3-claim")
+        assert not claim.condition_is_true(CONDITION_DISRUPTION_REASON)
+        assert env.queue.is_empty()
+
+    def test_cheapest_node_not_replaced(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        pod = unschedulable_pod(requests={"cpu": "3"})
+        # 4-cpu node fairly full -> no cheaper single replacement
+        env.add_pair("cheap-1", pods=[pod], instance_type="c-4x-amd64-linux",
+                     capacity={"cpu": "4", "memory": "8Gi", "pods": "110"})
+        env.reconcile()
+        for cmd in env.queue.get_commands():
+            assert cmd.decision() != "replace" or cmd.replacements
+
+
+class TestMultiNodeConsolidation:
+    def test_two_nodes_merge_into_one(self):
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np)
+        for i in range(2):
+            pod = unschedulable_pod(requests={"cpu": "1"})
+            env.add_pair(
+                f"multi-{i}",
+                pods=[pod],
+                instance_type="s-16x-amd64-linux",
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        # both candidates consolidated into <= 1 replacement
+        assert len(cmd.candidates) == 2
+        assert len(cmd.replacements) <= 1
+
+    def test_spot_to_spot_requires_feature_gate(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        env.add_pair(
+            "spot-1",
+            pods=[pod],
+            instance_type="s-32x-amd64-linux",
+            capacity_type=wk.CAPACITY_TYPE_SPOT,
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        env.reconcile()
+        # gate disabled by default: no replace command for a spot candidate
+        # whose replacement would also be spot
+        for cmd in env.queue.get_commands():
+            if cmd.candidates and cmd.candidates[0].name() == "spot-1":
+                ct = cmd.replacements[0].node_claim.requirements.get(
+                    wk.CAPACITY_TYPE_LABEL_KEY
+                )
+                assert not ct.has(wk.CAPACITY_TYPE_SPOT) or ct.has(
+                    wk.CAPACITY_TYPE_ON_DEMAND
+                )
+
+
+class TestDrift:
+    def test_drifted_node_replaced(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        node, claim = env.add_pair("drift-1", pods=[pod], consolidatable=False)
+        claim.set_condition("Drifted", "True", now=env.clock.now())
+        env.store.update(claim)
+        env.informer.flush()
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert cmd.reason == "Drifted"
+        assert len(cmd.candidates) == 1 and len(cmd.replacements) == 1
+
+    def test_empty_drifted_node_not_via_drift(self):
+        # drift skips candidates with no reschedulable pods (emptiness owns them)
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.consolidate_after = None  # disable emptiness path
+        env.store.create(np)
+        node, claim = env.add_pair("drift-2", consolidatable=False)
+        claim.set_condition("Drifted", "True", now=env.clock.now())
+        env.store.update(claim)
+        env.informer.flush()
+        assert env.reconcile() is False
+
+
+class TestBudgets:
+    def test_percentage_budget_limits_batch(self):
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="50%")]
+        env.store.create(np)
+        for i in range(4):
+            env.add_pair(f"b-{i}")
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert len(cmd.candidates) == 2  # 50% of 4
+
+    def test_schedule_budget_inactive(self):
+        env = Env()
+        np = nodepool("default")
+        # active for 1h starting at midnight; fake clock starts far from it
+        np.spec.disruption.budgets = [
+            Budget(nodes="0", schedule="0 0 * * *", duration=3600.0)
+        ]
+        env.store.create(np)
+        env.add_pair("b-sched")
+        # budget inactive -> unrestricted -> emptiness proceeds
+        assert env.reconcile() is True
