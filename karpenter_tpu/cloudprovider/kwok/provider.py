@@ -1,0 +1,164 @@
+"""kwok CloudProvider: the in-tree correctness/benchmark harness.
+
+Mirrors the reference's kwok/cloudprovider/cloudprovider.go:46-266 — Create
+fabricates a Node object directly (no kubelet) after NodeRegistrationDelay;
+a tick() stand-in for the kwok controller heartbeats fabricated nodes Ready.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Condition, Node, ObjectMeta, Taint
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    order_by_price,
+)
+from karpenter_tpu.runtime.store import AlreadyExists, Store
+from karpenter_tpu.scheduling.requirements import requirements_from_dicts
+from karpenter_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
+from karpenter_tpu.utils.clock import Clock
+
+# Node appears this long after Create (kwok NodeRegistrationDelay)
+NODE_REGISTRATION_DELAY = 2.0
+# nodes are sharded into partitions for scale (cloudprovider.go:263-266)
+PARTITION_LABEL = "kwok-partition"
+NUM_PARTITIONS = 10
+
+
+@dataclass
+class _Instance:
+    claim: NodeClaim
+    instance_type: InstanceType
+    node_due_at: float
+    node_created: bool = False
+
+
+class KwokCloudProvider(CloudProvider):
+    def __init__(self, store: Store, clock: Clock,
+                 instance_types: Optional[list[InstanceType]] = None,
+                 registration_delay: float = NODE_REGISTRATION_DELAY):
+        self.store = store
+        self.clock = clock
+        self.instance_types = (
+            instance_types if instance_types is not None else construct_instance_types()
+        )
+        self.registration_delay = registration_delay
+        self._instances: dict[str, _Instance] = {}
+        self._counter = 0
+
+    # -- CloudProvider boundary ---------------------------------------------
+
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        reqs = requirements_from_dicts(node_claim.spec.requirements)
+        from karpenter_tpu.utils import resources as res
+
+        requests = node_claim.spec.resources.requests
+        compatible = [
+            it
+            for it in self.instance_types
+            if it.requirements.intersects(reqs) is None
+            and it.offerings.available().has_compatible(reqs)
+            and res.fits(requests, it.allocatable())
+        ]
+        if not compatible:
+            raise InsufficientCapacityError(
+                "no compatible instance types for nodeclaim requirements"
+            )
+        it = order_by_price(compatible, reqs)[0]
+        offering = next(
+            o
+            for o in sorted(it.offerings, key=lambda o: o.price)
+            if o.available
+            and reqs.is_compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS)
+        )
+        self._counter += 1
+        created = copy.deepcopy(node_claim)
+        created.status.provider_id = f"kwok://{node_claim.metadata.name}-{self._counter}"
+        created.status.capacity = dict(it.capacity)
+        created.status.allocatable = dict(it.allocatable())
+        created.status.image_id = "kwok-ami"
+        created.metadata.labels.update(reqs.labels())
+        created.metadata.labels.update(
+            {
+                wk.LABEL_INSTANCE_TYPE: it.name,
+                wk.LABEL_TOPOLOGY_ZONE: offering.zone,
+                wk.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type,
+                PARTITION_LABEL: f"partition-{self._counter % NUM_PARTITIONS}",
+            }
+        )
+        self._instances[created.status.provider_id] = _Instance(
+            claim=created,
+            instance_type=it,
+            node_due_at=self.clock.now() + self.registration_delay,
+        )
+        return created
+
+    def delete(self, node_claim: NodeClaim) -> None:
+        pid = node_claim.status.provider_id
+        if pid not in self._instances:
+            raise NodeClaimNotFoundError(pid)
+        del self._instances[pid]
+
+    def get(self, provider_id: str) -> NodeClaim:
+        inst = self._instances.get(provider_id)
+        if inst is None:
+            raise NodeClaimNotFoundError(provider_id)
+        return copy.deepcopy(inst.claim)
+
+    def list(self) -> list[NodeClaim]:
+        return [copy.deepcopy(i.claim) for i in self._instances.values()]
+
+    def get_instance_types(self, node_pool: NodePool) -> list[InstanceType]:
+        return list(self.instance_types)
+
+    def is_drifted(self, node_claim: NodeClaim) -> str:
+        return ""
+
+    def name(self) -> str:
+        return "kwok"
+
+    # -- the fake kubelet (kwok controller) ---------------------------------
+
+    def tick(self) -> int:
+        """Fabricate due Nodes and heartbeat existing ones Ready
+        (cloudprovider.go:58-86, 185-233). Returns nodes fabricated."""
+        fabricated = 0
+        now = self.clock.now()
+        for inst in self._instances.values():
+            if inst.node_created or now < inst.node_due_at:
+                continue
+            claim = inst.claim
+            node = Node(
+                metadata=ObjectMeta(
+                    name=claim.metadata.name,
+                    labels=dict(claim.metadata.labels),
+                    annotations=dict(claim.metadata.annotations),
+                ),
+            )
+            node.metadata.labels[wk.LABEL_HOSTNAME] = node.metadata.name
+            node.spec.provider_id = claim.status.provider_id
+            node.spec.taints = list(claim.spec.taints) + list(
+                claim.spec.startup_taints
+            ) + [UNREGISTERED_NO_EXECUTE_TAINT]
+            node.status.capacity = dict(claim.status.capacity)
+            node.status.allocatable = dict(claim.status.allocatable)
+            node.status.conditions.append(
+                Condition(type="Ready", status="True", reason="KubeletReady")
+            )
+            try:
+                self.store.create(node)
+            except AlreadyExists:
+                pass
+            inst.node_created = True
+            fabricated += 1
+        return fabricated

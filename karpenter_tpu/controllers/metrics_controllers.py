@@ -1,0 +1,125 @@
+"""Metrics controllers: pod / node / nodepool gauge stores.
+
+Mirrors controllers/metrics/{pod,node,nodepool}/controller.go — per-object
+gauge families replaced atomically via metrics.Store so deleted objects'
+series disappear.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.metrics import Store as MetricStore
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.clock import Clock
+
+_POD_STATE = global_registry.gauge(
+    "karpenter_pods_state", "pod state", labels=["name", "namespace", "phase", "node"]
+)
+_POD_STARTUP = global_registry.histogram(
+    "karpenter_pods_startup_duration_seconds", "time from pod creation to running"
+)
+_POD_UNBOUND = global_registry.histogram(
+    "karpenter_pods_unbound_duration_seconds", "time pods spend unbound"
+)
+_NODE_ALLOCATABLE = global_registry.gauge(
+    "karpenter_nodes_allocatable", "node allocatable",
+    labels=["node_name", "nodepool", "resource_type"],
+)
+_NODE_UTILIZATION = global_registry.gauge(
+    "karpenter_nodes_total_pod_requests", "node pod requests",
+    labels=["node_name", "nodepool", "resource_type"],
+)
+_NODEPOOL_LIMIT = global_registry.gauge(
+    "karpenter_nodepools_limit", "nodepool limits", labels=["nodepool", "resource_type"]
+)
+_NODEPOOL_USAGE = global_registry.gauge(
+    "karpenter_nodepools_usage", "nodepool usage", labels=["nodepool", "resource_type"]
+)
+
+
+class PodMetricsController:
+    def __init__(self, store: Store, cluster: Cluster, clock: Clock):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock
+        self.metric_store = MetricStore()
+        self._started: set[str] = set()
+
+    def reconcile(self) -> None:
+        for pod in self.store.list("Pod"):
+            key = f"pod/{pod.metadata.namespace}/{pod.metadata.name}"
+            self.metric_store.update(
+                key,
+                [
+                    (
+                        _POD_STATE,
+                        {
+                            "name": pod.metadata.name,
+                            "namespace": pod.metadata.namespace,
+                            "phase": pod.status.phase,
+                            "node": pod.spec.node_name,
+                        },
+                        1.0,
+                    )
+                ],
+            )
+            if pod.status.phase == "Running" and pod.metadata.uid not in self._started:
+                self._started.add(pod.metadata.uid)
+                _POD_STARTUP.observe(
+                    self.clock.now() - pod.metadata.creation_timestamp
+                )
+
+    def on_delete(self, namespace: str, name: str) -> None:
+        self.metric_store.delete(f"pod/{namespace}/{name}")
+
+
+class NodeMetricsController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.metric_store = MetricStore()
+
+    def reconcile(self) -> None:
+        for sn in self.cluster.state_nodes():
+            pool = sn.labels().get(wk.NODEPOOL_LABEL_KEY, "")
+            series = []
+            for resource, value in sn.allocatable().items():
+                series.append(
+                    (
+                        _NODE_ALLOCATABLE,
+                        {"node_name": sn.name(), "nodepool": pool, "resource_type": resource},
+                        value,
+                    )
+                )
+            for resource, value in sn.total_pod_requests().items():
+                series.append(
+                    (
+                        _NODE_UTILIZATION,
+                        {"node_name": sn.name(), "nodepool": pool, "resource_type": resource},
+                        value,
+                    )
+                )
+            self.metric_store.update(f"node/{sn.name()}", series)
+
+
+class NodePoolMetricsController:
+    def __init__(self, store: Store, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+        self.metric_store = MetricStore()
+
+    def reconcile(self) -> None:
+        for pool in self.store.list("NodePool"):
+            name = pool.metadata.name
+            series = []
+            for resource, value in pool.spec.limits.items():
+                series.append(
+                    (_NODEPOOL_LIMIT, {"nodepool": name, "resource_type": resource}, value)
+                )
+            for resource, value in self.cluster.nodepool_resources_for(name).items():
+                series.append(
+                    (_NODEPOOL_USAGE, {"nodepool": name, "resource_type": resource}, value)
+                )
+            self.metric_store.update(f"nodepool/{name}", series)

@@ -1,0 +1,97 @@
+"""Node auto-repair: force-delete unhealthy nodes per provider repair
+policies, with a cluster-wide circuit breaker.
+
+Mirrors the reference's node/health/controller.go:59-226.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Node
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.events.recorder import Event, Recorder
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import Clock
+
+# >20% unhealthy nodes → stop repairing (controller.go:75-150)
+UNHEALTHY_CIRCUIT_BREAKER_THRESHOLD = 0.2
+
+_REPAIRED_TOTAL = global_registry.counter(
+    "karpenter_nodes_repaired_total", "unhealthy nodes force-deleted",
+    labels=["condition"],
+)
+
+
+class HealthController:
+    def __init__(
+        self,
+        store: Store,
+        cloud_provider: CloudProvider,
+        recorder: Recorder,
+        clock: Clock,
+        enabled: bool = False,
+    ):
+        self.store = store
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder
+        self.clock = clock
+        self.enabled = enabled
+
+    def reconcile(self, node: Node) -> None:
+        if not self.enabled:
+            return
+        if node.metadata.deletion_timestamp is not None:
+            return
+        if wk.NODEPOOL_LABEL_KEY not in node.metadata.labels:
+            return
+        policies = self.cloud_provider.repair_policies()
+        if not policies:
+            return
+        for policy in policies:
+            cond = next(
+                (c for c in node.status.conditions if c.type == policy.condition_type),
+                None,
+            )
+            if cond is None or cond.status != policy.condition_status:
+                continue
+            elapsed = self.clock.now() - cond.last_transition_time
+            if elapsed < policy.toleration_duration:
+                continue
+            if self._circuit_broken():
+                self.recorder.publish(
+                    Event(
+                        node, "Warning", "NodeRepairBlocked",
+                        "Disruption blocked: more than 20% of nodes are unhealthy",
+                    )
+                )
+                return
+            _REPAIRED_TOTAL.inc({"condition": policy.condition_type})
+            self.recorder.publish(
+                Event(
+                    node, "Warning", "NodeUnhealthy",
+                    f"Force-terminating: {policy.condition_type}={policy.condition_status} "
+                    f"for {int(elapsed)}s",
+                )
+            )
+            self.store.delete(node)
+            return
+
+    def _circuit_broken(self) -> bool:
+        nodes = self.store.list(
+            "Node", predicate=lambda n: wk.NODEPOOL_LABEL_KEY in n.metadata.labels
+        )
+        if not nodes:
+            return False
+        policies = self.cloud_provider.repair_policies()
+        unhealthy = 0
+        for n in nodes:
+            for policy in policies:
+                cond = next(
+                    (c for c in n.status.conditions if c.type == policy.condition_type),
+                    None,
+                )
+                if cond is not None and cond.status == policy.condition_status:
+                    unhealthy += 1
+                    break
+        return unhealthy / len(nodes) > UNHEALTHY_CIRCUIT_BREAKER_THRESHOLD

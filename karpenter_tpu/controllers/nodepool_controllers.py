@@ -1,0 +1,177 @@
+"""NodePool controllers: hash, counter, readiness, registration health,
+validation.
+
+Mirrors nodepool/{hash,counter,readiness,registrationhealth,validation}/
+controller.go.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import (
+    CONDITION_NODECLASS_READY,
+    CONDITION_NODE_REGISTRATION_HEALTHY,
+    CONDITION_READY,
+    CONDITION_VALIDATION_SUCCEEDED,
+    NODEPOOL_HASH_VERSION,
+    NodePool,
+)
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.utils.clock import Clock
+
+
+class HashController:
+    """Maintains the static-field hash annotation driving drift
+    (nodepool/hash/controller.go:46-124)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def reconcile(self, pool: NodePool) -> None:
+        current = pool.static_hash()
+        annotations = pool.metadata.annotations
+        stored_version = annotations.get(wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
+        if stored_version != NODEPOOL_HASH_VERSION:
+            # hash-version migration: re-stamp the pool AND backfill claims so
+            # they aren't spuriously drifted by the algorithm change
+            for claim in self.store.list(
+                "NodeClaim",
+                predicate=lambda c: c.metadata.labels.get(wk.NODEPOOL_LABEL_KEY)
+                == pool.metadata.name,
+            ):
+                claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = current
+                claim.metadata.annotations[
+                    wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+                ] = NODEPOOL_HASH_VERSION
+                self.store.update(claim)
+        if (
+            annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY) != current
+            or stored_version != NODEPOOL_HASH_VERSION
+        ):
+            annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = current
+            annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = NODEPOOL_HASH_VERSION
+            self.store.update(pool)
+
+
+class CounterController:
+    """Aggregates node+claim resources into nodepool status
+    (nodepool/counter/controller.go:60-103)."""
+
+    def __init__(self, store: Store, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+
+    def reconcile(self, pool: NodePool) -> None:
+        resources = self.cluster.nodepool_resources_for(pool.metadata.name)
+        node_count = int(resources.pop("nodes", 0.0))
+        pool.status.resources = resources
+        pool.status.node_count = node_count
+        self.store.update(pool)
+
+
+class ReadinessController:
+    """Ready condition from NodeClass readiness (readiness/controller.go:45-107).
+    Without a NodeClass ref (kwok), the pool is Ready once validated."""
+
+    def __init__(self, store: Store, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self, pool: NodePool) -> None:
+        ref = pool.spec.template.spec.node_class_ref
+        now = self.clock.now()
+        if ref.kind:
+            node_class = self.store.try_get(ref.kind, ref.name)
+            if node_class is None:
+                pool.set_condition(
+                    CONDITION_NODECLASS_READY, "False",
+                    reason="NodeClassNotFound", message="NodeClass not found", now=now,
+                )
+            else:
+                status = "True"
+                ready = getattr(node_class, "status", None)
+                if ready is not None and getattr(ready, "conditions", None):
+                    cond = next((c for c in ready.conditions if c.type == "Ready"), None)
+                    if cond is not None and cond.status != "True":
+                        status = "False"
+                pool.set_condition(CONDITION_NODECLASS_READY, status, now=now)
+        else:
+            pool.set_condition(CONDITION_NODECLASS_READY, "True", now=now)
+        ready = all(
+            pool.condition_is_true(t)
+            for t in (CONDITION_VALIDATION_SUCCEEDED, CONDITION_NODECLASS_READY)
+        )
+        pool.set_condition(CONDITION_READY, "True" if ready else "False", now=now)
+        self.store.update(pool)
+
+
+class RegistrationHealthController:
+    """Resets NodeRegistrationHealthy to Unknown on spec change
+    (registrationhealth/controller.go:46-96)."""
+
+    def __init__(self, store: Store, clock: Clock):
+        self.store = store
+        self.clock = clock
+        self._seen_hashes: dict[str, str] = {}
+
+    def reconcile(self, pool: NodePool) -> None:
+        current = pool.static_hash()
+        previous = self._seen_hashes.get(pool.metadata.name)
+        self._seen_hashes[pool.metadata.name] = current
+        if previous is not None and previous != current:
+            pool.set_condition(
+                CONDITION_NODE_REGISTRATION_HEALTHY, "Unknown",
+                reason="NodePoolChanged", message="NodePool spec changed",
+                now=self.clock.now(),
+            )
+            self.store.update(pool)
+        elif pool.get_condition(CONDITION_NODE_REGISTRATION_HEALTHY) is None:
+            pool.set_condition(
+                CONDITION_NODE_REGISTRATION_HEALTHY, "Unknown",
+                reason="Initializing", message="", now=self.clock.now(),
+            )
+            self.store.update(pool)
+
+
+class ValidationController:
+    """Runtime spec validation → ValidationSucceeded condition
+    (validation/controller.go:44-82)."""
+
+    def __init__(self, store: Store, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self, pool: NodePool) -> None:
+        err = self._validate(pool)
+        now = self.clock.now()
+        if err is None:
+            pool.set_condition(CONDITION_VALIDATION_SUCCEEDED, "True", now=now)
+        else:
+            pool.set_condition(
+                CONDITION_VALIDATION_SUCCEEDED, "False",
+                reason="ValidationFailed", message=err, now=now,
+            )
+        self.store.update(pool)
+
+    def _validate(self, pool: NodePool) -> str | None:
+        for budget in pool.spec.disruption.budgets:
+            if budget.schedule is not None and budget.duration is None:
+                return "budget with schedule must set duration"
+            if not budget.nodes.endswith("%"):
+                try:
+                    int(budget.nodes)
+                except ValueError:
+                    return f"invalid budget nodes value {budget.nodes!r}"
+        for req in pool.spec.template.spec.requirements:
+            err = wk.is_restricted_label(req.get("key", ""))
+            if err is not None:
+                return err
+        for key in pool.spec.template.labels:
+            err = wk.is_restricted_label(key)
+            if err is not None:
+                return err
+        weight = pool.spec.weight
+        if weight < 0 or weight > 100:
+            return "weight must be in [0, 100]"
+        return None

@@ -1,0 +1,213 @@
+"""Operator: wires every controller into one cooperative event loop.
+
+Mirrors the reference's pkg/operator/operator.go:106-278 +
+pkg/controllers/controllers.go:62-129. Where the reference runs ~27
+controller-runtime goroutine loops with leader election, the TPU build runs
+one single-threaded event loop (SURVEY.md §2 "TPU-native equivalent"):
+watch events dispatch to object controllers; singleton loops (provisioner,
+disruption, GC, kwok fake-kubelet, metrics) tick every pass. Determinism is
+a feature — the solver parallelism lives on-device, not in host threads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.controllers.disruption import Controller as DisruptionController
+from karpenter_tpu.controllers.disruption import Queue as DisruptionQueue
+from karpenter_tpu.controllers.metrics_controllers import (
+    NodeMetricsController,
+    NodePoolMetricsController,
+    PodMetricsController,
+)
+from karpenter_tpu.controllers.node.health import HealthController
+from karpenter_tpu.controllers.node.termination import (
+    EvictionQueue,
+    TerminationController,
+    Terminator,
+)
+from karpenter_tpu.controllers.nodeclaim.disruption import DisruptionController as NCDisruption
+from karpenter_tpu.controllers.nodeclaim.gc import (
+    ConsistencyController,
+    ExpirationController,
+    GarbageCollectionController,
+    HydrationController,
+    PodEventsController,
+)
+from karpenter_tpu.controllers.nodeclaim.lifecycle import LifecycleController
+from karpenter_tpu.controllers.nodepool_controllers import (
+    CounterController,
+    HashController,
+    ReadinessController,
+    RegistrationHealthController,
+    ValidationController,
+)
+from karpenter_tpu.controllers.provisioning import Provisioner
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.store import DELETED, Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.clock import Clock
+
+
+class Operator:
+    def __init__(
+        self,
+        store: Store,
+        cloud_provider: CloudProvider,
+        clock: Optional[Clock] = None,
+        options: Optional[Options] = None,
+        engine_factory=None,
+    ):
+        self.clock = clock or Clock()
+        self.store = store
+        self.cloud_provider = cloud_provider
+        self.options = options or Options()
+        self.recorder = Recorder(clock=self.clock)
+        self.cluster = Cluster(
+            self.clock, store, cloud_provider,
+            nomination_window=2 * self.options.batch_max_duration,
+        )
+        self.informer = StateInformer(store, self.cluster)
+
+        self.provisioner = Provisioner(
+            store, cloud_provider, self.cluster, self.recorder, self.clock,
+            self.options, engine_factory=engine_factory,
+        )
+        self.disruption_queue = DisruptionQueue(
+            store, self.recorder, self.cluster, self.clock, self.provisioner
+        )
+        self.disruption = DisruptionController(
+            self.clock, store, self.provisioner, cloud_provider, self.recorder,
+            self.cluster, self.disruption_queue,
+        )
+        self.lifecycle = LifecycleController(
+            store, cloud_provider, self.recorder, self.clock
+        )
+        self.nc_disruption = NCDisruption(store, cloud_provider, self.clock)
+        self.expiration = ExpirationController(store, self.clock, self.recorder)
+        self.gc = GarbageCollectionController(store, cloud_provider, self.clock)
+        self.consistency = ConsistencyController(store, self.recorder, self.clock)
+        self.podevents = PodEventsController(store, self.clock)
+        self.hydration = HydrationController(store)
+        self.eviction_queue = EvictionQueue(store, self.recorder, self.clock)
+        self.terminator = Terminator(self.clock, store, self.eviction_queue, self.recorder)
+        self.termination = TerminationController(
+            store, cloud_provider, self.terminator, self.recorder, self.clock
+        )
+        self.health = HealthController(
+            store, cloud_provider, self.recorder, self.clock,
+            enabled=self.options.feature_gates.node_repair,
+        )
+        self.np_hash = HashController(store)
+        self.np_counter = CounterController(store, self.cluster)
+        self.np_readiness = ReadinessController(store, self.clock)
+        self.np_registration_health = RegistrationHealthController(store, self.clock)
+        self.np_validation = ValidationController(store, self.clock)
+        self.pod_metrics = PodMetricsController(store, self.cluster, self.clock)
+        self.node_metrics = NodeMetricsController(self.cluster)
+        self.nodepool_metrics = NodePoolMetricsController(store, self.cluster)
+
+        self._dispatch_watch = store.watch(
+            ["Pod", "Node", "NodeClaim", "NodePool"]
+        )
+
+    # -- the loop -----------------------------------------------------------
+
+    def run_once(self) -> None:
+        """One cooperative pass: ingest watches, dispatch object events,
+        tick singletons. Controllers re-emit store writes which the next
+        pass ingests — level-triggered, idempotent, resumable (SURVEY.md §5
+        'Checkpoint / resume')."""
+        self.informer.flush()
+        self._dispatch()
+        # kwok fake kubelet fabricates due nodes before controllers run
+        if hasattr(self.cloud_provider, "tick"):
+            self.cloud_provider.tick()
+        self.informer.flush()
+        # Periodic sweeps stand in for the reference's RequeueAfter timers:
+        # registration waits on node appearance, liveness/expiration on the
+        # clock, termination on drain progress — all time-, not event-driven.
+        for claim in self.store.list("NodeClaim"):
+            self.lifecycle.reconcile(claim)
+            if self.store.try_get("NodeClaim", claim.metadata.name) is None:
+                continue
+            self.nc_disruption.reconcile(claim)
+            self.expiration.reconcile(claim)
+        for node in self.store.list(
+            "Node", predicate=lambda n: n.metadata.deletion_timestamp is not None
+        ):
+            self.termination.reconcile(node)
+        self.informer.flush()
+        self.provisioner.reconcile()
+        self.disruption.reconcile()
+        self.disruption_queue.reconcile()
+        self.eviction_queue.reconcile()
+        self.gc.reconcile()
+        self.informer.flush()
+        self.pod_metrics.reconcile()
+        self.node_metrics.reconcile()
+        self.nodepool_metrics.reconcile()
+
+    def run(self, passes: int = 1) -> None:
+        for _ in range(passes):
+            self.run_once()
+
+    def _dispatch(self) -> None:
+        for event in self._dispatch_watch.drain():
+            obj = event.obj
+            if event.kind == "Pod":
+                if event.type != DELETED and podutil.is_provisionable(obj):
+                    self.provisioner.trigger(obj.metadata.uid)
+                self.podevents.on_pod_event(obj)
+                if event.type == DELETED:
+                    self.pod_metrics.on_delete(
+                        obj.metadata.namespace, obj.metadata.name
+                    )
+            elif event.kind == "NodeClaim":
+                if event.type == DELETED:
+                    continue
+                live = self.store.try_get("NodeClaim", obj.metadata.name)
+                if live is None:
+                    continue
+                self.lifecycle.reconcile(live)
+                if self.store.try_get("NodeClaim", obj.metadata.name) is None:
+                    continue
+                self.nc_disruption.reconcile(live)
+                self.expiration.reconcile(live)
+                self.consistency.reconcile(live)
+                self.hydration.reconcile_claim(live)
+            elif event.kind == "Node":
+                if event.type == DELETED:
+                    continue
+                live = self.store.try_get("Node", obj.metadata.name)
+                if live is None:
+                    continue
+                self.termination.reconcile(live)
+                if self.store.try_get("Node", obj.metadata.name) is None:
+                    continue
+                self.health.reconcile(live)
+                self.hydration.reconcile_node(live)
+            elif event.kind == "NodePool":
+                if event.type == DELETED:
+                    continue
+                live = self.store.try_get("NodePool", obj.metadata.name)
+                if live is None:
+                    continue
+                self.np_hash.reconcile(live)
+                self.np_validation.reconcile(live)
+                self.np_readiness.reconcile(live)
+                self.np_registration_health.reconcile(live)
+                self.np_counter.reconcile(live)
+
+    # -- observability ------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        return global_registry.expose()
+
+    def healthy(self) -> bool:
+        return True

