@@ -87,7 +87,14 @@ class KwokCloudProvider(CloudProvider):
         created.status.capacity = dict(it.capacity)
         created.status.allocatable = dict(it.allocatable())
         created.status.image_id = "kwok-ami"
-        created.metadata.labels.update(reqs.labels())
+        # Stamp every single-valued In requirement from the claim, the chosen
+        # instance type, and the offering as node labels — the reference does
+        # this directly, bypassing restricted-label filtering, so nodes carry
+        # arch/os/zone labels (kwok/cloudprovider/cloudprovider.go:235-266).
+        for source in (reqs, it.requirements, offering.requirements):
+            for r in source:
+                if r.operator == "In" and len(r) == 1:
+                    created.metadata.labels[r.key] = r.values_list()[0]
         created.metadata.labels.update(
             {
                 wk.LABEL_INSTANCE_TYPE: it.name,

@@ -120,7 +120,7 @@ class Queue:
                     message=cmd.reason,
                     now=self.clock.now(),
                 )
-                self.store.update(claim)
+                self.store.apply(claim)
             except NotFound:
                 continue
             marked.append(candidate)

@@ -81,7 +81,7 @@ class Terminator:
     def taint(self, node: Node) -> None:
         if not any(t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
             node.spec.taints = list(node.spec.taints) + [DISRUPTED_NO_SCHEDULE_TAINT]
-            self.store.update(node)
+            self.store.apply(node)
 
     def drain(self, node: Node, grace_expiration: Optional[float]) -> Optional[str]:
         """Evict pods in groups, critical last; None when drained
@@ -166,11 +166,11 @@ class TerminationController:
                     CONDITION_DRAINED, "False", reason="Draining",
                     message=not_drained, now=self.clock.now(),
                 )
-                self.store.update(claim)
+                self.store.apply(claim)
             return
         if claim is not None and not claim.condition_is_true(CONDITION_DRAINED):
             claim.set_condition(CONDITION_DRAINED, "True", now=self.clock.now())
-            self.store.update(claim)
+            self.store.apply(claim)
 
         # volumes: all VolumeAttachments for drainable volumes must detach
         attachments = self.store.list(
@@ -186,11 +186,11 @@ class TerminationController:
                     message=f"{len(attachments)} volume attachment(s) remain",
                     now=self.clock.now(),
                 )
-                self.store.update(claim)
+                self.store.apply(claim)
             return
         if claim is not None and not claim.condition_is_true(CONDITION_VOLUMES_DETACHED):
             claim.set_condition(CONDITION_VOLUMES_DETACHED, "True", now=self.clock.now())
-            self.store.update(claim)
+            self.store.apply(claim)
 
         # instance termination
         if claim is not None:
@@ -199,7 +199,7 @@ class TerminationController:
                 claim.set_condition(
                     CONDITION_INSTANCE_TERMINATING, "True", now=self.clock.now()
                 )
-                self.store.update(claim)
+                self.store.apply(claim)
                 return  # wait for the instance to actually go away
             except NodeClaimNotFoundError:
                 pass

@@ -48,7 +48,7 @@ class DisruptionController:
             return
         self._reconcile_drift(pool, claim)
         self._reconcile_consolidatable(pool, claim)
-        self.store.update(claim)
+        self.store.apply(claim)
 
     # -- drift (drift.go:50-110) --------------------------------------------
 

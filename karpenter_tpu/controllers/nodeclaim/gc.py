@@ -156,7 +156,7 @@ class ConsistencyController:
             claim.set_condition(
                 CONDITION_CONSISTENT_STATE_FOUND, "True", now=self.clock.now()
             )
-        self.store.update(claim)
+        self.store.apply(claim)
 
 
 class PodEventsController:
@@ -188,7 +188,7 @@ class PodEventsController:
         if now - claim.status.last_pod_event_time < POD_EVENT_DEDUPE:
             return
         claim.status.last_pod_event_time = now
-        self.store.update(claim)
+        self.store.apply(claim)
 
 
 class HydrationController:
@@ -207,7 +207,7 @@ class HydrationController:
         key = node_class_label_key(ref.group, ref.kind)
         if key not in claim.metadata.labels:
             claim.metadata.labels[key] = ref.name
-            self.store.update(claim)
+            self.store.apply(claim)
 
     def reconcile_node(self, node) -> None:
         claim = next(
@@ -227,4 +227,4 @@ class HydrationController:
         key = node_class_label_key(ref.group, ref.kind)
         if key not in node.metadata.labels:
             node.metadata.labels[key] = ref.name
-            self.store.update(node)
+            self.store.apply(node)

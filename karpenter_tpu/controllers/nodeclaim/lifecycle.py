@@ -84,7 +84,7 @@ class LifecycleController:
             step(claim)
             if self.store.try_get("NodeClaim", claim.metadata.name) is None:
                 return  # a step deleted the claim
-        self.store.update(claim)
+        self.store.apply(claim)
 
     # -- launch (launch.go:45-124) ------------------------------------------
 
@@ -126,7 +126,7 @@ class LifecycleController:
             f for f in claim.metadata.finalizers if f != wk.TERMINATION_FINALIZER
         ]
         try:
-            self.store.update(claim)
+            self.store.apply(claim)
             self.store.delete(claim)
         except Exception:  # noqa: BLE001 — already gone
             pass
@@ -161,7 +161,7 @@ class LifecycleController:
             pool.set_condition(
                 CONDITION_NODE_REGISTRATION_HEALTHY, "True", now=self.clock.now()
             )
-            self.store.update(pool)
+            self.store.apply(pool)
 
     def _node_for_claim(self, claim: NodeClaim) -> Optional[Node]:
         matches = self.store.list(
@@ -199,7 +199,7 @@ class LifecycleController:
         ]
         node.metadata.labels.update(claim.metadata.labels)
         node.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] = "true"
-        self.store.update(node)
+        self.store.apply(node)
 
     # -- initialization (initialization.go:46-133) --------------------------
 
@@ -245,7 +245,7 @@ class LifecycleController:
                 )
                 return
         node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] = "true"
-        self.store.update(node)
+        self.store.apply(node)
         claim.set_condition(CONDITION_INITIALIZED, "True", now=now)
 
     # -- liveness (liveness.go:46-160) --------------------------------------
@@ -268,7 +268,7 @@ class LifecycleController:
                     message="Node not registered within registration TTL",
                     now=now,
                 )
-                self.store.update(pool)
+                self.store.apply(pool)
             self._delete_claim(claim, "liveness")
 
     # -- termination (controller.go:172-290) --------------------------------
@@ -288,7 +288,7 @@ class LifecycleController:
             claim.metadata.annotations[
                 wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
             ] = str(deadline)
-            self.store.update(claim)
+            self.store.apply(claim)
         # Linked nodes drain/terminate first (their own finalizer pipeline)
         nodes = self.store.list(
             "Node", predicate=lambda n: n.spec.provider_id == claim.status.provider_id
@@ -306,7 +306,7 @@ class LifecycleController:
                 claim.set_condition(
                     CONDITION_INSTANCE_TERMINATING, "True", now=self.clock.now()
                 )
-                self.store.update(claim)
+                self.store.apply(claim)
                 return  # wait for the instance to disappear
             except NodeClaimNotFoundError:
                 pass

@@ -44,14 +44,14 @@ class HashController:
                 claim.metadata.annotations[
                     wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
                 ] = NODEPOOL_HASH_VERSION
-                self.store.update(claim)
+                self.store.apply(claim)
         if (
             annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY) != current
             or stored_version != NODEPOOL_HASH_VERSION
         ):
             annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = current
             annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = NODEPOOL_HASH_VERSION
-            self.store.update(pool)
+            self.store.apply(pool)
 
 
 class CounterController:
@@ -67,7 +67,7 @@ class CounterController:
         node_count = int(resources.pop("nodes", 0.0))
         pool.status.resources = resources
         pool.status.node_count = node_count
-        self.store.update(pool)
+        self.store.apply(pool)
 
 
 class ReadinessController:
@@ -103,7 +103,7 @@ class ReadinessController:
             for t in (CONDITION_VALIDATION_SUCCEEDED, CONDITION_NODECLASS_READY)
         )
         pool.set_condition(CONDITION_READY, "True" if ready else "False", now=now)
-        self.store.update(pool)
+        self.store.apply(pool)
 
 
 class RegistrationHealthController:
@@ -125,13 +125,13 @@ class RegistrationHealthController:
                 reason="NodePoolChanged", message="NodePool spec changed",
                 now=self.clock.now(),
             )
-            self.store.update(pool)
+            self.store.apply(pool)
         elif pool.get_condition(CONDITION_NODE_REGISTRATION_HEALTHY) is None:
             pool.set_condition(
                 CONDITION_NODE_REGISTRATION_HEALTHY, "Unknown",
                 reason="Initializing", message="", now=self.clock.now(),
             )
-            self.store.update(pool)
+            self.store.apply(pool)
 
 
 class ValidationController:
@@ -152,7 +152,7 @@ class ValidationController:
                 CONDITION_VALIDATION_SUCCEEDED, "False",
                 reason="ValidationFailed", message=err, now=now,
             )
-        self.store.update(pool)
+        self.store.apply(pool)
 
     def _validate(self, pool: NodePool) -> str | None:
         for budget in pool.spec.disruption.budgets:

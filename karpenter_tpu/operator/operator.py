@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Optional
 
 from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.controllers.binding import BindingController
 from karpenter_tpu.controllers.disruption import Controller as DisruptionController
 from karpenter_tpu.controllers.disruption import Queue as DisruptionQueue
 from karpenter_tpu.controllers.metrics_controllers import (
@@ -108,6 +109,7 @@ class Operator:
         self.np_readiness = ReadinessController(store, self.clock)
         self.np_registration_health = RegistrationHealthController(store, self.clock)
         self.np_validation = ValidationController(store, self.clock)
+        self.binding = BindingController(store, self.cluster, self.clock, self.recorder)
         self.pod_metrics = PodMetricsController(store, self.cluster, self.clock)
         self.node_metrics = NodeMetricsController(self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(store, self.cluster)
@@ -143,6 +145,16 @@ class Operator:
         ):
             self.termination.reconcile(node)
         self.informer.flush()
+        # Fake kube-scheduler: bind placeable pods before provisioning so the
+        # solver only sees genuinely unsatisfiable demand.
+        self.binding.reconcile()
+        self.informer.flush()
+        # Reference requeues provisionable pods every 10s (provisioning/
+        # controller.go RequeueAfter): re-trigger each pass so pods left
+        # pending after a batch re-enter the next window instead of being
+        # stranded once their watch event is consumed.
+        for pending in self.store.list("Pod", predicate=podutil.is_provisionable):
+            self.provisioner.trigger(pending.metadata.uid)
         self.provisioner.reconcile()
         self.disruption.reconcile()
         self.disruption_queue.reconcile()

@@ -29,7 +29,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_tpu.apis import labels as wk
@@ -193,7 +193,7 @@ class GroupSolver:
             fn = jax.jit(
                 shard_map(
                     _solve_block, mesh=mesh, in_specs=in_specs,
-                    out_specs=out_specs, check_rep=False,
+                    out_specs=out_specs, check_vma=False,
                 )
             )
             self._sharded_fns[fn_key] = fn

@@ -80,9 +80,8 @@ class Store:
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._watches: list[Watch] = []
         self._version = 0
-        # repr snapshot at last write, for no-op update suppression (the
-        # reference's equality.Semantic.DeepEqual guard before every Patch)
-        self._last_repr: dict[tuple[str, tuple[str, str]], str] = {}
+        # repr snapshots backing apply()'s update-if-changed guard
+        self._applied_repr: dict[tuple[str, tuple[str, str]], str] = {}
 
     # -- watches -----------------------------------------------------------
 
@@ -109,7 +108,6 @@ class Store:
         if not obj.metadata.creation_timestamp:
             obj.metadata.creation_timestamp = self.clock.now()
         bucket[key] = obj
-        self._last_repr[(kind, key)] = repr(obj)
         self._emit(ADDED, obj)
         return obj
 
@@ -148,15 +146,9 @@ class Store:
                 f"{obj.KIND} {key}: version {current.metadata.resource_version} "
                 f"!= expected {expect_version}"
             )
-        # No-op suppression: unchanged objects neither bump versions nor emit
-        # events, so idempotent reconcilers don't re-trigger themselves.
-        new_repr = repr(obj)
-        if current is obj and self._last_repr.get((obj.KIND, key)) == new_repr:
-            return obj
         self._version += 1
         obj.metadata.resource_version = self._version
         bucket[key] = obj
-        self._last_repr[(obj.KIND, key)] = repr(obj)
         self._emit(MODIFIED, obj)
         # Deleting object whose finalizers were all stripped is removed now.
         if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
@@ -166,6 +158,26 @@ class Store:
     def touch(self, obj: Any) -> Any:
         """Update an object mutated in place (the common controller path)."""
         return self.update(obj)
+
+    def apply(self, obj: Any) -> Any:
+        """Update-if-changed: the reference guards every Patch with
+        equality.Semantic.DeepEqual so idempotent reconcilers don't re-emit
+        watch events and re-trigger themselves. Reconcile paths use this;
+        `update` keeps the strict always-bump apimachinery contract."""
+        key = (obj.KIND, _key(obj))
+        new_repr = repr(obj)
+        if self._applied_repr.get(key) == new_repr and _key(obj) in self._objects.get(
+            obj.KIND, {}
+        ):
+            return obj
+        out = self.update(obj)
+        # update() may have auto-removed the object (deletion_timestamp set,
+        # finalizers empty) — don't resurrect an orphaned snapshot.
+        if _key(obj) in self._objects.get(obj.KIND, {}):
+            self._applied_repr[key] = repr(obj)
+        else:
+            self._applied_repr.pop(key, None)
+        return out
 
     def delete(self, obj_or_kind: Any, name: str = "", namespace: str = "default") -> None:
         """Finalizer-aware delete (apimachinery graceful deletion)."""
@@ -180,7 +192,6 @@ class Store:
                 obj.metadata.deletion_timestamp = self.clock.now()
                 self._version += 1
                 obj.metadata.resource_version = self._version
-                self._last_repr[(obj.KIND, _key(obj))] = repr(obj)
                 self._emit(MODIFIED, obj)
             return
         self._remove(obj)
@@ -189,7 +200,7 @@ class Store:
         bucket = self._objects.get(obj.KIND, {})
         if bucket.pop(_key(obj), None) is not None:
             self._version += 1
-            self._last_repr.pop((obj.KIND, _key(obj)), None)
+            self._applied_repr.pop((obj.KIND, _key(obj)), None)
             self._emit(DELETED, obj)
 
     def remove_finalizer(self, obj: Any, finalizer: str) -> None:

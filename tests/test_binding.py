@@ -1,0 +1,163 @@
+"""BindingController: the fake kube-scheduler closing the e2e loop.
+
+The reference gets binding from the real kube-scheduler in its kwok E2E
+environment; these tests pin the stand-in's predicates (taints, labels,
+resources, host ports, volume limits, anti-affinity) and its change-detection
+short-circuit."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    Taint,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.binding import BindingController
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import node_claim_pair, unschedulable_pod
+
+
+def make_binder():
+    clock = FakeClock()
+    store = Store(clock=clock)
+    cluster = Cluster(clock, store, FakeCloudProvider())
+    informer = StateInformer(store, cluster)
+    binder = BindingController(store, cluster, clock, Recorder(clock=clock))
+    return clock, store, cluster, informer, binder
+
+
+def add_node(store, informer, name="n1", **kwargs):
+    node, claim = node_claim_pair(name, **kwargs)
+    store.create(claim)
+    store.create(node)
+    informer.flush()
+    return node, claim
+
+
+class TestBinding:
+    def test_binds_fitting_pod(self):
+        clock, store, cluster, informer, binder = make_binder()
+        add_node(store, informer)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        informer.flush()
+        assert binder.reconcile() == 1
+        pod = store.get("Pod", pod.metadata.name)
+        assert pod.spec.node_name == "n1"
+        assert not any(
+            c.type == "PodScheduled" and c.status == "False"
+            for c in pod.status.conditions
+        )
+
+    def test_marks_unplaceable_pod_unschedulable(self):
+        clock, store, cluster, informer, binder = make_binder()
+        pod = store.create(unschedulable_pod(requests={"cpu": "100"}))
+        pod.status.conditions = []  # fresh pod, never seen by a scheduler
+        informer.flush()
+        binder.reconcile()
+        pod = store.get("Pod", pod.metadata.name)
+        assert any(
+            c.type == "PodScheduled" and c.reason == "Unschedulable"
+            for c in pod.status.conditions
+        )
+
+    def test_respects_taints(self):
+        clock, store, cluster, informer, binder = make_binder()
+        node, claim = node_claim_pair("n1")
+        node.spec.taints = [Taint(key="dedicated", value="gpu", effect="NoSchedule")]
+        store.create(claim)
+        store.create(node)
+        informer.flush()
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        informer.flush()
+        assert binder.reconcile() == 0
+
+    def test_respects_node_selector(self):
+        clock, store, cluster, informer, binder = make_binder()
+        add_node(store, informer, zone="kwok-zone-1")
+        store.create(
+            unschedulable_pod(
+                requests={"cpu": "1"},
+                node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"},
+            )
+        )
+        informer.flush()
+        assert binder.reconcile() == 0
+
+    def test_respects_resources_across_binds(self):
+        clock, store, cluster, informer, binder = make_binder()
+        add_node(store, informer, capacity={"cpu": "3", "memory": "16Gi", "pods": "110"})
+        for _ in range(3):
+            store.create(unschedulable_pod(requests={"cpu": "2"}))
+        informer.flush()
+        # only one 2-cpu pod fits on a 3-cpu node; the sweep must account for
+        # its own earlier binds within the same pass
+        assert binder.reconcile() == 1
+
+    def test_required_anti_affinity_blocks_second_pod(self):
+        clock, store, cluster, informer, binder = make_binder()
+        add_node(store, informer)
+        term = PodAffinityTerm(
+            topology_key=wk.LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"app": "db"}),
+        )
+        for _ in range(2):
+            pod = unschedulable_pod(requests={"cpu": "1"}, labels={"app": "db"})
+            pod.spec.affinity = Affinity(
+                pod_anti_affinity=PodAntiAffinity(required=[term])
+            )
+            store.create(pod)
+        informer.flush()
+        assert binder.reconcile() == 1
+
+    def test_inverse_anti_affinity_blocks_candidate(self):
+        clock, store, cluster, informer, binder = make_binder()
+        node, _ = add_node(store, informer)
+        # a placed pod with anti-affinity against app=web
+        placed = unschedulable_pod(requests={"cpu": "1"}, labels={"app": "db"})
+        placed.spec.affinity = Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ]
+            )
+        )
+        placed.spec.node_name = node.metadata.name
+        store.create(placed)
+        informer.flush()
+        candidate = store.create(
+            unschedulable_pod(requests={"cpu": "1"}, labels={"app": "web"})
+        )
+        informer.flush()
+        assert binder.reconcile() == 0
+        assert store.get("Pod", candidate.metadata.name).spec.node_name == ""
+
+    def test_skips_sweep_when_store_unchanged(self):
+        clock, store, cluster, informer, binder = make_binder()
+        add_node(store, informer)
+        store.create(unschedulable_pod(requests={"cpu": "100"}))  # can't fit
+        informer.flush()
+        binder.reconcile()
+        v = store.resource_version
+        assert binder.reconcile() == 0
+        assert store.resource_version == v
+
+    def test_prefers_nominated_claim_node(self):
+        clock, store, cluster, informer, binder = make_binder()
+        add_node(store, informer, "n1")
+        add_node(store, informer, "n2")
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        informer.flush()
+        key = (pod.metadata.namespace, pod.metadata.name)
+        cluster.pod_to_node_claim[key] = "n2-claim"
+        binder.reconcile()
+        assert store.get("Pod", pod.metadata.name).spec.node_name == "n2"
