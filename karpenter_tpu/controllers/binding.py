@@ -44,6 +44,7 @@ class BindingController:
         self.clock = clock
         self.recorder = recorder
         self._last_version = -1
+        self._pods_by_node: dict[str, list[Pod]] = {}
 
     def reconcile(self) -> int:
         """Bind every placeable unbound pod; mark the rest Unschedulable.
@@ -52,6 +53,11 @@ class BindingController:
         # last sweep, so every fit decision would come out identical.
         if self.store.resource_version == self._last_version:
             return 0
+        # One pods-by-node index per sweep: the anti-affinity checks would
+        # otherwise re-scan the whole Pod collection per candidate node.
+        self._pods_by_node: dict[str, list[Pod]] = {}
+        for p in self.store.list("Pod", predicate=lambda p: p.spec.node_name != ""):
+            self._pods_by_node.setdefault(p.spec.node_name, []).append(p)
         bound = 0
         for pod in self.store.list("Pod", predicate=self._needs_binding):
             node = self._find_fit(pod)
@@ -132,7 +138,7 @@ class BindingController:
             for other in self.cluster.nodes.values():
                 if other.node is None or other.labels().get(term.topology_key) != domain:
                     continue
-                for placed in other.pods(self.store):
+                for placed in self._pods_by_node.get(other.node.metadata.name, ()):
                     if self._term_matches(term, pod.metadata.namespace, placed):
                         return False
         # Inverse: already-placed pods with required anti-affinity must not
@@ -187,6 +193,7 @@ class BindingController:
         # Keep the live mirror current within this pass so subsequent binds
         # in the same sweep see the node's reduced headroom.
         self.cluster.update_pod(pod)
+        self._pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
         _PODS_BOUND.inc()
         self.recorder.publish(
             Event(pod, "Normal", "Scheduled", f"bound to {sn.node.metadata.name}")

@@ -10,7 +10,10 @@ from typing import Optional, Sequence
 
 from karpenter_tpu.apis.nodeclaim import CONDITION_DISRUPTION_REASON
 from karpenter_tpu.cloudprovider.types import CloudProvider
-from karpenter_tpu.controllers.disruption.consolidation import Consolidation
+from karpenter_tpu.controllers.disruption.consolidation import (
+    CONSOLIDATION_TTL,
+    Consolidation,
+)
 from karpenter_tpu.controllers.disruption.helpers import (
     build_disruption_budget_mapping,
     get_candidates,
@@ -23,6 +26,7 @@ from karpenter_tpu.controllers.disruption.methods import (
 )
 from karpenter_tpu.controllers.disruption.queue import Queue
 from karpenter_tpu.controllers.disruption.types import DECISION_NOOP
+from karpenter_tpu.controllers.disruption.validation import ValidationError
 from karpenter_tpu.events.recorder import Recorder
 from karpenter_tpu.metrics import global_registry, measure
 from karpenter_tpu.runtime.store import Store
@@ -94,13 +98,22 @@ class Controller:
             )
         )
         self._next_run = 0.0
+        # (command, method) awaiting TTL re-validation — two-phase validation:
+        # the reference parks a goroutine on the TTL while informers keep
+        # running (validation.go:152-282); the cooperative loop parks the
+        # command instead and re-validates on a later pass so the churn
+        # re-check sees genuinely fresh state.
+        self._pending: Optional[tuple] = None
+        self._pending_due = 0.0
 
     def reconcile(self) -> bool:
         """One pass; returns True if a command was started (requeue fast)."""
-        if self.clock.now() < self._next_run:
+        if self.clock.now() < self._next_run and self._pending is None:
             return False
         if not self.cluster.synced():
             return False
+        if self._pending is not None:
+            return self._revalidate_pending()
         # Clean leftover disruption taints/conditions from restarts or
         # abandoned commands (controller.go:131-152).
         outdated = [
@@ -116,6 +129,22 @@ class Controller:
                 return True
         self._next_run = self.clock.now() + POLLING_PERIOD
         return False
+
+    def _revalidate_pending(self) -> bool:
+        """Phase two: the TTL elapsed — re-verify against fresh state and
+        start the command, or abandon it (validation.go:152-282)."""
+        if self.clock.now() < self._pending_due:
+            return False
+        cmd, method = self._pending
+        self._pending = None
+        try:
+            cmd = method.validator.validate(cmd)
+        except ValidationError:
+            return False
+        cmd.creation_timestamp = self.clock.now()
+        cmd.method = method
+        self.queue.start_command(cmd)
+        return True
 
     def _disrupt(self, method) -> bool:
         """controller.go:169-206."""
@@ -145,6 +174,11 @@ class Controller:
             cmd = method.compute_command(budgets, *candidates)
             if cmd.decision() == DECISION_NOOP:
                 return False
+            if getattr(method, "validator", None) is not None:
+                # Park for TTL re-validation instead of starting immediately.
+                self._pending = (cmd, method)
+                self._pending_due = self.clock.now() + CONSOLIDATION_TTL
+                return True
             cmd.creation_timestamp = self.clock.now()
             cmd.method = method
             self.queue.start_command(cmd)

@@ -16,10 +16,7 @@ from karpenter_tpu.apis.nodepool import (
     DISRUPTION_REASON_EMPTY,
     DISRUPTION_REASON_UNDERUTILIZED,
 )
-from karpenter_tpu.controllers.disruption.consolidation import (
-    CONSOLIDATION_TTL,
-    Consolidation,
-)
+from karpenter_tpu.controllers.disruption.consolidation import Consolidation
 from karpenter_tpu.controllers.disruption.helpers import (
     CandidateDeletingError,
     simulate_scheduling,
@@ -37,7 +34,6 @@ from karpenter_tpu.controllers.disruption.types import (
 from karpenter_tpu.controllers.disruption.validation import (
     ConsolidationValidator,
     EmptinessValidator,
-    ValidationError,
 )
 from karpenter_tpu.events.recorder import Event
 from karpenter_tpu.scheduling.requirements import Requirements
@@ -91,11 +87,9 @@ class Emptiness:
             if not constrained:
                 self.c.mark_consolidated()
             return Command()
-        cmd = Command(candidates=empty)
-        try:
-            return self.validator.validate(cmd, CONSOLIDATION_TTL)
-        except ValidationError:
-            return Command()
+        # Unvalidated: the disruption controller holds the command for
+        # CONSOLIDATION_TTL and runs self.validator on a later pass.
+        return Command(candidates=empty)
 
 
 class Drift:
@@ -194,10 +188,8 @@ class MultiNodeConsolidation:
             if not constrained:
                 self.c.mark_consolidated()
             return cmd
-        try:
-            return self.validator.validate(cmd, CONSOLIDATION_TTL)
-        except ValidationError:
-            return Command()
+        # Unvalidated: two-phase validation happens in the controller.
+        return cmd
 
     def _first_n_consolidation_option(
         self, candidates: list[Candidate], max_n: int
@@ -296,10 +288,7 @@ class SingleNodeConsolidation:
             cmd = self.c.compute_consolidation(candidate)
             if cmd.decision() == DECISION_NOOP:
                 continue
-            try:
-                self.validator.validate(cmd, CONSOLIDATION_TTL)
-            except ValidationError:
-                return Command()
+            # Unvalidated: two-phase validation happens in the controller.
             return cmd
         if not constrained:
             self.c.mark_consolidated()

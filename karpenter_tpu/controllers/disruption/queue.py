@@ -169,40 +169,40 @@ class Queue:
 
     def _wait_or_terminate(self, cmd: Command) -> bool:
         """True when the command finished; raises UnrecoverableError on
-        timeout or deleted replacement (queue.go:159-233)."""
-        try:
-            waiting = False
-            for replacement in cmd.replacements:
-                if replacement.initialized:
-                    continue
-                claim = self.store.try_get("NodeClaim", replacement.name)
-                if claim is None:
-                    if not self.cluster.node_claim_exists(replacement.name):
-                        raise UnrecoverableError("replacement was deleted")
-                    waiting = True
-                    continue
+        timeout or deleted replacement (queue.go:159-233). The timeout is
+        checked only on the waiting path: the reference's defer runs after
+        candidate deletion, so a command completing on the pass it crosses
+        MAX_RETRY_DURATION still deletes its candidates instead of rolling
+        back with replacements already launched."""
+        waiting = False
+        for replacement in cmd.replacements:
+            if replacement.initialized:
+                continue
+            claim = self.store.try_get("NodeClaim", replacement.name)
+            if claim is None:
+                if not self.cluster.node_claim_exists(replacement.name):
+                    raise UnrecoverableError("replacement was deleted")
+                waiting = True
+                continue
+            self.recorder.publish(
+                Event(claim, "Normal", "DisruptionLaunching", f"Launching NodeClaim: {cmd.reason}")
+            )
+            if not claim.condition_is_true(CONDITION_INITIALIZED):
                 self.recorder.publish(
-                    Event(claim, "Normal", "DisruptionLaunching", f"Launching NodeClaim: {cmd.reason}")
-                )
-                if not claim.condition_is_true(CONDITION_INITIALIZED):
-                    self.recorder.publish(
-                        Event(
-                            claim,
-                            "Normal",
-                            "DisruptionWaitingReadiness",
-                            "Waiting on readiness to continue disruption",
-                        )
+                    Event(
+                        claim,
+                        "Normal",
+                        "DisruptionWaitingReadiness",
+                        "Waiting on readiness to continue disruption",
                     )
-                    waiting = True
-                    continue
-                replacement.initialized = True
-            if waiting:
-                return False
-        except UnrecoverableError:
-            raise
-        finally:
+                )
+                waiting = True
+                continue
+            replacement.initialized = True
+        if waiting:
             if self.clock.since(cmd.creation_timestamp) > MAX_RETRY_DURATION:
                 raise UnrecoverableError("command reached timeout")
+            return False
         # all replacements initialized: delete the candidates
         for candidate in cmd.candidates:
             claim = self.store.try_get("NodeClaim", candidate.node_claim.metadata.name)

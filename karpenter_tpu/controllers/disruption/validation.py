@@ -27,15 +27,20 @@ class ValidationError(Exception):
 
 
 class _BaseValidator:
+    """Validation is two-phase across controller passes: the disruption
+    controller records a computed command with a TTL deadline and calls
+    `validate` on a LATER reconcile pass, after informers and other
+    controllers have run — so the churn re-check observes genuinely fresh
+    state. (The reference blocks a goroutine on the TTL while informers run
+    concurrently, validation.go:152-282; a blocking sleep in this
+    single-threaded loop would stall every controller AND make the re-check
+    vacuous.)"""
+
     def __init__(self, c, reason: str, filter_: Callable[[Candidate], bool], vtype: str):
         self.c = c
         self.reason = reason
         self.filter = filter_
         self.validation_type = vtype
-
-    def _wait(self, period: float) -> None:
-        if period > 0:
-            self.c.clock.sleep(period)
 
     def _fresh_candidates(self, candidates: list[Candidate]) -> list[Candidate]:
         fresh = get_candidates(
@@ -64,8 +69,7 @@ class EmptinessValidator(_BaseValidator):
 
         return Emptiness(self.c, validator=self).should_disrupt(candidate)
 
-    def validate(self, cmd: Command, period: float) -> Command:
-        self._wait(period)
+    def validate(self, cmd: Command) -> Command:
         validated = self._fresh_candidates(cmd.candidates)
         if not validated:
             raise ValidationError(f"{len(cmd.candidates)} candidates are no longer valid")
@@ -99,8 +103,7 @@ class ConsolidationValidator(_BaseValidator):
             c, DISRUPTION_REASON_UNDERUTILIZED, method.should_disrupt, vtype
         )
 
-    def validate(self, cmd: Command, period: float) -> Command:
-        self._wait(period)
+    def validate(self, cmd: Command) -> Command:
         validated = self._validate_candidates(cmd.candidates)
         self._validate_command(cmd, validated)
         self._validate_candidates(validated)

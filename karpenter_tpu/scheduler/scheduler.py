@@ -82,6 +82,11 @@ class Results:
     new_node_claims: list[NodeClaim]
     existing_nodes: list[ExistingNode]
     pod_errors: dict[Pod, Exception]
+    # The solve hit its timeout: unprocessed pods get a pod_errors entry and
+    # all_non_pending_pods_scheduled() returns False, so consolidation/drift
+    # simulations can't treat a truncated solve as fully scheduled (the
+    # reference surfaces ctx.Err() to callers).
+    timed_out: bool = False
 
     def record(self, recorder: Recorder, cluster: Cluster) -> None:
         for p, err in self.pod_errors.items():
@@ -119,6 +124,8 @@ class Results:
         }
 
     def all_non_pending_pods_scheduled(self) -> bool:
+        if self.timed_out:
+            return False
         return not [
             p for p in self.pod_errors if not podutil.is_provisionable(p)
         ]
@@ -306,11 +313,25 @@ class Scheduler:
             self.update_cached_pod_data(p)
         q = Queue(pods, self.cached_pod_data)
         start = self.clock.now()
+        timed_out = False
         while True:
             pod = q.pop()
             if pod is None:
                 break
             if timeout is not None and self.clock.now() - start > timeout:
+                # Surface the truncation: the popped pod and everything left
+                # in the queue were never attempted this round.
+                timed_out = True
+                pod_errors.setdefault(
+                    pod, TimeoutError("scheduling simulation timed out")
+                )
+                while True:
+                    rest = q.pop()
+                    if rest is None:
+                        break
+                    pod_errors.setdefault(
+                        rest, TimeoutError("scheduling simulation timed out")
+                    )
                 break
             try:
                 self._try_schedule(copy.deepcopy(pod))
@@ -327,6 +348,7 @@ class Scheduler:
             new_node_claims=self.new_node_claims,
             existing_nodes=self.existing_nodes,
             pod_errors=pod_errors,
+            timed_out=timed_out,
         )
 
     def _try_schedule(self, p: Pod) -> None:

@@ -13,6 +13,7 @@ from karpenter_tpu.apis.nodepool import Budget
 from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
 from karpenter_tpu.controllers.disruption import Controller as DisruptionController
 from karpenter_tpu.controllers.disruption import Queue as DisruptionQueue
+from karpenter_tpu.controllers.disruption.consolidation import CONSOLIDATION_TTL
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.events.recorder import Recorder
 from karpenter_tpu.operator.options import Options
@@ -54,9 +55,16 @@ class Env:
         return node, claim
 
     def reconcile(self):
+        """One reconcile, driving two-phase validation through its TTL: a
+        command computed on the first pass parks for CONSOLIDATION_TTL and
+        starts on a later pass (validation.go:152-282)."""
         self.informer.flush()
         out = self.controller.reconcile()
         self.informer.flush()
+        if self.controller._pending is not None:
+            self.clock.step(CONSOLIDATION_TTL + 0.1)
+            out = self.controller.reconcile()
+            self.informer.flush()
         return out
 
 
@@ -75,6 +83,28 @@ class TestEmptiness:
         env.queue.reconcile()
         env.informer.flush()
         assert env.store.try_get("NodeClaim", "empty-1-claim") is None
+
+    def test_validation_sees_churn_between_phases(self):
+        """A pod landing on the empty node during the validation TTL must
+        abandon the command — the churn re-check the two-phase design exists
+        for (validation.go:152-282)."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair("empty-1")
+        env.informer.flush()
+        assert env.controller.reconcile() is True  # phase one: parked
+        assert env.controller._pending is not None
+        # a pod binds to the node while the command waits out its TTL
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        bind_pod(pod, node)
+        env.store.create(pod)
+        env.informer.flush()
+        env.clock.step(CONSOLIDATION_TTL + 0.1)
+        assert env.controller.reconcile() is False  # phase two: abandoned
+        assert env.controller._pending is None
+        env.queue.reconcile()
+        env.informer.flush()
+        assert env.store.try_get("NodeClaim", "empty-1-claim") is not None
 
     def test_node_with_pods_not_empty(self):
         env = Env()
