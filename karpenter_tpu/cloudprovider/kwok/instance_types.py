@@ -39,6 +39,24 @@ def price_of(cpu: float, mem_gib: float, capacity_type: str) -> float:
 
 
 def construct_instance_types() -> list[InstanceType]:
+    """Memoized: every caller shares the same InstanceType objects, so the
+    provisioner's id-keyed CatalogEngine cache hits across provider
+    instances (one device encode + compile per process). The returned list
+    is a fresh copy; the elements are shared and must not be mutated."""
+    return list(_construct_instance_types_cached())
+
+
+def _construct_instance_types_cached() -> tuple[InstanceType, ...]:
+    global _CATALOG
+    if _CATALOG is None:
+        _CATALOG = tuple(_build_instance_types())
+    return _CATALOG
+
+
+_CATALOG = None
+
+
+def _build_instance_types() -> list[InstanceType]:
     out: list[InstanceType] = []
     for cpu in CPU_SIZES:
         for family, ratio in MEM_RATIOS.items():

@@ -49,6 +49,60 @@ class NoNodePoolsError(Exception):
     pass
 
 
+_ENGINE_CONTENT_CACHE: dict[tuple, object] = {}
+
+
+def _type_fingerprint(it) -> tuple:
+    return (
+        it.name,
+        tuple(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in it.requirements
+        ),
+        tuple(
+            (o.zone, o.capacity_type, o.price, o.available, o.reservation_id)
+            for o in it.offerings
+        ),
+        tuple(sorted(it.capacity.items())),
+        tuple(sorted(it.overhead.total().items())),
+    )
+
+
+def default_engine_factory():
+    """CatalogEngine per distinct instance-type union. Two cache levels: an
+    id-keyed fast path (providers return stable InstanceType objects, so the
+    steady-state lookup is free) backed by a process-wide content-keyed cache
+    so equal catalogs built by different provider instances share one encode
+    + compile."""
+    from karpenter_tpu.ops.catalog import CatalogEngine
+
+    id_cache: dict[tuple, object] = {}
+
+    def factory(instance_types: dict):
+        seen: set[int] = set()
+        all_types = []
+        for its in instance_types.values():
+            for it in its:
+                if id(it) not in seen:
+                    seen.add(id(it))
+                    all_types.append(it)
+        if not all_types:
+            return None
+        id_key = tuple(sorted(seen))
+        engine = id_cache.get(id_key)
+        if engine is None:
+            content_key = tuple(_type_fingerprint(it) for it in all_types)
+            engine = _ENGINE_CONTENT_CACHE.get(content_key)
+            if engine is None:
+                engine = CatalogEngine(all_types)
+                _ENGINE_CONTENT_CACHE[content_key] = engine
+            # hold type refs so ids stay unique for the cache key's lifetime
+            id_cache[id_key] = engine
+        return engine
+
+    return factory
+
+
 class Provisioner:
     def __init__(
         self,
@@ -72,8 +126,12 @@ class Provisioner:
             max_duration=self.options.batch_max_duration,
         )
         self.volume_topology = VolumeTopology(store)
-        # Optional CatalogEngine factory for the device-backed filter path
-        self.engine_factory = engine_factory
+        # CatalogEngine factory for the device-backed solver path. Defaults
+        # ON (options.solver_backend == "tpu"): the fast path IS the real
+        # path; pass solver_backend="host" or engine_factory=False to opt out.
+        if engine_factory is None and self.options.solver_backend == "tpu":
+            engine_factory = default_engine_factory()
+        self.engine_factory = engine_factory or None
 
     def trigger(self, uid: str) -> None:
         self.batcher.trigger(uid)

@@ -1,0 +1,678 @@
+"""Device-accelerated first-fit-decreasing: the TPU fast path the
+Provisioner actually executes.
+
+The reference's solver is a per-pod loop — Pop → try existing nodes →
+try in-flight claims (emptiest first) → open a new claim from the weighted
+templates (scheduler.go:346-401, :451-557). Its hottest inner op is
+`filterInstanceTypesByRequirements` over every instance type
+(nodeclaim.go:373-441). This module keeps the FFD skeleton host-side but
+reshapes the work TPU-first (SURVEY.md §7 step 3):
+
+1. Pods collapse into groups of identical (requirements, requests) shapes —
+   a 50k-pod batch is typically a few hundred shapes.
+2. ONE fused device call computes the full feasibility cube
+   compat ∧ has-offering over [G groups × I instance types]
+   (CatalogEngine.feasibility — membership matmuls on the MXU).
+3. The sequential FFD loop then runs over G groups (not P pods), operating
+   on CLAIM CLASSES — sets of identical in-flight claims — with vectorized
+   numpy splits/fills. Claim requirement algebra reuses the exact host
+   `Requirements` implementation, so join decisions match the host solver's
+   `NodeClaim.can_add` compatibility semantics bit-for-bit.
+4. A final batched device verification re-filters every class against its
+   ACCUMULATED requirements (set intersection is not pairwise-decomposable:
+   per-group feasibility intersection can be looser than joint feasibility).
+   Any discrepancy aborts the fast path and the caller falls back to the
+   host loop — the fast path never ships a looser answer.
+
+Eligibility is checked first (`eligible`): pods with pod (anti-)affinity,
+topology spread, preferred node affinity, host ports, or volumes — and
+solves involving reserved capacity or minValues — take the host path, which
+remains the semantics oracle.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Pod
+from karpenter_tpu.ops import feasibility as feas
+from karpenter_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    Requirements,
+)
+from karpenter_tpu.scheduling.taints import Taints
+from karpenter_tpu.utils import resources as res
+
+if TYPE_CHECKING:
+    from karpenter_tpu.ops.catalog import CatalogEngine
+
+# Below this batch size the host per-pod loop is comfortably fast and covers
+# every feature; the device path's fixed costs don't pay off.
+DEVICE_MIN_PODS = 64
+
+# Observability: how often the fast path ran vs fell back (tests assert on
+# the module counters; metrics expose them to operators).
+DEVICE_SOLVES = 0
+DEVICE_FALLBACKS = 0
+# Existing-node fill is host-vectorized per group; cap the node count so the
+# host compat checks stay off the critical path (large clusters fall back).
+DEVICE_MAX_EXISTING = 512
+
+
+# -- eligibility -------------------------------------------------------------
+
+
+def eligible(scheduler, pods: Sequence[Pod]) -> bool:
+    """True when the device path can reproduce host semantics for this solve
+    (solve-level gates; per-pod gates run once per GROUP during grouping)."""
+    if scheduler.engine is None:
+        return False
+    if len(pods) < DEVICE_MIN_PODS:
+        return False
+    if len(scheduler.existing_nodes) > DEVICE_MAX_EXISTING:
+        return False
+    # Topology machinery engaged (spread/affinity groups, incl. inverse
+    # anti-affinity from cluster pods) → host.
+    if getattr(scheduler.topology, "topology_groups", None):
+        return False
+    # Reserved capacity and minValues interplay stays host-side.
+    if scheduler.reserved_capacity_enabled and any(
+        o.capacity_type == wk.CAPACITY_TYPE_RESERVED
+        for it in scheduler.engine.instance_types
+        for o in it.offerings
+    ):
+        return False
+    for nct in scheduler.nodeclaim_templates:
+        if nct.requirements.has_min_values():
+            return False
+    return True
+
+
+def _group_eligible(pod: Pod) -> bool:
+    """Per-shape gates, checked once per distinct pod shape."""
+    spec = pod.spec
+    aff = spec.affinity
+    if aff is not None:
+        if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
+            return False
+        na = aff.node_affinity
+        if na is not None and (na.preferred or len(na.required) > 1):
+            return False
+    if spec.topology_spread_constraints:
+        return False
+    if any(c.ports for c in spec.containers):
+        return False
+    if getattr(spec, "volumes", None):
+        return False
+    return True
+
+
+# -- grouping ----------------------------------------------------------------
+
+
+class _Group:
+    __slots__ = (
+        "pods", "reqs", "strict_reqs", "requests", "requests_q", "sort_key",
+        "placed_existing",
+    )
+
+    def __init__(self, pod: Pod, data):
+        self.pods: list[Pod] = [pod]
+        self.reqs: Requirements = data.requirements
+        self.strict_reqs: Requirements = data.strict_requirements
+        self.requests: dict = data.requests
+        self.requests_q: Optional[np.ndarray] = None
+        self.placed_existing = 0
+        self.sort_key = (
+            -data.requests.get(wk.RESOURCE_CPU, 0.0),
+            -data.requests.get(wk.RESOURCE_MEMORY, 0.0),
+            pod.metadata.creation_timestamp,
+            pod.metadata.uid,
+        )
+
+
+def _raw_sig(pod: Pod) -> tuple:
+    """Cheap value-signature over every spec field that can influence an
+    ELIGIBLE pod's scheduling: selector, single required affinity term,
+    container resources, tolerations, and the eligibility-gate fields
+    themselves (so an ineligible pod can never hide inside an eligible
+    group). Runs once per pod — keep it allocation-light."""
+    spec = pod.spec
+    aff = spec.affinity
+    aff_sig: tuple = ()
+    gates = 0
+    if aff is not None:
+        if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
+            gates |= 1
+        na = aff.node_affinity
+        if na is not None:
+            if na.preferred:
+                gates |= 2
+            aff_sig = tuple(
+                tuple(
+                    (e["key"], e["operator"], tuple(e.get("values", ())))
+                    for e in term.match_expressions
+                )
+                for term in na.required
+            )
+    if spec.topology_spread_constraints:
+        gates |= 4
+    if getattr(spec, "volumes", None):
+        gates |= 8
+    containers = []
+    for c in spec.containers:
+        containers.append(
+            (
+                tuple(sorted(c.requests.items())),
+                tuple(sorted(c.limits.items())) if c.limits else (),
+                len(c.ports),
+                c.restart_policy,
+            )
+        )
+    inits = ()
+    if spec.init_containers:
+        inits = tuple(
+            (
+                tuple(sorted(c.requests.items())),
+                tuple(sorted(c.limits.items())) if c.limits else (),
+                c.restart_policy,
+            )
+            for c in spec.init_containers
+        )
+    return (
+        tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
+        aff_sig,
+        gates,
+        tuple(containers),
+        inits,
+        tuple(sorted(spec.overhead.items())) if spec.overhead else (),
+        tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations)
+        if spec.tolerations
+        else (),
+    )
+
+
+def _group_pods(scheduler, pods: Sequence[Pod]) -> Optional[list[_Group]]:
+    """Collapse pods into value-identical shape groups, ordered by the host
+    queue's FFD key (queue.go:72-108). PodData is computed ONCE per group
+    and shared into the scheduler's cache — the per-pod host parse is the
+    single biggest cost at 50k pods. Returns None when a shape fails the
+    per-group eligibility gates (→ host path)."""
+    groups: dict[tuple, _Group] = {}
+    order: list[_Group] = []
+    for pod in pods:
+        sig = _raw_sig(pod)
+        g = groups.get(sig)
+        if g is None:
+            if not _group_eligible(pod):
+                return None
+            scheduler.update_cached_pod_data(pod)
+            data = scheduler.cached_pod_data[pod.metadata.uid]
+            g = _Group(pod, data)
+            groups[sig] = g
+            order.append(g)
+        else:
+            g.pods.append(pod)
+            scheduler.cached_pod_data[pod.metadata.uid] = scheduler.cached_pod_data[
+                g.pods[0].metadata.uid
+            ]
+    order.sort(key=lambda g: g.sort_key)
+    return order
+
+
+# -- claim classes -----------------------------------------------------------
+
+
+class _ClaimClass:
+    """`n_claims` identical in-flight NodeClaims: same template, same
+    accumulated requirements, same usage, same member-pod composition."""
+
+    __slots__ = (
+        "template", "reqs", "types", "usage_q", "pods_per_claim",
+        "n_claims", "members",
+    )
+
+    def __init__(self, template, reqs, types, usage_q, pods_per_claim, n_claims, members):
+        self.template = template
+        self.reqs = reqs  # host Requirements — accumulated, exact algebra
+        self.types = types  # np.ndarray [I] bool
+        self.usage_q = usage_q  # np.ndarray [D] int64 quantized usage
+        self.pods_per_claim = pods_per_claim  # int
+        self.n_claims = n_claims  # int
+        self.members = members  # list[(group_index, pods_of_group_per_claim)]
+
+
+def _intersect(reqs_a: Requirements, reqs_b: Requirements) -> Requirements:
+    out = Requirements(*reqs_a.values())
+    out.add(*reqs_b.values())
+    return out
+
+
+def _narrows(base: Requirements, incoming: Requirements) -> bool:
+    """True when `incoming` constrains a key `base` already constrains with a
+    different value set — the condition under which joint feasibility can be
+    strictly tighter than the intersection of per-source feasibilities."""
+    for r in incoming:
+        if base.has(r.key) and base.get(r.key) != r:
+            return True
+    return False
+
+
+class _DeviceSolve:
+    def __init__(self, scheduler, pods: Sequence[Pod]):
+        self.s = scheduler
+        self.engine: "CatalogEngine" = scheduler.engine
+        self.pods = pods
+        self.pod_errors: dict[Pod, Exception] = {}
+        e = self.engine
+        self.D = len(e.resource_dims)
+        self.scales = feas.resource_scales(e.resource_dims)
+        self.alloc_q = feas.quantize_resources(
+            e.allocatable, ceil=False, scales=self.scales
+        )  # [I, D] int64, floor — conservative vs host float
+        self.type_index = {id(it): i for i, it in enumerate(e.instance_types)}
+        # name fallback: a content-cache-hit engine holds equal-content types
+        # under different object identities
+        self._name_index = {it.name: i for i, it in enumerate(e.instance_types)}
+        self.classes: list[_ClaimClass] = []
+        self.groups: list[_Group] = []
+        # Scheduler state is NOT mutated until the final verification passes:
+        # a fallback to the host loop must start from pristine state.
+        self.existing_fills: list[tuple[int, int, int, int]] = []  # (node, group, start, count)
+        self.existing_reqs: dict[int, Requirements] = {}  # live accumulated node reqs
+        self.remaining_resources = {
+            name: dict(rl) for name, rl in scheduler.remaining_resources.items()
+        }
+        # Joint-requirement verification is only needed when two sources
+        # constrained the SAME key with DIFFERENT value sets — that's the only
+        # way per-group feasibility intersection can be looser than joint
+        # feasibility (set intersection isn't pairwise-decomposable).
+        self.needs_verify = False
+
+    # -- encoding ------------------------------------------------------------
+
+    def _encode(self) -> bool:
+        e = self.engine
+        groups = _group_pods(self.s, self.pods)
+        if groups is None:
+            return False
+        self.groups = groups
+        G = len(self.groups)
+        requests = np.zeros((G, self.D), dtype=np.float64)
+        for gi, g in enumerate(self.groups):
+            for name, v in g.requests.items():
+                dim = e.resource_dims.get(name)
+                if dim is not None:
+                    requests[gi, dim] = v
+            g.requests_q = feas.quantize_resources(
+                requests[gi], ceil=True, scales=self.scales
+            )
+        row_sets = [e.rows_for(g.reqs) for g in self.groups]
+        key_present = e.key_presence([g.reqs for g in self.groups])
+        fz = e.feasibility(row_sets, requests.astype(np.float32), key_present)
+        # Free feasibility: compat ∧ offering. Fits is recomputed per class
+        # with accumulated usage + daemon overhead (nodeclaim.go:373-441's
+        # fits is against the CLAIM's total requests, not the bare pod's).
+        self.feas_free = fz.compat & fz.has_offering  # [G, I]
+        return True
+
+    def _template_masks(self) -> None:
+        """Per-template instance-type masks and group compatibility."""
+        s, e = self.s, self.engine
+        I = e.num_instances
+        T = len(s.nodeclaim_templates)
+        self.tmpl_types = np.zeros((T, I), dtype=bool)
+        self.tmpl_overhead_q = np.zeros((T, self.D), dtype=np.int64)
+        for ti, nct in enumerate(s.nodeclaim_templates):
+            for it in nct.instance_type_options:
+                idx = self.type_index.get(id(it))
+                if idx is None:
+                    idx = self._name_index.get(it.name)
+                if idx is not None:
+                    self.tmpl_types[ti, idx] = True
+            overhead = np.zeros(self.D, dtype=np.float64)
+            for name, v in s.daemon_overhead[nct].items():
+                dim = e.resource_dims.get(name)
+                if dim is not None:
+                    overhead[dim] = v
+            self.tmpl_overhead_q[ti] = feas.quantize_resources(
+                overhead, ceil=True, scales=self.scales
+            )
+
+    # -- existing-node fill (per-pod: addToExistingNode, earliest index) -----
+
+    def _fill_existing(self) -> None:
+        s = self.s
+        nodes = s.existing_nodes
+        if not nodes:
+            return
+        N = len(nodes)
+        remaining = np.zeros((N, self.D), dtype=np.float64)
+        for ni, en in enumerate(nodes):
+            for name, v in en.remaining_resources.items():
+                dim = self.engine.resource_dims.get(name)
+                if dim is not None:
+                    remaining[ni, dim] = v
+        # Requirement/taint compat cached by node-label signature: clusters
+        # have few distinct node shapes, so the host checks stay tiny.
+        compat_cache: dict[tuple, bool] = {}
+        for gi, g in enumerate(self.groups):
+            total = len(g.pods)
+            left = total
+            for ni, en in enumerate(nodes):
+                if left == 0:
+                    break
+                # Live accumulated requirements: a prior fill that introduced
+                # a key narrows what later groups may join (the reference
+                # narrows node requirements on every Add). Un-narrowed nodes
+                # share a signature-keyed compat cache.
+                live_reqs = self.existing_reqs.get(ni)
+                if live_reqs is not None:
+                    ok = (
+                        Taints(en.cached_taints).tolerates_pod(g.pods[0]) is None
+                        and live_reqs.compatible(g.reqs) is None
+                    )
+                else:
+                    sig = (
+                        tuple(sorted(en.state_node.labels().items())),
+                        tuple((t.key, t.value, t.effect) for t in en.cached_taints),
+                        gi,
+                    )
+                    ok = compat_cache.get(sig)
+                    if ok is None:
+                        ok = (
+                            Taints(en.cached_taints).tolerates_pod(g.pods[0]) is None
+                            and en.requirements.compatible(g.reqs) is None
+                        )
+                        compat_cache[sig] = ok
+                if not ok:
+                    continue
+                rem_q = feas.quantize_resources(
+                    remaining[ni], ceil=False, scales=self.scales
+                )
+                if not np.all(rem_q >= 0):
+                    continue
+                per_dim = np.where(
+                    g.requests_q > 0,
+                    rem_q // np.maximum(g.requests_q, 1),
+                    np.iinfo(np.int64).max,
+                )
+                fit = int(min(int(np.min(per_dim)), left))
+                if fit <= 0:
+                    continue
+                start = total - left
+                self.existing_fills.append((ni, gi, start, fit))
+                base = self.existing_reqs.get(ni, en.requirements)
+                if any(not base.has(r.key) or base.get(r.key) != r for r in g.reqs):
+                    self.existing_reqs[ni] = _intersect(base, g.reqs)
+                remaining[ni] -= fit * np.array(
+                    [g.requests.get(n, 0.0) for n in self.engine.resource_dims],
+                    dtype=np.float64,
+                )
+                left -= fit
+            g.placed_existing = total - left
+
+    # -- claim-class FFD ------------------------------------------------------
+
+    def _narrow_types(self, types: np.ndarray, usage_q: np.ndarray) -> np.ndarray:
+        return types & np.all(self.alloc_q >= usage_q[None, :], axis=1)
+
+    def _fill_classes(self, gi: int, g: _Group, left: int) -> int:
+        """Join existing claim classes, emptiest first (scheduler.go:453-457
+        sorts in-flight claims by pod count ascending before CanAdd)."""
+        for cls in sorted(self.classes, key=lambda c: c.pods_per_claim):
+            if left == 0:
+                break
+            if cls.n_claims == 0:
+                continue
+            if cls.reqs.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+                continue
+            if Taints(cls.template.spec.taints).tolerates_pod(g.pods[0]) is not None:
+                continue
+            cand = cls.types & self.feas_free[gi]
+            if not cand.any():
+                continue
+            headroom = self.alloc_q[cand] - cls.usage_q[None, :]
+            with np.errstate(divide="ignore"):
+                per_type = np.where(
+                    g.requests_q[None, :] > 0,
+                    headroom // np.maximum(g.requests_q[None, :], 1),
+                    np.iinfo(np.int64).max,
+                )
+            per_type = np.where(np.all(headroom >= 0, axis=1, keepdims=True), per_type, -1)
+            k = int(np.max(np.min(per_type, axis=1), initial=-1))
+            if k <= 0:
+                continue
+            if _narrows(cls.reqs, g.reqs):
+                self.needs_verify = True
+            joint = _intersect(cls.reqs, g.reqs)
+            # claims filled to capacity k, then possibly one partial claim
+            n_full = min(cls.n_claims, left // k)
+            rem = (left - n_full * k) if n_full < cls.n_claims else 0
+            took = n_full * k + rem
+            if took == 0:
+                continue
+            for count, n_cl in ((k, n_full), (rem, 1 if rem else 0)):
+                if n_cl == 0 or count == 0:
+                    continue
+                usage = cls.usage_q + count * g.requests_q
+                self.classes.append(
+                    _ClaimClass(
+                        cls.template,
+                        joint,
+                        self._narrow_types(cand, usage),
+                        usage,
+                        cls.pods_per_claim + count,
+                        n_cl,
+                        cls.members + [(gi, count)],
+                    )
+                )
+            cls.n_claims -= n_full + (1 if rem else 0)
+            left -= took
+        return left
+
+    def _open_claims(self, gi: int, g: _Group, left: int) -> int:
+        """Open new claims from the first feasible template in weight order
+        (scheduler.go:478-556 earliest-index-wins)."""
+        s = self.s
+        for ti, nct in enumerate(s.nodeclaim_templates):
+            if Taints(nct.spec.taints).tolerates_pod(g.pods[0]) is not None:
+                continue
+            if nct.requirements.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+                continue
+            mask = self.tmpl_types[ti] & self.feas_free[gi]
+            remaining_limits = self.remaining_resources.get(nct.nodepool_name)
+            if remaining_limits:
+                mask = mask & self._limits_mask(nct, remaining_limits)
+            if not mask.any():
+                continue
+            base = self.tmpl_overhead_q[ti] + g.requests_q
+            headroom = self.alloc_q[mask] - self.tmpl_overhead_q[ti][None, :]
+            with np.errstate(divide="ignore"):
+                per_type = np.where(
+                    g.requests_q[None, :] > 0,
+                    headroom // np.maximum(g.requests_q[None, :], 1),
+                    np.iinfo(np.int64).max,
+                )
+            per_type = np.where(np.all(headroom >= 0, axis=1, keepdims=True), per_type, 0)
+            k = int(np.max(np.min(per_type, axis=1), initial=0))
+            if k <= 0:
+                continue
+            if _narrows(nct.requirements, g.reqs):
+                self.needs_verify = True
+            joint = _intersect(nct.requirements, g.reqs)
+            n_full, rem = divmod(left, k)
+            for count, n_cl in ((k, n_full), (rem, 1 if rem else 0)):
+                if n_cl == 0 or count == 0:
+                    continue
+                usage = self.tmpl_overhead_q[ti] + count * g.requests_q
+                self.classes.append(
+                    _ClaimClass(
+                        nct,
+                        joint,
+                        self._narrow_types(mask, usage),
+                        usage,
+                        count,
+                        n_cl,
+                        [(gi, count)],
+                    )
+                )
+                self._subtract_max(nct, mask, n_cl)
+            return 0
+        for pod in g.pods[len(g.pods) - left :]:
+            self.pod_errors[pod] = ValueError(
+                "all nodepools were incompatible or had no feasible instance types"
+            )
+        return 0
+
+    def _limits_mask(self, nct, remaining: dict) -> np.ndarray:
+        mask = np.ones(self.engine.num_instances, dtype=bool)
+        for name, limit in remaining.items():
+            dim = self.engine.resource_dims.get(name)
+            if dim is None:
+                continue
+            limit_q = feas.quantize_resources(
+                np.array([limit], dtype=np.float64), ceil=False, scales=self.scales[dim : dim + 1]
+            )[0]
+            mask &= self.alloc_q[:, dim] <= limit_q
+        return mask
+
+    def _subtract_max(self, nct, mask: np.ndarray, n_claims: int) -> None:
+        """Pessimistic nodepool-limit tracking: subtract the max resources
+        over the claim's options per claim (scheduler.go:744-765)."""
+        remaining = self.remaining_resources.get(nct.nodepool_name)
+        if not remaining:
+            return
+        idxs = np.nonzero(mask)[0]
+        maxes: dict[str, float] = {}
+        for i in idxs:
+            for name, v in self.engine.instance_types[i].allocatable().items():
+                if v > maxes.get(name, 0.0):
+                    maxes[name] = v
+        scaled = {k: v * n_claims for k, v in maxes.items()}
+        self.remaining_resources[nct.nodepool_name] = res.subtract(remaining, scaled)
+
+    # -- final verification ---------------------------------------------------
+
+    def _verify(self) -> bool:
+        """Re-filter every class against its ACCUMULATED requirements in one
+        batched device call. Returns False (→ host fallback) if any class's
+        type set shrinks below what the packing assumed. Skipped when no two
+        sources ever constrained the same key differently — then per-source
+        intersection IS the joint feasibility and the round trip is wasted."""
+        if not self.classes or not self.needs_verify:
+            return True
+        e = self.engine
+        row_sets = [e.rows_for(c.reqs) for c in self.classes]
+        key_present = e.key_presence([c.reqs for c in self.classes])
+        requests = np.zeros((len(self.classes), self.D), dtype=np.float32)
+        fz = e.feasibility(row_sets, requests, key_present)
+        joint_ok = fz.compat & fz.has_offering  # [C, I]
+        for ci, cls in enumerate(self.classes):
+            narrowed = cls.types & joint_ok[ci]
+            fits = self._narrow_types(narrowed, cls.usage_q)
+            if not fits.any():
+                return False
+            cls.types = fits
+        return True
+
+    # -- output ---------------------------------------------------------------
+
+    def _emit(self) -> None:
+        """Materialize scheduler state: existing-node fills, nodepool limit
+        tracking, and host SchedNodeClaim objects (one per claim)."""
+        import copy as _copy
+
+        from karpenter_tpu.scheduler.nodeclaim import NodeClaim as SchedNodeClaim
+
+        s = self.s
+        for ni, gi, start, count in self.existing_fills:
+            en = s.existing_nodes[ni]
+            g = self.groups[gi]
+            take = g.pods[start : start + count]
+            en.pods.extend(take)
+            en.remaining_resources = res.subtract(
+                en.remaining_resources, {k: v * count for k, v in g.requests.items()}
+            )
+        for ni, reqs in self.existing_reqs.items():
+            s.existing_nodes[ni].requirements = reqs
+        s.remaining_resources.update(self.remaining_resources)
+        # per-group cursors for handing out pod slices; existing-node fills
+        # consumed the head of each group's pod list
+        cursors = [g.placed_existing for g in self.groups]
+        for cls in self.classes:
+            if cls.n_claims <= 0:
+                continue
+            options = []
+            for it in cls.template.instance_type_options:
+                idx = self.type_index.get(id(it))
+                if idx is None:
+                    idx = self._name_index.get(it.name)
+                if idx is not None and cls.types[idx]:
+                    options.append(it)
+            for _ in range(cls.n_claims):
+                nc = SchedNodeClaim(
+                    cls.template,
+                    s.topology,
+                    s.daemon_overhead[cls.template],
+                    _copy.deepcopy(s.daemon_hostports[cls.template]),
+                    options,
+                    s.reservation_manager,
+                    s.reserved_offering_mode,
+                    s.reserved_capacity_enabled,
+                    engine=s.engine,
+                )
+                reqs = Requirements(*cls.reqs.values())
+                reqs.add(*nc.requirements.values())  # keeps hostname placeholder
+                nc.requirements = reqs
+                requests = dict(s.daemon_overhead[cls.template])
+                for gi, count in cls.members:
+                    g = self.groups[gi]
+                    take = g.pods[cursors[gi] : cursors[gi] + count]
+                    cursors[gi] += count
+                    nc.pods.extend(take)
+                    requests = res.merge(
+                        requests, {k: v * count for k, v in g.requests.items()}
+                    )
+                nc.requests = requests
+                s.new_node_claims.append(nc)
+
+
+def solve_device(scheduler, pods: Sequence[Pod]):
+    """Run the device FFD; returns Results, or None → caller uses the host
+    loop (either ineligible or the final verification found the per-group
+    feasibility intersection was looser than the joint one)."""
+    global DEVICE_SOLVES, DEVICE_FALLBACKS
+    from karpenter_tpu.scheduler.scheduler import Results
+
+    if not eligible(scheduler, pods):
+        DEVICE_FALLBACKS += 1
+        return None
+    solve = _DeviceSolve(scheduler, pods)
+    if not solve._encode():
+        DEVICE_FALLBACKS += 1
+        return None
+    solve._template_masks()
+    solve._fill_existing()
+    for gi, g in enumerate(solve.groups):
+        left = len(g.pods) - g.placed_existing
+        if left == 0:
+            continue
+        left = solve._fill_classes(gi, g, left)
+        if left > 0:
+            solve._open_claims(gi, g, left)
+    if not solve._verify():
+        DEVICE_FALLBACKS += 1
+        return None
+    solve._emit()
+    DEVICE_SOLVES += 1
+    for nc in scheduler.new_node_claims:
+        nc.finalize_scheduling()
+    return Results(
+        new_node_claims=scheduler.new_node_claims,
+        existing_nodes=scheduler.existing_nodes,
+        pod_errors=solve.pod_errors,
+    )

@@ -309,6 +309,18 @@ class Scheduler:
 
     def _solve(self, pods: list[Pod], timeout: Optional[float]) -> Results:
         pod_errors: dict[Pod, Exception] = {}
+        # Device fast path: grouped FFD with the feasibility cube on the TPU
+        # (ops/ffd.py). It computes pod data once per distinct pod shape.
+        # Returns None when ineligible or when its final verification can't
+        # guarantee host-identical semantics — then the host per-pod loop
+        # below remains the oracle.
+        if self.engine is not None:
+            from karpenter_tpu.ops import ffd
+
+            device_results = ffd.solve_device(self, pods)
+            if device_results is not None:
+                _UNSCHEDULABLE_GAUGE.set(float(len(device_results.pod_errors)))
+                return device_results
         for p in pods:
             self.update_cached_pod_data(p)
         q = Queue(pods, self.cached_pod_data)
