@@ -56,7 +56,12 @@ class BindingController:
         # One pods-by-node index per sweep: the anti-affinity checks would
         # otherwise re-scan the whole Pod collection per candidate node.
         self._pods_by_node: dict[str, list[Pod]] = {}
-        for p in self.store.list("Pod", predicate=lambda p: p.spec.node_name != ""):
+        # Terminal (Succeeded/Failed) pods don't repel candidates:
+        # kube-scheduler ignores them for inter-pod (anti-)affinity.
+        for p in self.store.list(
+            "Pod",
+            predicate=lambda p: p.spec.node_name != "" and podutil.is_active(p),
+        ):
             self._pods_by_node.setdefault(p.spec.node_name, []).append(p)
         bound = 0
         for pod in self.store.list("Pod", predicate=self._needs_binding):

@@ -133,6 +133,11 @@ class CatalogEngine:
         self.allocatable = enc.encode_resource_lists(
             self.resource_dims, [it.allocatable() for it in self.instance_types]
         )
+        # Raw capacity for nodepool-limit filtering and pessimistic
+        # subtract-max tracking (scheduler.go:670-686 uses it.capacity).
+        self.capacity = enc.encode_resource_lists(
+            self.resource_dims, [it.capacity for it in self.instance_types]
+        )
         self.offering_available = np.array(
             [o.available for o in self._offerings], dtype=bool
         )
@@ -187,8 +192,14 @@ class CatalogEngine:
         ):
             self._encode_catalog(list(self.offering_owner))
 
+    # Below this many new rows the device dispatch costs more than the host
+    # twin; the sequential FFD simulation interns joint rows a few at a time.
+    _DEVICE_MIN_NEW_ROWS = 48
+
     def _ensure_rows(self) -> None:
-        """Compute compat matrices for any rows added since the last call."""
+        """Compute compat matrices for any rows added since the last call.
+        Large batches (catalog warm-up, the per-solve template x group sweep)
+        run on device; incremental joint rows use the exact numpy twin."""
         if self._computed_rows == len(self._rows):
             return
         new_rows = self._rows[self._computed_rows :]
@@ -205,39 +216,42 @@ class CatalogEngine:
             pad = self._word_capacity - er.mask.shape[1]
             er.mask = np.pad(er.mask, ((0, 0), (0, pad)))
 
+        on_device = len(new_rows) >= self._DEVICE_MIN_NEW_ROWS
+        cast = jnp.asarray if on_device else np.asarray
+        kernel = feas.req_rows_vs_sets if on_device else feas.req_rows_vs_sets_np
         row_args = (
-            jnp.asarray(er.key),
-            jnp.asarray(er.complement),
-            jnp.asarray(er.has_values),
-            jnp.asarray(er.gt),
-            jnp.asarray(er.lt),
-            jnp.asarray(er.mask),
+            cast(er.key),
+            cast(er.complement),
+            cast(er.has_values),
+            cast(er.gt),
+            cast(er.lt),
+            cast(er.mask),
         )
-        tables = (jnp.asarray(self._tables.slot_key), jnp.asarray(self._tables.value_int))
+        tables = (cast(self._tables.slot_key), cast(self._tables.value_int))
         inst = self._inst_sets
         new_inst = np.asarray(
-            feas.req_rows_vs_sets(
+            kernel(
                 *row_args,
-                jnp.asarray(inst.present),
-                jnp.asarray(inst.complement),
-                jnp.asarray(inst.has_values),
-                jnp.asarray(inst.gt),
-                jnp.asarray(inst.lt),
-                jnp.asarray(inst.mask),
+                cast(inst.present),
+                cast(inst.complement),
+                cast(inst.has_values),
+                cast(inst.gt),
+                cast(inst.lt),
+                cast(inst.mask),
                 *tables,
             )
         )
         off = self._offer_sets
         if self.num_offerings:
             new_off = np.asarray(
-                feas.req_rows_vs_sets(
+                kernel(
                     *row_args,
-                    jnp.asarray(off.present),
-                    jnp.asarray(off.complement),
-                    jnp.asarray(off.has_values),
-                    jnp.asarray(off.gt),
-                    jnp.asarray(off.lt),
-                    jnp.asarray(off.mask),
+                    cast(off.present),
+                    cast(off.complement),
+                    cast(off.has_values),
+                    cast(off.gt),
+                    cast(off.lt),
+                    cast(off.mask),
                     *tables,
                 )
             )
@@ -245,6 +259,15 @@ class CatalogEngine:
             new_off = np.zeros((len(new_rows), 0), dtype=bool)
         self._req_compat = np.concatenate([self._req_compat, new_inst], axis=0)
         self._offer_compat = np.concatenate([self._offer_compat, new_off], axis=0)
+        # Rows that constrain NO catalog entry (all-True columns) are
+        # identity elements of the AND-reduce; queries prune them so the
+        # matmul's row axis stays tiny.
+        self._row_trivial = np.concatenate(
+            [
+                getattr(self, "_row_trivial", np.zeros(0, dtype=bool)),
+                new_inst.all(axis=1) & new_off.all(axis=1),
+            ]
+        )
         self._computed_rows = len(self._rows)
         self._device_cache.pop("req_compat", None)
         self._device_cache.pop("offer_compat", None)
@@ -272,27 +295,81 @@ class CatalogEngine:
                 out[i, self.vocab.key_ids[r.key]] = True
         return out
 
+    def masks_for_rows(
+        self, rows: Sequence[int], keys: Sequence[str]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Exact (compat[I], has_offering[I]) for ONE requirement set given
+        its interned row ids and constrained keys, evaluated host-side from
+        the cached per-row matrices.
+
+        Because set compatibility is a per-requirement AND (Intersects:
+        every row must intersect independently, requirements.go:248-268),
+        AND-ing the cached row vectors of the JOINT requirement set — whose
+        rows are the true per-key intersections produced by Requirements.add
+        — is bit-identical to the host filter, including the per-offering
+        cross-key conjunction. Hostname rows may be excluded by callers
+        (they cannot constrain catalog entries)."""
+        rows = list(rows)
+        self._ensure_rows()
+        if rows:
+            compat = self._req_compat[rows].all(axis=0)
+        else:
+            compat = np.ones(self.num_instances, dtype=bool)
+        if self.num_offerings == 0:
+            return compat, np.zeros(self.num_instances, dtype=bool)
+        if rows:
+            offer_rows_ok = self._offer_compat[rows].all(axis=0)
+        else:
+            offer_rows_ok = np.ones(self.num_offerings, dtype=bool)
+        key_present = np.zeros(self._key_capacity, dtype=bool)
+        for k in keys:
+            kid = self.vocab.key_ids.get(k)
+            if kid is not None:
+                key_present[kid] = True
+        undef_ok = ~np.any(self.offering_custom_need & ~key_present[None, :], axis=1)
+        offer_ok = offer_rows_ok & undef_ok & self.offering_available
+        has_offering = np.zeros(self.num_instances, dtype=bool)
+        np.logical_or.at(has_offering, self.offering_owner[offer_ok], True)
+        return compat, has_offering
+
+    def host_masks(self, reqs: Requirements) -> tuple[np.ndarray, np.ndarray]:
+        return self.masks_for_rows(self.rows_for(reqs), [r.key for r in reqs])
+
     def feasibility(
         self,
         row_sets: Sequence[Sequence[int]],
         requests: np.ndarray,  # [P, D] float32 in self.resource_dims order
         key_present: Optional[np.ndarray] = None,  # [P, K]
     ) -> Feasibility:
-        """Batched feasibility of P requirement-sets against the catalog."""
+        """Batched feasibility of P requirement-sets against the catalog.
+
+        The row axis is restricted to the NON-TRIVIAL rows actually used by
+        this query, and both axes are padded to power-of-two buckets so the
+        jitted kernels hit the compile cache across solves."""
         self._ensure_rows()
         P = len(row_sets)
-        R = max(1, self._computed_rows)
-        membership = np.zeros((P, R), dtype=bool)
+        used = sorted(
+            {rid for rows in row_sets for rid in rows if not self._row_trivial[rid]}
+        ) if self._computed_rows else []
+        colmap = {rid: i for i, rid in enumerate(used)}
+        R = max(1, len(used))
+        P2 = 1 << max(0, (P - 1).bit_length())
+        R2 = 1 << max(0, (R - 1).bit_length())
+        membership = np.zeros((P2, R2), dtype=bool)
         for p, rows in enumerate(row_sets):
             for rid in rows:
-                membership[p, rid] = True
+                i = colmap.get(rid)
+                if i is not None:
+                    membership[p, i] = True
 
-        if self._computed_rows:
-            req_compat = self._dev("req_compat", self._req_compat)
+        if used:
+            req_compat_h = np.zeros((R2, self.num_instances), dtype=bool)
+            req_compat_h[:R] = self._req_compat[used]
+            req_compat = jnp.asarray(req_compat_h)
         else:
-            req_compat = jnp.zeros((1, self.num_instances), dtype=bool)
+            req_compat = jnp.zeros((R2, self.num_instances), dtype=bool)
         membership_dev = jnp.asarray(membership)
-        compat = np.asarray(feas.membership_all(membership_dev, req_compat))
+        compat = np.asarray(feas.membership_all(membership_dev, req_compat))[:P]
         # fits stays host-side in float64: exact parity with resources.fits
         # at byte magnitudes; it's an O(P*I*D) elementwise op, not the matmul.
         fits = np.all(
@@ -307,19 +384,22 @@ class CatalogEngine:
 
         if key_present is None:
             key_present = np.zeros((P, self._key_capacity), dtype=bool)
-        offer_compat = (
-            self._dev("offer_compat", self._offer_compat)
-            if self._computed_rows
-            else jnp.zeros((1, self.num_offerings), dtype=bool)
-        )
+        key_present_p = np.zeros((P2, key_present.shape[1]), dtype=bool)
+        key_present_p[:P] = key_present
+        if used:
+            offer_compat_h = np.zeros((R2, self.num_offerings), dtype=bool)
+            offer_compat_h[:R] = self._offer_compat[used]
+            offer_compat = jnp.asarray(offer_compat_h)
+        else:
+            offer_compat = jnp.zeros((R2, self.num_offerings), dtype=bool)
         has_offering = np.asarray(
             feas.offering_reduce(
                 membership_dev,
                 offer_compat,
                 self._dev("custom_need", self.offering_custom_need),
-                jnp.asarray(key_present),
+                jnp.asarray(key_present_p),
                 self._dev("available", self.offering_available),
                 self._dev("owner_onehot", self._owner_onehot),
             )
-        )
+        )[:P]
         return Feasibility(compat, fits, has_offering)

@@ -111,6 +111,69 @@ def req_rows_vs_sets(
     return ~present | has_intersection | exempt
 
 
+def req_rows_vs_sets_np(
+    row_key: np.ndarray,
+    row_complement: np.ndarray,
+    row_has_values: np.ndarray,
+    row_gt: np.ndarray,
+    row_lt: np.ndarray,
+    row_mask: np.ndarray,
+    set_present: np.ndarray,
+    set_complement: np.ndarray,
+    set_has_values: np.ndarray,
+    set_gt: np.ndarray,
+    set_lt: np.ndarray,
+    set_mask: np.ndarray,
+    slot_key: np.ndarray,
+    value_int: np.ndarray,
+) -> np.ndarray:
+    """Host twin of req_rows_vs_sets: identical integer/bool semantics in
+    numpy, for incremental row batches too small to pay device dispatch
+    (the sequential FFD simulation interns joint-requirement rows one claim
+    at a time)."""
+
+    def unpack(words: np.ndarray) -> np.ndarray:
+        shifts = np.arange(WORD, dtype=np.uint32)
+        bits = (words[..., None] >> shifts) & np.uint32(1)
+        return bits.reshape(*words.shape[:-1], words.shape[-1] * WORD).astype(bool)
+
+    present = set_present[:, row_key].T
+    s_comp = set_complement[:, row_key].T
+    s_hasv = set_has_values[:, row_key].T
+    s_gt = set_gt[:, row_key].T
+    s_lt = set_lt[:, row_key].T
+
+    g = np.maximum(row_gt[:, None], s_gt)
+    l = np.minimum(row_lt[:, None], s_lt)
+    bounds_empty = (g != NO_GT) & (l != NO_LT) & (g >= l)
+    both_complement = row_complement[:, None] & s_comp
+
+    row_bits = unpack(row_mask)
+    set_bits = unpack(set_mask)
+    key_slots = slot_key[None, :] == row_key[:, None]
+    a_bits = np.where(row_complement[:, None], ~row_bits, row_bits) & key_slots
+    b_raw = set_bits[None, :, :]
+    b_bits = np.where(s_comp[:, :, None], ~b_raw, b_raw)
+    unbounded = (g == NO_GT) & (l == NO_LT)
+    is_int = value_int != NOT_INT
+    in_range = (
+        is_int[None, None, :]
+        & (value_int[None, None, :] > g[:, :, None])
+        & (value_int[None, None, :] < l[:, :, None])
+    )
+    bounds = unbounded[:, :, None] | in_range
+    candidates = a_bits[:, None, :] & b_bits & bounds
+    any_candidate = np.any(candidates, axis=-1)
+
+    has_intersection = np.where(
+        bounds_empty, False, np.where(both_complement, True, any_candidate)
+    )
+    row_exempt = (row_complement & row_has_values) | (~row_complement & ~row_has_values)
+    set_exempt = (s_comp & s_hasv) | (~s_comp & ~s_hasv)
+    exempt = row_exempt[:, None] & set_exempt
+    return ~present | has_intersection | exempt
+
+
 @jax.jit
 def membership_all(membership: jnp.ndarray, row_ok: jnp.ndarray) -> jnp.ndarray:
     """all-rows-compatible via matmul.

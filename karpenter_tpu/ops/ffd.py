@@ -1,48 +1,61 @@
 """Device-accelerated first-fit-decreasing: the TPU fast path the
-Provisioner actually executes.
+Provisioner actually executes, with EXACT host-decision parity.
 
 The reference's solver is a per-pod loop — Pop → try existing nodes →
 try in-flight claims (emptiest first) → open a new claim from the weighted
 templates (scheduler.go:346-401, :451-557). Its hottest inner op is
 `filterInstanceTypesByRequirements` over every instance type
-(nodeclaim.go:373-441). This module keeps the FFD skeleton host-side but
-reshapes the work TPU-first (SURVEY.md §7 step 3):
+(nodeclaim.go:373-441). This module reshapes that work TPU-first while
+reproducing the host loop's decisions bit-for-bit:
 
-1. Pods collapse into groups of identical (requirements, requests) shapes —
-   a 50k-pod batch is typically a few hundred shapes.
-2. ONE fused device call computes the full feasibility cube
-   compat ∧ has-offering over [G groups × I instance types]
-   (CatalogEngine.feasibility — membership matmuls on the MXU).
-3. The sequential FFD loop then runs over G groups (not P pods), operating
-   on CLAIM CLASSES — sets of identical in-flight claims — with vectorized
-   numpy splits/fills. Claim requirement algebra reuses the exact host
-   `Requirements` implementation, so join decisions match the host solver's
-   `NodeClaim.can_add` compatibility semantics bit-for-bit.
-4. A final batched device verification re-filters every class against its
-   ACCUMULATED requirements (set intersection is not pairwise-decomposable:
-   per-group feasibility intersection can be looser than joint feasibility).
-   Any discrepancy aborts the fast path and the caller falls back to the
-   host loop — the fast path never ships a looser answer.
+1. Pods collapse into groups of identical spec shapes; pod data (requirement
+   parsing) runs ONCE per distinct shape instead of once per pod.
+2. ONE batched device call evaluates the joint (template x group)
+   requirement feasibility over the catalog — membership matmuls on the MXU
+   (CatalogEngine.feasibility). Set compatibility is a per-requirement AND
+   (requirements.go:248-268), so AND-ing the cached row vectors of the TRUE
+   joint requirement set (whose rows are the per-key intersections produced
+   by Requirements.add) is bit-identical to the host filter — including the
+   per-offering cross-key conjunction the pairwise masks miss.
+3. The packing loop is an EXACT simulation of the host queue: pods are
+   processed in the host's sort order (cpu desc, mem desc, timestamp, uid;
+   queue.go:72-108), each pod tries existing nodes in order, then in-flight
+   claims in the host's emptiest-first *stable-sort* order, then the
+   weighted templates. Every rejection reason is monotone (requirements
+   only narrow, usage only grows, limits only shrink), so rejections are
+   cached permanently and steady-state placements cost O(1) per pod:
+   lazy-keyed heaps model the stable sort, per-(claim, group) capacity
+   schedules replace the per-pod filter.
+4. Higher-order joint requirement sets (a claim accumulating several
+   narrowing groups) are evaluated host-side from the engine's cached row
+   matrices — exact, no device round-trip on the sequential path.
 
-Eligibility is checked first (`eligible`): pods with pod (anti-)affinity,
-topology spread, preferred node affinity, host ports, or volumes — and
-solves involving reserved capacity or minValues — take the host path, which
-remains the semantics oracle.
+Eligibility is checked first (`eligible`): solves with topology machinery
+(spread/affinity groups, incl. inverse anti-affinity from cluster pods),
+reserved capacity, minValues, or PreferNoSchedule relaxation — and pods
+with pod (anti-)affinity, preferred/multi-term node affinity, host ports,
+or volumes — take the host path, which remains the semantics oracle.
 """
 
 from __future__ import annotations
 
+import heapq
+import itertools
+import time
 from typing import TYPE_CHECKING, Optional, Sequence
 
 import numpy as np
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import Pod
-from karpenter_tpu.ops import feasibility as feas
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.scheduler.nodeclaim import InstanceTypeFilterError
 from karpenter_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    Requirement,
     Requirements,
 )
+from karpenter_tpu.scheduling.requirements import Operator
 from karpenter_tpu.scheduling.taints import Taints
 from karpenter_tpu.utils import resources as res
 
@@ -52,14 +65,31 @@ if TYPE_CHECKING:
 # Below this batch size the host per-pod loop is comfortably fast and covers
 # every feature; the device path's fixed costs don't pay off.
 DEVICE_MIN_PODS = 64
+# Existing-node joins run through host requirement algebra per (node, group)
+# pair; cap the node count so that stays off the critical path.
+DEVICE_MAX_EXISTING = 512
 
-# Observability: how often the fast path ran vs fell back (tests assert on
-# the module counters; metrics expose them to operators).
+# Observability: how often the fast path ran vs fell back. Mirrored into the
+# metrics registry so operators can alert on fallback storms.
 DEVICE_SOLVES = 0
 DEVICE_FALLBACKS = 0
-# Existing-node fill is host-vectorized per group; cap the node count so the
-# host compat checks stay off the critical path (large clusters fall back).
-DEVICE_MAX_EXISTING = 512
+_SOLVES_CTR = global_registry.counter(
+    "karpenter_scheduler_device_solves_total",
+    "scheduling solves served by the device fast path",
+)
+_FALLBACKS_CTR = global_registry.counter(
+    "karpenter_scheduler_device_fallbacks_total",
+    "scheduling solves that fell back to the host loop",
+)
+
+# Tests set this to make simulation bugs fail loudly instead of silently
+# falling back to the host loop.
+STRICT = False
+
+_EPS = 1e-9
+_BIG = np.int64(2**31)
+
+_placeholder_counter = itertools.count(1)
 
 
 # -- eligibility -------------------------------------------------------------
@@ -74,9 +104,16 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
         return False
     if len(scheduler.existing_nodes) > DEVICE_MAX_EXISTING:
         return False
-    # Topology machinery engaged (spread/affinity groups, incl. inverse
-    # anti-affinity from cluster pods) → host.
+    # Topology machinery engaged — incl. inverse anti-affinity tracked from
+    # EXISTING cluster pods (topology.go:55-58), which constrains even plain
+    # pods — → host.
     if getattr(scheduler.topology, "topology_groups", None):
+        return False
+    if getattr(scheduler.topology, "inverse_topology_groups", None):
+        return False
+    # The relaxation ladder may mutate pods when PreferNoSchedule taints are
+    # tolerable (preferences.go:133-145) — shape groups would go stale.
+    if scheduler.preferences.tolerate_prefer_no_schedule:
         return False
     # Reserved capacity and minValues interplay stays host-side.
     if scheduler.reserved_capacity_enabled and any(
@@ -85,8 +122,11 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
         for o in it.offerings
     ):
         return False
+    dims = scheduler.engine.resource_dims
     for nct in scheduler.nodeclaim_templates:
         if nct.requirements.has_min_values():
+            return False
+        if any(k not in dims for k in scheduler.daemon_overhead[nct]):
             return False
     return True
 
@@ -115,23 +155,30 @@ def _group_eligible(pod: Pod) -> bool:
 
 class _Group:
     __slots__ = (
-        "pods", "reqs", "strict_reqs", "requests", "requests_q", "sort_key",
-        "placed_existing",
+        "reqs", "strict_reqs", "requests", "req_f", "div_dims", "div_req",
+        "tier", "fit_floor", "sort_cpu", "sort_mem", "n_pods", "rowset",
+        "has_hostname",
     )
 
-    def __init__(self, pod: Pod, data):
-        self.pods: list[Pod] = [pod]
+    def __init__(self, data, dims: dict):
         self.reqs: Requirements = data.requirements
         self.strict_reqs: Requirements = data.strict_requirements
         self.requests: dict = data.requests
-        self.requests_q: Optional[np.ndarray] = None
-        self.placed_existing = 0
-        self.sort_key = (
-            -data.requests.get(wk.RESOURCE_CPU, 0.0),
-            -data.requests.get(wk.RESOURCE_MEMORY, 0.0),
-            pod.metadata.creation_timestamp,
-            pod.metadata.uid,
-        )
+        self.req_f = np.zeros(len(dims), dtype=np.float64)
+        for name, v in data.requests.items():
+            self.req_f[dims[name]] = v
+        self.div_dims = np.nonzero(self.req_f > 0)[0]
+        self.div_req = self.req_f[self.div_dims]
+        # Resource tier: groups with IDENTICAL request vectors share claim
+        # capacity schedules (fits depends only on the vector, not the group).
+        self.tier = self.req_f.tobytes()
+        # Fit threshold: usage + req <= alloc + eps  ⟺  rem >= req - eps
+        self.fit_floor = self.req_f - 1e-9
+        self.sort_cpu = data.requests.get(wk.RESOURCE_CPU, 0.0)
+        self.sort_mem = data.requests.get(wk.RESOURCE_MEMORY, 0.0)
+        self.n_pods = 0
+        self.rowset: frozenset = frozenset()  # filled once the engine interns
+        self.has_hostname = any(r.key == wk.LABEL_HOSTNAME for r in data.requirements)
 
 
 def _raw_sig(pod: Pod) -> tuple:
@@ -139,18 +186,39 @@ def _raw_sig(pod: Pod) -> tuple:
     ELIGIBLE pod's scheduling: selector, single required affinity term,
     container resources, tolerations, and the eligibility-gate fields
     themselves (so an ineligible pod can never hide inside an eligible
-    group). Runs once per pod — keep it allocation-light."""
+    group). Dict items are taken in insertion order — two value-equal specs
+    built in different key orders merely split into two identical groups,
+    which only costs dedup, never correctness. Runs once per pod."""
     spec = pod.spec
+    containers = spec.containers
+    # fast path: the overwhelmingly common single-container plain pod
+    if (
+        spec.affinity is None
+        and not spec.topology_spread_constraints
+        and not spec.tolerations
+        and not spec.init_containers
+        and not spec.overhead
+        and not getattr(spec, "volumes", None)
+        and len(containers) == 1
+    ):
+        c = containers[0]
+        return (
+            tuple(spec.node_selector.items()) if spec.node_selector else (),
+            tuple(c.requests.items()),
+            tuple(c.limits.items()) if c.limits else (),
+            len(c.ports),
+            c.restart_policy,
+        )
     aff = spec.affinity
     aff_sig: tuple = ()
-    gates = 0
+    gates = 1
     if aff is not None:
         if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
-            gates |= 1
+            gates |= 2
         na = aff.node_affinity
         if na is not None:
             if na.preferred:
-                gates |= 2
+                gates |= 4
             aff_sig = tuple(
                 tuple(
                     (e["key"], e["operator"], tuple(e.get("values", ())))
@@ -159,106 +227,100 @@ def _raw_sig(pod: Pod) -> tuple:
                 for term in na.required
             )
     if spec.topology_spread_constraints:
-        gates |= 4
-    if getattr(spec, "volumes", None):
         gates |= 8
-    containers = []
-    for c in spec.containers:
-        containers.append(
-            (
-                tuple(sorted(c.requests.items())),
-                tuple(sorted(c.limits.items())) if c.limits else (),
-                len(c.ports),
-                c.restart_policy,
-            )
+    if getattr(spec, "volumes", None):
+        gates |= 16
+    cont_sig = tuple(
+        (
+            tuple(c.requests.items()),
+            tuple(c.limits.items()) if c.limits else (),
+            len(c.ports),
+            c.restart_policy,
         )
+        for c in containers
+    )
     inits = ()
     if spec.init_containers:
         inits = tuple(
             (
-                tuple(sorted(c.requests.items())),
-                tuple(sorted(c.limits.items())) if c.limits else (),
+                tuple(c.requests.items()),
+                tuple(c.limits.items()) if c.limits else (),
                 c.restart_policy,
             )
             for c in spec.init_containers
         )
     return (
-        tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
+        tuple(spec.node_selector.items()) if spec.node_selector else (),
         aff_sig,
         gates,
-        tuple(containers),
+        cont_sig,
         inits,
-        tuple(sorted(spec.overhead.items())) if spec.overhead else (),
+        tuple(spec.overhead.items()) if spec.overhead else (),
         tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations)
         if spec.tolerations
         else (),
     )
 
 
-def _group_pods(scheduler, pods: Sequence[Pod]) -> Optional[list[_Group]]:
-    """Collapse pods into value-identical shape groups, ordered by the host
-    queue's FFD key (queue.go:72-108). PodData is computed ONCE per group
-    and shared into the scheduler's cache — the per-pod host parse is the
-    single biggest cost at 50k pods. Returns None when a shape fails the
-    per-group eligibility gates (→ host path)."""
-    groups: dict[tuple, _Group] = {}
-    order: list[_Group] = []
-    for pod in pods:
-        sig = _raw_sig(pod)
-        g = groups.get(sig)
-        if g is None:
-            if not _group_eligible(pod):
-                return None
-            scheduler.update_cached_pod_data(pod)
-            data = scheduler.cached_pod_data[pod.metadata.uid]
-            g = _Group(pod, data)
-            groups[sig] = g
-            order.append(g)
-        else:
-            g.pods.append(pod)
-            scheduler.cached_pod_data[pod.metadata.uid] = scheduler.cached_pod_data[
-                g.pods[0].metadata.uid
-            ]
-    order.sort(key=lambda g: g.sort_key)
-    return order
+# -- simulation structures ---------------------------------------------------
 
 
-# -- claim classes -----------------------------------------------------------
+class _Claim:
+    """An in-flight NodeClaim under simulation.
 
-
-class _ClaimClass:
-    """`n_claims` identical in-flight NodeClaims: same template, same
-    accumulated requirements, same usage, same member-pod composition."""
+    Fits-narrowing TELESCOPES: because usage only grows, the host's per-join
+    option filter satisfies types_k = types_0 ∧ fits(U_k). The claim keeps
+    the remaining headroom `rem = allocatable − usage` over exactly the
+    UNIQUE allocatable vectors that still fit the current usage — rows that
+    stop fitting are pruned permanently, so every join is a handful of
+    small-array ops; the emitted option set is type_mask ∧ surviving rows."""
 
     __slots__ = (
-        "template", "reqs", "types", "usage_q", "pods_per_claim",
-        "n_claims", "members",
+        "ti", "reqs", "rowkey", "type_mask", "u_ids", "rem", "count", "rank",
+        "members", "group_counts", "gdrop", "gknown",
     )
 
-    def __init__(self, template, reqs, types, usage_q, pods_per_claim, n_claims, members):
-        self.template = template
-        self.reqs = reqs  # host Requirements — accumulated, exact algebra
-        self.types = types  # np.ndarray [I] bool
-        self.usage_q = usage_q  # np.ndarray [D] int64 quantized usage
-        self.pods_per_claim = pods_per_claim  # int
-        self.n_claims = n_claims  # int
-        self.members = members  # list[(group_index, pods_of_group_per_claim)]
+    def __init__(self, ti, reqs, rowkey, type_mask, u_ids, rem, rank):
+        self.ti = ti
+        self.reqs = reqs  # host Requirements incl. hostname placeholder
+        self.rowkey = rowkey  # frozenset of engine row ids, sans hostname
+        self.type_mask = type_mask  # np bool [I]: requirement-level narrowing
+        self.u_ids = u_ids  # np int [M] unique-allocatable row ids
+        self.rem = rem  # np float64 [M, D] uniq_alloc - current usage
+        self.count = 0
+        self.rank = rank
+        self.members: list[Pod] = []
+        self.group_counts: dict[int, int] = {}  # TOTAL pods per group
+        self.gdrop: set[int] = set()  # groups permanently rejected
+        # Groups whose requirements are subsumed by the claim's (adding them
+        # is a no-op). Subsumption survives further narrowing, so membership
+        # is permanent.
+        self.gknown: set[int] = set()
 
 
-def _intersect(reqs_a: Requirements, reqs_b: Requirements) -> Requirements:
-    out = Requirements(*reqs_a.values())
-    out.add(*reqs_b.values())
-    return out
+class _Node:
+    """Existing-node wrapper; mutations are committed to the scheduler's
+    ExistingNode objects only at emit."""
+
+    __slots__ = (
+        "en", "reqs", "remaining", "version", "usage_ver", "joined",
+        "gtol", "gcompat", "gcap",
+    )
+
+    def __init__(self, en):
+        self.en = en
+        self.reqs = en.requirements
+        self.remaining = dict(en.remaining_resources)
+        self.version = 0
+        self.usage_ver = 0
+        self.joined: list[Pod] = []
+        self.gtol: dict[int, bool] = {}
+        self.gcompat: dict[int, tuple[int, bool]] = {}  # gi -> (version, ok)
+        self.gcap: dict[int, tuple[int, int]] = {}  # gi -> (usage_ver, k_left)
 
 
-def _narrows(base: Requirements, incoming: Requirements) -> bool:
-    """True when `incoming` constrains a key `base` already constrains with a
-    different value set — the condition under which joint feasibility can be
-    strictly tighter than the intersection of per-source feasibilities."""
-    for r in incoming:
-        if base.has(r.key) and base.get(r.key) != r:
-            return True
-    return False
+class _Fallback(Exception):
+    """Internal: abort the device solve and use the host loop."""
 
 
 class _DeviceSolve:
@@ -266,322 +328,577 @@ class _DeviceSolve:
         self.s = scheduler
         self.engine: "CatalogEngine" = scheduler.engine
         self.pods = pods
-        self.pod_errors: dict[Pod, Exception] = {}
         e = self.engine
-        self.D = len(e.resource_dims)
-        self.scales = feas.resource_scales(e.resource_dims)
-        self.alloc_q = feas.quantize_resources(
-            e.allocatable, ceil=False, scales=self.scales
-        )  # [I, D] int64, floor — conservative vs host float
-        self.type_index = {id(it): i for i, it in enumerate(e.instance_types)}
-        # name fallback: a content-cache-hit engine holds equal-content types
-        # under different object identities
-        self._name_index = {it.name: i for i, it in enumerate(e.instance_types)}
-        self.classes: list[_ClaimClass] = []
+        self.dims = e.resource_dims
+        self.D = len(self.dims)
+        self.I = e.num_instances
+        self.alloc_f = e.allocatable  # [I, D] float64
+        self.cap_f = e.capacity  # [I, D] float64
+        # Catalogs repeat allocatable vectors (size families × zones); fit
+        # checks collapse to the unique rows, shrinking every claim's
+        # headroom matrix ~I/U-fold.
+        self.uniq_alloc, self.uid_of_type = np.unique(
+            self.alloc_f, axis=0, return_inverse=True
+        )
+        self.U = self.uniq_alloc.shape[0]
         self.groups: list[_Group] = []
-        # Scheduler state is NOT mutated until the final verification passes:
-        # a fallback to the host loop must start from pristine state.
-        self.existing_fills: list[tuple[int, int, int, int]] = []  # (node, group, start, count)
-        self.existing_reqs: dict[int, Requirements] = {}  # live accumulated node reqs
+        self.claims: list[_Claim] = []
+        self.nodes = [_Node(en) for en in scheduler.existing_nodes]
+        self.seq = 0  # bucket-entry counter for the stable-sort order model
+        # joint requirement-set masks: frozenset(row ids) -> (compat, offer)
+        self.joint_cache: dict[frozenset, tuple[np.ndarray, np.ndarray]] = {}
         self.remaining_resources = {
             name: dict(rl) for name, rl in scheduler.remaining_resources.items()
         }
-        # Joint-requirement verification is only needed when two sources
-        # constrained the SAME key with DIFFERENT value sets — that's the only
-        # way per-group feasibility intersection can be looser than joint
-        # feasibility (set intersection isn't pairwise-decomposable).
-        self.needs_verify = False
+        self.limits_version = 0
+        # per-group state
+        self.gheaps: list[list] = []
+        self.gsynced: list[int] = []
+        self.nptr: list[int] = []
+        self.gnewclaim_err: dict[int, tuple[int, Exception]] = {}
+        # per-(template, group) static caches
+        self.tg_tol: dict[tuple[int, int], bool] = {}
+        self.tg_compat: dict[tuple[int, int], Optional[tuple]] = {}
+        # (claim rowkey, group) -> host-algebra compatibility; claims of the
+        # same family share rowkeys, so the check runs once per family
+        self.rowkey_compat: dict[tuple[frozenset, int], bool] = {}
+        self.pod_errors: dict[Pod, Exception] = {}
+        self.timed_out = False
 
     # -- encoding ------------------------------------------------------------
 
-    def _encode(self) -> bool:
-        e = self.engine
-        groups = _group_pods(self.s, self.pods)
-        if groups is None:
-            return False
-        self.groups = groups
+    def _group_pods(self) -> Optional[list[tuple[Pod, int]]]:
+        """Collapse pods into value-identical shape groups; PodData is
+        computed ONCE per group and shared into the scheduler's cache — the
+        per-pod host parse is the single biggest cost at 50k pods. Returns
+        (pod, group index) pairs, or None when a shape fails the per-group
+        eligibility gates (→ host path)."""
+        s, dims = self.s, self.dims
+        index: dict[tuple, int] = {}
+        out: list[tuple[Pod, int]] = []
+        first_uid: list[str] = []
+        cache = s.cached_pod_data
+        for pod in self.pods:
+            sig = _raw_sig(pod)
+            gi = index.get(sig)
+            if gi is None:
+                if not _group_eligible(pod):
+                    return None
+                s.update_cached_pod_data(pod)
+                data = cache[pod.metadata.uid]
+                if any(k not in dims for k in data.requests):
+                    return None
+                gi = len(self.groups)
+                index[sig] = gi
+                self.groups.append(_Group(data, dims))
+                first_uid.append(pod.metadata.uid)
+            else:
+                cache[pod.metadata.uid] = cache[first_uid[gi]]
+            self.groups[gi].n_pods += 1
+            out.append((pod, gi))
         G = len(self.groups)
-        requests = np.zeros((G, self.D), dtype=np.float64)
-        for gi, g in enumerate(self.groups):
-            for name, v in g.requests.items():
-                dim = e.resource_dims.get(name)
-                if dim is not None:
-                    requests[gi, dim] = v
-            g.requests_q = feas.quantize_resources(
-                requests[gi], ceil=True, scales=self.scales
-            )
-        row_sets = [e.rows_for(g.reqs) for g in self.groups]
-        key_present = e.key_presence([g.reqs for g in self.groups])
-        fz = e.feasibility(row_sets, requests.astype(np.float32), key_present)
-        # Free feasibility: compat ∧ offering. Fits is recomputed per class
-        # with accumulated usage + daemon overhead (nodeclaim.go:373-441's
-        # fits is against the CLAIM's total requests, not the bare pod's).
-        self.feas_free = fz.compat & fz.has_offering  # [G, I]
-        return True
+        self.gheaps = [[] for _ in range(G)]
+        self.gsynced = [0] * G
+        self.nptr = [0] * G
+        return out
 
-    def _template_masks(self) -> None:
-        """Per-template instance-type masks and group compatibility."""
+    def _sorted(self, pairs: list[tuple[Pod, int]]) -> list[tuple[Pod, int]]:
+        """Exact host queue order (queue.go:72-108): cpu desc, mem desc,
+        creation timestamp, uid. Vectorized via lexsort (numpy string
+        comparison is code-point order — identical to Python's)."""
+        groups = self.groups
+        try:
+            gi_arr = np.fromiter((gi for _, gi in pairs), dtype=np.int64, count=len(pairs))
+            cpu = np.array([g.sort_cpu for g in groups])[gi_arr]
+            mem = np.array([g.sort_mem for g in groups])[gi_arr]
+            ts = np.fromiter(
+                (p.metadata.creation_timestamp for p, _ in pairs),
+                dtype=np.float64,
+                count=len(pairs),
+            )
+            uid = np.array([p.metadata.uid for p, _ in pairs])
+            order = np.lexsort((uid, ts, -mem, -cpu))
+            return [pairs[i] for i in order]
+        except (TypeError, ValueError):
+            return sorted(
+                pairs,
+                key=lambda pg: (
+                    -groups[pg[1]].sort_cpu,
+                    -groups[pg[1]].sort_mem,
+                    pg[0].metadata.creation_timestamp,
+                    pg[0].metadata.uid,
+                ),
+            )
+
+    def _rows_sans_hostname(self, reqs: Requirements) -> frozenset:
+        rid = self.engine.row_id
+        return frozenset(
+            rid(r) for r in reqs if r.key != wk.LABEL_HOSTNAME
+        )
+
+    def _prepare_templates(self) -> None:
+        """Template masks/overheads + the batched device sweep over all
+        compatible (template x group) joint requirement sets — the
+        MXU-shaped part of the solve (SURVEY.md §7 step 2)."""
         s, e = self.s, self.engine
-        I = e.num_instances
         T = len(s.nodeclaim_templates)
-        self.tmpl_types = np.zeros((T, I), dtype=bool)
-        self.tmpl_overhead_q = np.zeros((T, self.D), dtype=np.int64)
+        G = len(self.groups)
+        self.tmpl_mask = np.zeros((T, self.I), dtype=bool)
+        self.tmpl_options: list[list] = []
+        self.usage0_f = np.zeros((T, self.D), dtype=np.float64)
+        index = {id(it): i for i, it in enumerate(e.instance_types)}
+        name_index = {it.name: i for i, it in enumerate(e.instance_types)}
+        self.opt_index: list[list[int]] = []
+        for g in self.groups:
+            g.rowset = self._rows_sans_hostname(g.reqs)
         for ti, nct in enumerate(s.nodeclaim_templates):
+            idxs = []
             for it in nct.instance_type_options:
-                idx = self.type_index.get(id(it))
-                if idx is None:
-                    idx = self._name_index.get(it.name)
-                if idx is not None:
-                    self.tmpl_types[ti, idx] = True
-            overhead = np.zeros(self.D, dtype=np.float64)
+                i = index.get(id(it))
+                if i is None:
+                    i = name_index.get(it.name)
+                if i is None:
+                    raise _Fallback("template option missing from engine catalog")
+                idxs.append(i)
+                self.tmpl_mask[ti, i] = True
+            self.opt_index.append(idxs)
+            self.tmpl_options.append(list(nct.instance_type_options))
             for name, v in s.daemon_overhead[nct].items():
-                dim = e.resource_dims.get(name)
-                if dim is not None:
-                    overhead[dim] = v
-            self.tmpl_overhead_q[ti] = feas.quantize_resources(
-                overhead, ceil=True, scales=self.scales
+                self.usage0_f[ti, self.dims[name]] = v
+        # Joint (template x group) requirement sets, evaluated in ONE batched
+        # device sweep — the [T*G, I] membership-matmul cube. Degenerate
+        # solves with a huge distinct-shape count fall back to lazy per-pair
+        # host evaluation (still exact) to bound the batch.
+        if T * G <= 8192:
+            row_sets: list[list[int]] = []
+            reqs_list: list[Requirements] = []
+            keysets: list[frozenset] = []
+            for ti in range(T):
+                for gi in range(G):
+                    tg = self._tg(ti, gi)
+                    if tg is None:
+                        continue
+                    joint, rows = tg
+                    if rows not in self.joint_cache:
+                        self.joint_cache[rows] = None  # reserve
+                        row_sets.append(list(rows))
+                        reqs_list.append(joint)
+                        keysets.append(rows)
+            if row_sets:
+                requests = np.zeros((len(row_sets), self.D), dtype=np.float32)
+                fz = e.feasibility(row_sets, requests, e.key_presence(reqs_list))
+                for i, rows in enumerate(keysets):
+                    self.joint_cache[rows] = (fz.compat[i], fz.has_offering[i])
+
+    _MISSING = object()
+
+    def _tg(self, ti: int, gi: int):
+        """(joint Requirements, engine row-set) for template x group, or None
+        when the template's requirements reject the group."""
+        key = (ti, gi)
+        got = self.tg_compat.get(key, self._MISSING)
+        if got is self._MISSING:
+            nct = self.s.nodeclaim_templates[ti]
+            g = self.groups[gi]
+            err = nct.requirements.compatible(
+                g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
             )
+            if err is not None:
+                got = None
+            else:
+                joint = Requirements(*nct.requirements.values())
+                joint.add(*g.reqs.values())
+                got = (joint, self._rows_sans_hostname(joint))
+            self.tg_compat[key] = got
+        return got
 
-    # -- existing-node fill (per-pod: addToExistingNode, earliest index) -----
+    # -- joint masks ---------------------------------------------------------
 
-    def _fill_existing(self) -> None:
-        s = self.s
-        nodes = s.existing_nodes
-        if not nodes:
-            return
+    def _joint_masks(self, rows: frozenset, reqs: Requirements) -> tuple:
+        got = self.joint_cache.get(rows)
+        if got is None:
+            keys = [r.key for r in reqs if r.key != wk.LABEL_HOSTNAME]
+            got = self.engine.masks_for_rows(list(rows), keys)
+            self.joint_cache[rows] = got
+        return got
+
+    # -- existing nodes (addToExistingNode, scheduler.go:451-473) ------------
+
+    def _try_nodes(self, pod: Pod, g: _Group, gi: int) -> bool:
+        nodes = self.nodes
+        j = self.nptr[gi]
         N = len(nodes)
-        remaining = np.zeros((N, self.D), dtype=np.float64)
-        for ni, en in enumerate(nodes):
-            for name, v in en.remaining_resources.items():
-                dim = self.engine.resource_dims.get(name)
-                if dim is not None:
-                    remaining[ni, dim] = v
-        # Requirement/taint compat cached by node-label signature: clusters
-        # have few distinct node shapes, so the host checks stay tiny.
-        compat_cache: dict[tuple, bool] = {}
-        for gi, g in enumerate(self.groups):
-            total = len(g.pods)
-            left = total
-            for ni, en in enumerate(nodes):
-                if left == 0:
-                    break
-                # Live accumulated requirements: a prior fill that introduced
-                # a key narrows what later groups may join (the reference
-                # narrows node requirements on every Add). Un-narrowed nodes
-                # share a signature-keyed compat cache.
-                live_reqs = self.existing_reqs.get(ni)
-                if live_reqs is not None:
-                    ok = (
-                        Taints(en.cached_taints).tolerates_pod(g.pods[0]) is None
-                        and live_reqs.compatible(g.reqs) is None
-                    )
-                else:
-                    sig = (
-                        tuple(sorted(en.state_node.labels().items())),
-                        tuple((t.key, t.value, t.effect) for t in en.cached_taints),
-                        gi,
-                    )
-                    ok = compat_cache.get(sig)
-                    if ok is None:
-                        ok = (
-                            Taints(en.cached_taints).tolerates_pod(g.pods[0]) is None
-                            and en.requirements.compatible(g.reqs) is None
-                        )
-                        compat_cache[sig] = ok
-                if not ok:
-                    continue
-                rem_q = feas.quantize_resources(
-                    remaining[ni], ceil=False, scales=self.scales
-                )
-                if not np.all(rem_q >= 0):
-                    continue
-                per_dim = np.where(
-                    g.requests_q > 0,
-                    rem_q // np.maximum(g.requests_q, 1),
-                    np.iinfo(np.int64).max,
-                )
-                fit = int(min(int(np.min(per_dim)), left))
-                if fit <= 0:
-                    continue
-                start = total - left
-                self.existing_fills.append((ni, gi, start, fit))
-                base = self.existing_reqs.get(ni, en.requirements)
-                if any(not base.has(r.key) or base.get(r.key) != r for r in g.reqs):
-                    self.existing_reqs[ni] = _intersect(base, g.reqs)
-                remaining[ni] -= fit * np.array(
-                    [g.requests.get(n, 0.0) for n in self.engine.resource_dims],
-                    dtype=np.float64,
-                )
-                left -= fit
-            g.placed_existing = total - left
-
-    # -- claim-class FFD ------------------------------------------------------
-
-    def _narrow_types(self, types: np.ndarray, usage_q: np.ndarray) -> np.ndarray:
-        return types & np.all(self.alloc_q >= usage_q[None, :], axis=1)
-
-    def _fill_classes(self, gi: int, g: _Group, left: int) -> int:
-        """Join existing claim classes, emptiest first (scheduler.go:453-457
-        sorts in-flight claims by pod count ascending before CanAdd)."""
-        for cls in sorted(self.classes, key=lambda c: c.pods_per_claim):
-            if left == 0:
-                break
-            if cls.n_claims == 0:
+        while j < N:
+            nd = nodes[j]
+            tol = nd.gtol.get(gi)
+            if tol is None:
+                tol = Taints(nd.en.cached_taints).tolerates_pod(pod) is None
+                nd.gtol[gi] = tol
+            if not tol:
+                j += 1
                 continue
-            if cls.reqs.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+            cc = nd.gcompat.get(gi)
+            if cc is None or cc[0] != nd.version:
+                ok = nd.reqs.compatible(g.reqs) is None
+                nd.gcompat[gi] = (nd.version, ok)
+            else:
+                ok = cc[1]
+            if not ok:
+                # requirements only narrow: permanently incompatible
+                j += 1
                 continue
-            if Taints(cls.template.spec.taints).tolerates_pod(g.pods[0]) is not None:
-                continue
-            cand = cls.types & self.feas_free[gi]
-            if not cand.any():
-                continue
-            headroom = self.alloc_q[cand] - cls.usage_q[None, :]
-            with np.errstate(divide="ignore"):
-                per_type = np.where(
-                    g.requests_q[None, :] > 0,
-                    headroom // np.maximum(g.requests_q[None, :], 1),
-                    np.iinfo(np.int64).max,
-                )
-            per_type = np.where(np.all(headroom >= 0, axis=1, keepdims=True), per_type, -1)
-            k = int(np.max(np.min(per_type, axis=1), initial=-1))
+            kc = nd.gcap.get(gi)
+            if kc is None or kc[0] != nd.usage_ver:
+                k = self._node_capacity(nd, g)
+            else:
+                k = kc[1]
             if k <= 0:
+                # remaining resources only shrink: permanently full
+                j += 1
                 continue
-            if _narrows(cls.reqs, g.reqs):
-                self.needs_verify = True
-            joint = _intersect(cls.reqs, g.reqs)
-            # claims filled to capacity k, then possibly one partial claim
-            n_full = min(cls.n_claims, left // k)
-            rem = (left - n_full * k) if n_full < cls.n_claims else 0
-            took = n_full * k + rem
-            if took == 0:
-                continue
-            for count, n_cl in ((k, n_full), (rem, 1 if rem else 0)):
-                if n_cl == 0 or count == 0:
-                    continue
-                usage = cls.usage_q + count * g.requests_q
-                self.classes.append(
-                    _ClaimClass(
-                        cls.template,
-                        joint,
-                        self._narrow_types(cand, usage),
-                        usage,
-                        cls.pods_per_claim + count,
-                        n_cl,
-                        cls.members + [(gi, count)],
-                    )
-                )
-            cls.n_claims -= n_full + (1 if rem else 0)
-            left -= took
-        return left
-
-    def _open_claims(self, gi: int, g: _Group, left: int) -> int:
-        """Open new claims from the first feasible template in weight order
-        (scheduler.go:478-556 earliest-index-wins)."""
-        s = self.s
-        for ti, nct in enumerate(s.nodeclaim_templates):
-            if Taints(nct.spec.taints).tolerates_pod(g.pods[0]) is not None:
-                continue
-            if nct.requirements.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
-                continue
-            mask = self.tmpl_types[ti] & self.feas_free[gi]
-            remaining_limits = self.remaining_resources.get(nct.nodepool_name)
-            if remaining_limits:
-                mask = mask & self._limits_mask(nct, remaining_limits)
-            if not mask.any():
-                continue
-            base = self.tmpl_overhead_q[ti] + g.requests_q
-            headroom = self.alloc_q[mask] - self.tmpl_overhead_q[ti][None, :]
-            with np.errstate(divide="ignore"):
-                per_type = np.where(
-                    g.requests_q[None, :] > 0,
-                    headroom // np.maximum(g.requests_q[None, :], 1),
-                    np.iinfo(np.int64).max,
-                )
-            per_type = np.where(np.all(headroom >= 0, axis=1, keepdims=True), per_type, 0)
-            k = int(np.max(np.min(per_type, axis=1), initial=0))
-            if k <= 0:
-                continue
-            if _narrows(nct.requirements, g.reqs):
-                self.needs_verify = True
-            joint = _intersect(nct.requirements, g.reqs)
-            n_full, rem = divmod(left, k)
-            for count, n_cl in ((k, n_full), (rem, 1 if rem else 0)):
-                if n_cl == 0 or count == 0:
-                    continue
-                usage = self.tmpl_overhead_q[ti] + count * g.requests_q
-                self.classes.append(
-                    _ClaimClass(
-                        nct,
-                        joint,
-                        self._narrow_types(mask, usage),
-                        usage,
-                        count,
-                        n_cl,
-                        [(gi, count)],
-                    )
-                )
-                self._subtract_max(nct, mask, n_cl)
-            return 0
-        for pod in g.pods[len(g.pods) - left :]:
-            self.pod_errors[pod] = ValueError(
-                "all nodepools were incompatible or had no feasible instance types"
+            # join
+            self.nptr[gi] = j
+            nd.joined.append(pod)
+            nd.remaining = res.subtract(nd.remaining, g.requests)
+            narrowed = any(
+                not nd.reqs.has(r.key) or nd.reqs.get(r.key) != r for r in g.reqs
             )
-        return 0
+            if narrowed:
+                joint = Requirements(*nd.reqs.values())
+                joint.add(*g.reqs.values())
+                nd.reqs = joint
+                nd.version += 1
+            nd.usage_ver += 1
+            nd.gcap[gi] = (nd.usage_ver, k - 1)
+            return True
+        self.nptr[gi] = j
+        return False
 
-    def _limits_mask(self, nct, remaining: dict) -> np.ndarray:
-        mask = np.ones(self.engine.num_instances, dtype=bool)
-        for name, limit in remaining.items():
-            dim = self.engine.resource_dims.get(name)
-            if dim is None:
+    def _node_capacity(self, nd: _Node, g: _Group) -> int:
+        k = _BIG
+        remaining = nd.remaining
+        for name, v in g.requests.items():
+            if v <= 0:
                 continue
-            limit_q = feas.quantize_resources(
-                np.array([limit], dtype=np.float64), ceil=False, scales=self.scales[dim : dim + 1]
-            )[0]
-            mask &= self.alloc_q[:, dim] <= limit_q
+            have = remaining.get(name, 0.0)
+            k = min(k, int((have + _EPS) // v))
+            if k <= 0:
+                return 0
+        return int(k)
+
+    # -- in-flight claims (addToInflightNode, scheduler.go:510-543) ----------
+
+    def _try_claims(self, pod: Pod, g: _Group, gi: int) -> bool:
+        claims = self.claims
+        heap = self.gheaps[gi]
+        synced = self.gsynced[gi]
+        if synced < len(claims):
+            for ci in range(synced, len(claims)):
+                c = claims[ci]
+                heapq.heappush(heap, (c.count, c.rank, ci))
+            self.gsynced[gi] = len(claims)
+        req_f = g.req_f
+        fit_floor = g.fit_floor  # req_f - eps, precomputed
+        while heap:
+            count, rank, ci = heap[0]
+            c = claims[ci]
+            if gi in c.gdrop:
+                heapq.heappop(heap)
+                continue
+            if c.count != count or c.rank != rank:
+                heapq.heapreplace(heap, (c.count, c.rank, ci))
+                continue
+            if gi in c.gknown:
+                # steady state: requirements already subsumed; one small
+                # compare against the remaining-headroom matrix decides
+                fitrows = (c.rem >= fit_floor).all(axis=1)
+                if not fitrows.any():
+                    c.gdrop.add(gi)  # usage only grows: permanently full
+                    heapq.heappop(heap)
+                    continue
+            else:
+                fitrows = self._try_first_join(c, pod, g, gi)
+                if fitrows is None:
+                    c.gdrop.add(gi)  # all rejection reasons are monotone
+                    heapq.heappop(heap)
+                    continue
+            # join: usage grows by req_f; rows that no longer fit the NEW
+            # usage (exactly the rows failing this fit check) die forever
+            if fitrows.all():
+                c.rem -= req_f
+            else:
+                c.rem = c.rem[fitrows] - req_f
+                c.u_ids = c.u_ids[fitrows]
+            c.count = count + 1
+            self.seq += 1
+            c.rank = -self.seq
+            c.members.append(pod)
+            c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
+            heapq.heapreplace(heap, (c.count, c.rank, ci))
+            return True
+        return False
+
+    def _try_first_join(self, c: _Claim, pod: Pod, g: _Group, gi: int):
+        """First join of group g onto claim c: the full NodeClaim.can_add
+        gate sequence (nodeclaim.go:114-163). Returns the fit-row mask over
+        the claim's (possibly narrowed) headroom matrix, or None to reject
+        permanently. Commits requirement narrowing on success."""
+        tol = self.tg_tol.get((c.ti, gi))
+        if tol is None:
+            nct = self.s.nodeclaim_templates[c.ti]
+            tol = Taints(nct.spec.taints).tolerates_pod(pod) is None
+            self.tg_tol[(c.ti, gi)] = tol
+        if not tol:
+            return None
+        # Compatibility depends only on (claim requirement rows, group) —
+        # hostname placeholders differ between claims but only matter when
+        # the GROUP constrains hostname.
+        if g.has_hostname:
+            ok = c.reqs.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
+        else:
+            ckey = (c.rowkey, gi)
+            ok = self.rowkey_compat.get(ckey)
+            if ok is None:
+                ok = (
+                    c.reqs.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+                    is None
+                )
+                self.rowkey_compat[ckey] = ok
+        if not ok:
+            return None
+        if g.rowset <= c.rowkey:
+            # every group row IS the claim's row for that key: joint == claim
+            rows = c.rowkey
+            joint = None
+        else:
+            joint = Requirements(*c.reqs.values())
+            joint.add(*g.reqs.values())
+            rows = self._rows_sans_hostname(joint)
+        if rows != c.rowkey:
+            compat_v, offer_v = self._joint_masks(rows, joint)
+            new_mask = c.type_mask & compat_v & offer_v
+            # unique-alloc rows that still have a surviving type
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[self.uid_of_type[new_mask]] = True
+            keep = surv_u[c.u_ids]
+            fitrows = keep & (c.rem >= g.fit_floor).all(axis=1)
+            if not fitrows.any():
+                return None
+            # commit the requirement-level narrowing (host narrows options on
+            # every successful Add with the joint set)
+            c.type_mask = new_mask
+            c.rem = c.rem[keep]
+            c.u_ids = c.u_ids[keep]
+            c.rowkey = rows
+            c.reqs = joint
+            c.gknown.add(gi)
+            return fitrows[keep]
+        fitrows = (c.rem >= g.fit_floor).all(axis=1)
+        if not fitrows.any():
+            return None
+        if joint is not None:
+            c.reqs = joint
+        c.gknown.add(gi)
+        return fitrows
+
+    # -- new claims (addToNewNodeClaim, scheduler.go:478-556) ----------------
+
+    def _new_claim(self, pod: Pod, g: _Group, gi: int) -> Optional[Exception]:
+        cached = self.gnewclaim_err.get(gi)
+        if cached is not None and cached[0] == self.limits_version:
+            return cached[1]
+        s = self.s
+        errs: list[Exception] = []
+        for ti, nct in enumerate(s.nodeclaim_templates):
+            remaining = self.remaining_resources.get(nct.nodepool_name)
+            limits_mask = None
+            if remaining:
+                limits_mask = self._limits_mask(remaining)
+                if not (limits_mask & self.tmpl_mask[ti]).any():
+                    errs.append(
+                        ValueError(
+                            f"all available instance types exceed limits for "
+                            f"nodepool {nct.nodepool_name!r}"
+                        )
+                    )
+                    continue
+            tol = self.tg_tol.get((ti, gi))
+            if tol is None:
+                terr = Taints(nct.spec.taints).tolerates_pod(pod)
+                tol = terr is None
+                self.tg_tol[(ti, gi)] = tol
+            if not tol:
+                errs.append(
+                    ValueError(str(Taints(nct.spec.taints).tolerates_pod(pod)))
+                )
+                continue
+            tg = self._tg(ti, gi)
+            if tg is None:
+                errs.append(
+                    ValueError(
+                        "incompatible requirements, "
+                        + str(
+                            nct.requirements.compatible(
+                                g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                            )
+                        )
+                    )
+                )
+                continue
+            joint_tg, rows = tg
+            compat_v, offer_v = self._joint_masks(rows, joint_tg)
+            base = self.tmpl_mask[ti]
+            if limits_mask is not None:
+                base = base & limits_mask
+            candidate = base & compat_v & offer_v
+            cand_u = np.unique(self.uid_of_type[candidate])
+            rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
+            fitrows = (rem0 >= -_EPS).all(axis=1)
+            if not fitrows.any():
+                errs.append(self._filter_error(base, compat_v, offer_v, ti, g))
+                continue
+            # success: open the claim
+            self.seq += 1
+            reqs = Requirements(*joint_tg.values())
+            reqs.add(
+                Requirement(
+                    wk.LABEL_HOSTNAME,
+                    Operator.IN,
+                    [f"device-placeholder-{next(_placeholder_counter):04d}"],
+                )
+            )
+            c = _Claim(
+                ti, reqs, rows, candidate, cand_u[fitrows], rem0[fitrows], self.seq
+            )
+            c.count = 1
+            c.members.append(pod)
+            c.group_counts[gi] = 1
+            c.gknown.add(gi)
+            self.claims.append(c)
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[c.u_ids] = True
+            self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
+            return None
+        if not errs:
+            errs.append(ValueError("no nodepool can host the pod"))
+        err = (
+            errs[0]
+            if len(errs) == 1
+            else ValueError("; ".join(str(e) for e in errs))
+        )
+        self.gnewclaim_err[gi] = (self.limits_version, err)
+        return err
+
+    def _limits_mask(self, remaining: dict) -> np.ndarray:
+        """Types whose CAPACITY fits inside the nodepool's remaining limits
+        (scheduler.go:670-686; _filter_by_remaining_resources)."""
+        mask = np.ones(self.I, dtype=bool)
+        for name, limit in remaining.items():
+            d = self.dims.get(name)
+            if d is None:
+                if 0.0 > limit + _EPS:
+                    mask[:] = False
+            else:
+                mask &= self.cap_f[:, d] <= limit + _EPS
         return mask
 
-    def _subtract_max(self, nct, mask: np.ndarray, n_claims: int) -> None:
-        """Pessimistic nodepool-limit tracking: subtract the max resources
-        over the claim's options per claim (scheduler.go:744-765)."""
+    def _subtract_max(self, nct, types_mask: np.ndarray) -> None:
+        """Pessimistic nodepool-limit tracking: subtract the max CAPACITY
+        over the claim's narrowed options (scheduler.go:744-765)."""
         remaining = self.remaining_resources.get(nct.nodepool_name)
         if not remaining:
             return
-        idxs = np.nonzero(mask)[0]
-        maxes: dict[str, float] = {}
-        for i in idxs:
-            for name, v in self.engine.instance_types[i].allocatable().items():
-                if v > maxes.get(name, 0.0):
-                    maxes[name] = v
-        scaled = {k: v * n_claims for k, v in maxes.items()}
-        self.remaining_resources[nct.nodepool_name] = res.subtract(remaining, scaled)
+        if types_mask.any():
+            maxes = self.cap_f[types_mask].max(axis=0)
+        else:
+            maxes = np.zeros(self.D)
+        self.remaining_resources[nct.nodepool_name] = {
+            k: (v - maxes[self.dims[k]] if k in self.dims else v)
+            for k, v in remaining.items()
+        }
+        self.limits_version += 1
 
-    # -- final verification ---------------------------------------------------
+    def _filter_error(
+        self,
+        base: np.ndarray,
+        compat_v: np.ndarray,
+        offer_v: np.ndarray,
+        ti: int,
+        g: _Group,
+    ) -> InstanceTypeFilterError:
+        """Host-identical three-criteria diagnostics over the limits-filtered
+        option set (nodeclaim.go:247-441)."""
+        fits_v = self._fits_vec(self.usage0_f[ti] + g.req_f)
+        m = base
+        c, f, o = compat_v[m], fits_v[m], offer_v[m]
+        err = InstanceTypeFilterError()
+        err.requirements_met = bool(c.any())
+        err.fits = bool(f.any())
+        err.has_offering = bool(o.any())
+        err.requirements_and_fits = bool((c & f & ~o).any())
+        err.requirements_and_offering = bool((c & o & ~f).any())
+        err.fits_and_offering = bool((f & o & ~c).any())
+        return err
 
-    def _verify(self) -> bool:
-        """Re-filter every class against its ACCUMULATED requirements in one
-        batched device call. Returns False (→ host fallback) if any class's
-        type set shrinks below what the packing assumed. Skipped when no two
-        sources ever constrained the same key differently — then per-source
-        intersection IS the joint feasibility and the round trip is wasted."""
-        if not self.classes or not self.needs_verify:
-            return True
-        e = self.engine
-        row_sets = [e.rows_for(c.reqs) for c in self.classes]
-        key_present = e.key_presence([c.reqs for c in self.classes])
-        requests = np.zeros((len(self.classes), self.D), dtype=np.float32)
-        fz = e.feasibility(row_sets, requests, key_present)
-        joint_ok = fz.compat & fz.has_offering  # [C, I]
-        for ci, cls in enumerate(self.classes):
-            narrowed = cls.types & joint_ok[ci]
-            fits = self._narrow_types(narrowed, cls.usage_q)
-            if not fits.any():
-                return False
-            cls.types = fits
-        return True
+    def _fits_vec(self, requests_f: np.ndarray) -> np.ndarray:
+        pos = np.nonzero(requests_f > 0)[0]
+        if not pos.size:
+            return np.ones(self.I, dtype=bool)
+        return np.all(
+            requests_f[pos][None, :] <= self.alloc_f[:, pos] + _EPS, axis=1
+        )
 
-    # -- output ---------------------------------------------------------------
+    # -- main loop (Scheduler._solve, scheduler.go:346-429) ------------------
 
-    def _emit(self) -> None:
+    def run(self, timeout: Optional[float]) -> None:
+        pairs = self._group_pods()
+        if pairs is None:
+            raise _Fallback("ineligible pod shape")
+        self._prepare_templates()
+        qpods = self._sorted(pairs)
+        head = 0
+        last_len: dict[str, int] = {}
+        pod_errors = self.pod_errors
+        start = time.perf_counter()
+        check = 0
+        while head < len(qpods):
+            pod, gi = qpods[head]
+            if last_len.get(pod.metadata.uid) == len(qpods) - head:
+                break
+            check += 1
+            if timeout is not None and not (check & 0x1FF):
+                if time.perf_counter() - start > timeout:
+                    self.timed_out = True
+                    for p, _ in qpods[head:]:
+                        pod_errors.setdefault(
+                            p, TimeoutError("scheduling simulation timed out")
+                        )
+                    return
+            head += 1
+            g = self.groups[gi]
+            if self.nodes and self._try_nodes(pod, g, gi):
+                pod_errors.pop(pod, None)
+                continue
+            if self._try_claims(pod, g, gi):
+                pod_errors.pop(pod, None)
+                continue
+            if not self.s.nodeclaim_templates:
+                err: Exception = ValueError(
+                    "nodepool requirements filtered out all available instance types"
+                )
+            else:
+                maybe = self._new_claim(pod, g, gi)
+                if maybe is None:
+                    pod_errors.pop(pod, None)
+                    continue
+                err = maybe
+            pod_errors[pod] = err
+            qpods.append((pod, gi))
+            last_len[pod.metadata.uid] = len(qpods) - head
+
+    # -- output --------------------------------------------------------------
+
+    def emit(self):
         """Materialize scheduler state: existing-node fills, nodepool limit
         tracking, and host SchedNodeClaim objects (one per claim)."""
         import copy as _copy
@@ -589,90 +906,79 @@ class _DeviceSolve:
         from karpenter_tpu.scheduler.nodeclaim import NodeClaim as SchedNodeClaim
 
         s = self.s
-        for ni, gi, start, count in self.existing_fills:
-            en = s.existing_nodes[ni]
-            g = self.groups[gi]
-            take = g.pods[start : start + count]
-            en.pods.extend(take)
-            en.remaining_resources = res.subtract(
-                en.remaining_resources, {k: v * count for k, v in g.requests.items()}
-            )
-        for ni, reqs in self.existing_reqs.items():
-            s.existing_nodes[ni].requirements = reqs
-        s.remaining_resources.update(self.remaining_resources)
-        # per-group cursors for handing out pod slices; existing-node fills
-        # consumed the head of each group's pod list
-        cursors = [g.placed_existing for g in self.groups]
-        for cls in self.classes:
-            if cls.n_claims <= 0:
+        for nd in self.nodes:
+            if not nd.joined:
                 continue
-            options = []
-            for it in cls.template.instance_type_options:
-                idx = self.type_index.get(id(it))
-                if idx is None:
-                    idx = self._name_index.get(it.name)
-                if idx is not None and cls.types[idx]:
-                    options.append(it)
-            for _ in range(cls.n_claims):
-                nc = SchedNodeClaim(
-                    cls.template,
-                    s.topology,
-                    s.daemon_overhead[cls.template],
-                    _copy.deepcopy(s.daemon_hostports[cls.template]),
-                    options,
-                    s.reservation_manager,
-                    s.reserved_offering_mode,
-                    s.reserved_capacity_enabled,
-                    engine=s.engine,
+            en = nd.en
+            en.pods.extend(nd.joined)
+            en.remaining_resources = nd.remaining
+            en.requirements = nd.reqs
+        s.remaining_resources.update(self.remaining_resources)
+        for c in self.claims:
+            nct = s.nodeclaim_templates[c.ti]
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[c.u_ids] = True
+            final_types = c.type_mask & surv_u[self.uid_of_type]
+            options = [
+                self.tmpl_options[c.ti][j]
+                for j, i in enumerate(self.opt_index[c.ti])
+                if final_types[i]
+            ]
+            nc = SchedNodeClaim(
+                nct,
+                s.topology,
+                s.daemon_overhead[nct],
+                _copy.deepcopy(s.daemon_hostports[nct]),
+                options,
+                s.reservation_manager,
+                s.reserved_offering_mode,
+                s.reserved_capacity_enabled,
+                engine=s.engine,
+            )
+            nc.requirements = c.reqs
+            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] = "false"
+            nc.pods = list(c.members)
+            requests = dict(s.daemon_overhead[nct])
+            for gi, count in c.group_counts.items():
+                g = self.groups[gi]
+                requests = res.merge(
+                    requests, {k: v * count for k, v in g.requests.items()}
                 )
-                reqs = Requirements(*cls.reqs.values())
-                reqs.add(*nc.requirements.values())  # keeps hostname placeholder
-                nc.requirements = reqs
-                requests = dict(s.daemon_overhead[cls.template])
-                for gi, count in cls.members:
-                    g = self.groups[gi]
-                    take = g.pods[cursors[gi] : cursors[gi] + count]
-                    cursors[gi] += count
-                    nc.pods.extend(take)
-                    requests = res.merge(
-                        requests, {k: v * count for k, v in g.requests.items()}
-                    )
-                nc.requests = requests
-                s.new_node_claims.append(nc)
+            nc.requests = requests
+            s.new_node_claims.append(nc)
 
 
-def solve_device(scheduler, pods: Sequence[Pod]):
-    """Run the device FFD; returns Results, or None → caller uses the host
-    loop (either ineligible or the final verification found the per-group
-    feasibility intersection was looser than the joint one)."""
+def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0):
+    """Run the device-accelerated exact FFD; returns Results, or None → the
+    caller uses the host loop (ineligible shape/solve)."""
     global DEVICE_SOLVES, DEVICE_FALLBACKS
     from karpenter_tpu.scheduler.scheduler import Results
 
     if not eligible(scheduler, pods):
         DEVICE_FALLBACKS += 1
+        _FALLBACKS_CTR.inc()
         return None
     solve = _DeviceSolve(scheduler, pods)
-    if not solve._encode():
+    try:
+        solve.run(timeout)
+        solve.emit()
+    except _Fallback:
         DEVICE_FALLBACKS += 1
+        _FALLBACKS_CTR.inc()
         return None
-    solve._template_masks()
-    solve._fill_existing()
-    for gi, g in enumerate(solve.groups):
-        left = len(g.pods) - g.placed_existing
-        if left == 0:
-            continue
-        left = solve._fill_classes(gi, g, left)
-        if left > 0:
-            solve._open_claims(gi, g, left)
-    if not solve._verify():
+    except Exception:
+        if STRICT:
+            raise
         DEVICE_FALLBACKS += 1
+        _FALLBACKS_CTR.inc()
         return None
-    solve._emit()
     DEVICE_SOLVES += 1
+    _SOLVES_CTR.inc()
     for nc in scheduler.new_node_claims:
         nc.finalize_scheduling()
     return Results(
         new_node_claims=scheduler.new_node_claims,
         existing_nodes=scheduler.existing_nodes,
         pod_errors=solve.pod_errors,
+        timed_out=solve.timed_out,
     )

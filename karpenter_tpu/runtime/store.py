@@ -108,6 +108,9 @@ class Store:
         if not obj.metadata.creation_timestamp:
             obj.metadata.creation_timestamp = self.clock.now()
         bucket[key] = obj
+        # Keep the apply() snapshot current: the DeepEqual guard compares
+        # against the object's latest written state, not the last patch.
+        self._applied_repr[(kind, key)] = repr(obj)
         self._emit(ADDED, obj)
         return obj
 
@@ -149,6 +152,10 @@ class Store:
         self._version += 1
         obj.metadata.resource_version = self._version
         bucket[key] = obj
+        # Refresh the apply() snapshot: an interleaved update() that mutates
+        # an object must not let a later apply() suppress the revert (the
+        # reference's DeepEqual guard compares against the stored object).
+        self._applied_repr[(obj.KIND, key)] = repr(obj)
         self._emit(MODIFIED, obj)
         # Deleting object whose finalizers were all stripped is removed now.
         if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:

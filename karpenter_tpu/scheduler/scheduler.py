@@ -317,7 +317,7 @@ class Scheduler:
         if self.engine is not None:
             from karpenter_tpu.ops import ffd
 
-            device_results = ffd.solve_device(self, pods)
+            device_results = ffd.solve_device(self, pods, timeout)
             if device_results is not None:
                 _UNSCHEDULABLE_GAUGE.set(float(len(device_results.pod_errors)))
                 return device_results

@@ -141,6 +141,32 @@ class TestBinding:
         assert binder.reconcile() == 0
         assert store.get("Pod", candidate.metadata.name).spec.node_name == ""
 
+    def test_terminal_pods_do_not_repel(self):
+        """kube-scheduler ignores Succeeded/Failed pods for inter-pod
+        anti-affinity; the per-sweep index must filter them."""
+        clock, store, cluster, informer, binder = make_binder()
+        node, _ = add_node(store, informer)
+        placed = unschedulable_pod(requests={"cpu": "1"}, labels={"app": "db"})
+        placed.spec.affinity = Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "web"}),
+                    )
+                ]
+            )
+        )
+        placed.spec.node_name = node.metadata.name
+        placed.status.phase = "Succeeded"
+        store.create(placed)
+        candidate = store.create(
+            unschedulable_pod(requests={"cpu": "1"}, labels={"app": "web"})
+        )
+        informer.flush()
+        assert binder.reconcile() == 1
+        assert store.get("Pod", candidate.metadata.name).spec.node_name == "n1"
+
     def test_skips_sweep_when_store_unchanged(self):
         clock, store, cluster, informer, binder = make_binder()
         add_node(store, informer)

@@ -1,0 +1,244 @@
+"""Device-FFD decision-parity suite.
+
+The device fast path (ops/ffd.py) must produce decisions IDENTICAL to the
+host per-pod loop — claim count, per-claim pod sets, per-claim instance-type
+option sets, per-claim requirements, existing-node assignments, and pod
+errors (BASELINE.md decision-parity requirement; the semantics oracle is the
+reference's scheduler.go:346-401 + nodeclaim.go:373-441).
+
+Workloads are randomized but fully deterministic (seeded; pinned pod UIDs
+and creation timestamps — the host queue tie-breaks on them, so identity
+across runs requires identical metadata). Run the long fuzz directly:
+
+    python tests/test_device_parity.py 1000
+"""
+
+import random
+import sys
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.catalog import CatalogEngine
+
+from helpers import daemonset, daemonset_pod, nodepool, registered_node, unschedulable_pod
+from test_scheduler import Env
+
+CATALOG = construct_instance_types()
+ZONES = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+ARCHS = ["amd64", "arm64"]
+OSES = ["linux", "windows"]
+CPUS = ["250m", "500m", "1", "2", "3", "4", "7", "16"]
+MEMS = ["256Mi", "512Mi", "1Gi", "2Gi", "7Gi"]
+
+
+def _random_nodepools(rng: random.Random):
+    pools = []
+    for i in range(rng.randint(1, 3)):
+        requirements = []
+        if rng.random() < 0.4:
+            requirements.append(
+                {"key": wk.LABEL_ARCH, "operator": "In", "values": [rng.choice(ARCHS)]}
+            )
+        if rng.random() < 0.3:
+            requirements.append(
+                {
+                    "key": wk.LABEL_TOPOLOGY_ZONE,
+                    "operator": rng.choice(["In", "NotIn"]),
+                    "values": rng.sample(ZONES, rng.randint(1, 2)),
+                }
+            )
+        taints = []
+        if rng.random() < 0.25:
+            taints.append(Taint(key="team", value="infra", effect="NoSchedule"))
+        limits = None
+        if rng.random() < 0.3:
+            limits = {"cpu": str(rng.choice([16, 64, 256]))}
+        pools.append(
+            nodepool(
+                f"pool-{i}",
+                requirements=requirements,
+                taints=taints,
+                limits=limits,
+                weight=rng.randint(0, 10),
+            )
+        )
+    return pools
+
+
+def _random_shape(rng: random.Random, si: int):
+    kwargs = {"requests": {"cpu": rng.choice(CPUS), "memory": rng.choice(MEMS)}}
+    selector = {}
+    roll = rng.random()
+    if roll < 0.3:
+        selector[wk.LABEL_ARCH] = rng.choice(ARCHS)
+    if 0.2 < roll < 0.45:
+        selector[wk.LABEL_TOPOLOGY_ZONE] = rng.choice(ZONES)
+    if roll > 0.9:
+        selector[wk.LABEL_OS] = rng.choice(OSES)
+    if roll > 0.97:
+        selector[wk.CAPACITY_TYPE_LABEL_KEY] = rng.choice(
+            [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
+        )
+    if selector:
+        kwargs["node_selector"] = selector
+    spec_kwargs = {}
+    if rng.random() < 0.25:
+        spec_kwargs["tolerations"] = [
+            Toleration(key="team", operator="Equal", value="infra", effect="NoSchedule")
+        ]
+    if rng.random() < 0.15:
+        op = rng.choice(["In", "NotIn"])
+        spec_kwargs["affinity"] = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": wk.LABEL_TOPOLOGY_ZONE,
+                                "operator": op,
+                                "values": rng.sample(ZONES, rng.randint(1, 3)),
+                            }
+                        ]
+                    )
+                ]
+            )
+        )
+    if rng.random() < 0.04:
+        kwargs["requests"] = {"cpu": "10000"}  # unschedulable: error-path parity
+    return kwargs, spec_kwargs
+
+
+def build_case(seed: int):
+    """(node_pools, state_nodes, daemonset_pods, build_pods) for one case."""
+    rng = random.Random(seed)
+    pools = _random_nodepools(rng)
+    nodes = []
+    for i in range(rng.randint(0, 6)):
+        pool = rng.choice(pools).metadata.name
+        nodes.append(
+            registered_node(
+                name=f"existing-{i}",
+                pool=pool,
+                instance_type="s-4x-amd64-linux",
+                zone=rng.choice(ZONES),
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+                labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux"},
+            )
+        )
+    ds_pods = []
+    if rng.random() < 0.4:
+        ds = daemonset(requests={"cpu": "100m", "memory": "64Mi"})
+        ds_pods.append(daemonset_pod(ds))
+    n_pods = rng.randint(ffd.DEVICE_MIN_PODS, 320)
+    shapes = [_random_shape(rng, si) for si in range(rng.randint(3, 24))]
+    picks = [rng.randrange(len(shapes)) for _ in range(n_pods)]
+
+    def build_pods():
+        pods = []
+        for i, si in enumerate(picks):
+            kwargs, spec_kwargs = shapes[si]
+            p = unschedulable_pod(name=f"p-{i:05d}", **kwargs, **spec_kwargs)
+            p.metadata.uid = f"uid-{i:05d}"
+            p.metadata.creation_timestamp = float(i % 7)  # exercise uid ties
+            pods.append(p)
+        return pods
+
+    return pools, nodes, ds_pods, build_pods
+
+
+def decisions(results):
+    claims = []
+    for nc in results.new_node_claims:
+        claims.append(
+            (
+                nc.nodepool_name,
+                tuple(sorted(it.name for it in nc.instance_type_options)),
+                tuple(sorted(p.metadata.name for p in nc.pods)),
+                tuple(
+                    sorted(
+                        (r.key, tuple(sorted(r.values)), r.complement, r.greater_than, r.less_than)
+                        for r in nc.requirements
+                    )
+                ),
+            )
+        )
+    claims.sort()
+    existing = sorted(
+        (en.name(), tuple(sorted(p.metadata.name for p in en.pods)))
+        for en in results.existing_nodes
+        if en.pods
+    )
+    errors = sorted(
+        (p.metadata.name, type(e).__name__, str(e)) for p, e in results.pod_errors.items()
+    )
+    return claims, existing, errors
+
+
+def run_case(seed: int):
+    """Returns (host_decisions, device_decisions, device_ran)."""
+    pools, nodes, ds_pods, build_pods = build_case(seed)
+
+    def env(engine):
+        import copy
+
+        return Env(
+            node_pools=copy.deepcopy(pools),
+            state_nodes=copy.deepcopy(nodes),
+            daemonset_pods=copy.deepcopy(ds_pods),
+            engine=engine,
+        )
+
+    host = decisions(env(None).schedule(build_pods()))
+    solves0 = ffd.DEVICE_SOLVES
+    old_strict = ffd.STRICT
+    ffd.STRICT = True
+    try:
+        dev = decisions(env(CatalogEngine(CATALOG)).schedule(build_pods()))
+    finally:
+        ffd.STRICT = old_strict
+    return host, dev, ffd.DEVICE_SOLVES > solves0
+
+
+class TestDeviceParity:
+    @pytest.mark.parametrize("seed", range(40))
+    def test_randomized_decision_parity(self, seed):
+        host, dev, ran = run_case(seed)
+        assert host == dev
+        assert ran, "device path unexpectedly fell back to the host loop"
+
+    def test_device_solves_counter_never_regresses_to_fallback(self):
+        """The production-shaped workload (≥64 plain pods, kwok catalog) must
+        take the device path — guards against silent eligibility regressions."""
+        _, _, ran = run_case(12345)
+        assert ran
+
+
+def main(n_cases: int) -> int:
+    failures = 0
+    fallbacks = 0
+    for seed in range(n_cases):
+        host, dev, ran = run_case(seed)
+        if host != dev:
+            failures += 1
+            print(f"seed {seed}: DIVERGED")
+        if not ran:
+            fallbacks += 1
+            print(f"seed {seed}: fell back to host loop")
+        if seed % 100 == 99:
+            print(f"{seed + 1}/{n_cases} cases, {failures} divergences, {fallbacks} fallbacks")
+    print(f"DONE: {n_cases} cases, {failures} divergences, {fallbacks} fallbacks")
+    return 1 if (failures or fallbacks) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 1000))

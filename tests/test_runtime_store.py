@@ -81,6 +81,31 @@ class TestStore:
         assert len(w.drain()) == 0
 
 
+class TestApplySnapshot:
+    def test_apply_suppresses_only_unchanged_since_last_write(self):
+        """apply() compares against the object's latest WRITTEN state: an
+        interleaved update() must not let a later apply() suppress a revert
+        (the reference's DeepEqual guard compares the stored object)."""
+        s = Store()
+        w = s.watch(["Pod"])
+        p = s.create(pod("a"))
+        p.spec.node_name = "n1"
+        s.apply(p)
+        # same state re-applied: suppressed
+        w.drain()
+        s.apply(p)
+        assert len(w.drain()) == 0
+        # interleaved update() to a different state...
+        p.spec.node_name = "n2"
+        s.update(p)
+        # ...then a revert back to the last-applied state MUST emit
+        p.spec.node_name = "n1"
+        w.drain()
+        s.apply(p)
+        assert [e.type for e in w.drain()] == [MODIFIED]
+        assert s.get("Pod", "a").spec.node_name == "n1"
+
+
 class TestRecorder:
     def test_dedupes_within_ttl(self):
         clock = FakeClock()
