@@ -39,6 +39,59 @@ def _req_cache_key(r: Requirement) -> tuple:
     return (r.key, r.complement, r.greater_than, r.less_than, frozenset(r.values))
 
 
+_RTT_CACHE: dict[str, float] = {}
+
+
+def device_rtt_s() -> float:
+    """Measured round-trip latency of one tiny dispatch+fetch on the default
+    backend, cached per process.
+
+    Dispatch is latency-aware (SURVEY §7: "bucketing/padding discipline" —
+    and here, transport discipline): against a co-located chip the RTT is
+    ~0.1 ms and even small cubes win on device; through a tunneled/remote
+    chip an RTT can be ~100 ms and small cubes must take the exact host twin
+    instead. Measuring beats guessing — the same binary runs in both worlds.
+    """
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no usable backend: never pick device
+        return float("inf")
+    rtt = _RTT_CACHE.get(backend)
+    if rtt is None:
+        import time as _time
+
+        try:
+            probe = jax.jit(lambda x: x + 1)
+            np.asarray(probe(jnp.ones((8,), jnp.float32)))  # compile + warm
+            t0 = _time.perf_counter()
+            np.asarray(probe(jnp.full((8,), 2.0, jnp.float32)))
+            rtt = _time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 — broken device: force the host twin
+            rtt = float("inf")
+        _RTT_CACHE[backend] = rtt
+    return rtt
+
+
+# Host-twin throughput estimates (cells/second), deliberately conservative so
+# the device keeps the large cubes. Calibrated on one x86 core with float32
+# BLAS for the membership matmuls.
+_HOST_MATMUL_CELLS_PER_S = 2.0e9
+_HOST_ROW_CELLS_PER_S = 0.5e9
+
+# "device" / "host" pin the dispatch for tests and benchmarks; None = adaptive.
+FORCE_BACKEND: Optional[str] = None
+
+
+def _use_device(host_cells: float, cells_per_s: float) -> bool:
+    if FORCE_BACKEND == "device":
+        return True
+    if FORCE_BACKEND == "host":
+        return False
+    return host_cells / cells_per_s > device_rtt_s()
+
+
 @dataclass
 class Feasibility:
     """Per-(entity, instance-type) feasibility triple plus diagnostics."""
@@ -192,14 +245,10 @@ class CatalogEngine:
         ):
             self._encode_catalog(list(self.offering_owner))
 
-    # Below this many new rows the device dispatch costs more than the host
-    # twin; the sequential FFD simulation interns joint rows a few at a time.
-    _DEVICE_MIN_NEW_ROWS = 48
-
     def _ensure_rows(self) -> None:
         """Compute compat matrices for any rows added since the last call.
-        Large batches (catalog warm-up, the per-solve template x group sweep)
-        run on device; incremental joint rows use the exact numpy twin."""
+        Batches whose estimated host cost exceeds the measured device RTT run
+        on device; incremental joint rows use the exact numpy twin."""
         if self._computed_rows == len(self._rows):
             return
         new_rows = self._rows[self._computed_rows :]
@@ -216,7 +265,12 @@ class CatalogEngine:
             pad = self._word_capacity - er.mask.shape[1]
             er.mask = np.pad(er.mask, ((0, 0), (0, pad)))
 
-        on_device = len(new_rows) >= self._DEVICE_MIN_NEW_ROWS
+        # row kernel work ~ R * (I + O) * G slot-cells on host
+        slots = self._word_capacity * 32  # G = word_capacity * WORD value slots
+        host_cells = (
+            len(new_rows) * (self.num_instances + self.num_offerings) * max(slots, 1)
+        )
+        on_device = _use_device(host_cells, _HOST_ROW_CELLS_PER_S)
         cast = jnp.asarray if on_device else np.asarray
         kernel = feas.req_rows_vs_sets if on_device else feas.req_rows_vs_sets_np
         row_args = (
@@ -345,7 +399,9 @@ class CatalogEngine:
 
         The row axis is restricted to the NON-TRIVIAL rows actually used by
         this query, and both axes are padded to power-of-two buckets so the
-        jitted kernels hit the compile cache across solves."""
+        jitted kernels hit the compile cache across solves. Dispatch is
+        latency-aware (see device_rtt_s): cubes too small to amortize the
+        measured device round-trip run through the exact numpy twins."""
         self._ensure_rows()
         P = len(row_sets)
         used = sorted(
@@ -362,14 +418,12 @@ class CatalogEngine:
                 if i is not None:
                     membership[p, i] = True
 
+        host_cells = P2 * R2 * (self.num_instances + self.num_offerings)
+        on_device = _use_device(host_cells, _HOST_MATMUL_CELLS_PER_S)
+
+        req_compat_h = np.zeros((R2, self.num_instances), dtype=bool)
         if used:
-            req_compat_h = np.zeros((R2, self.num_instances), dtype=bool)
             req_compat_h[:R] = self._req_compat[used]
-            req_compat = jnp.asarray(req_compat_h)
-        else:
-            req_compat = jnp.zeros((R2, self.num_instances), dtype=bool)
-        membership_dev = jnp.asarray(membership)
-        compat = np.asarray(feas.membership_all(membership_dev, req_compat))[:P]
         # fits stays host-side in float64: exact parity with resources.fits
         # at byte magnitudes; it's an O(P*I*D) elementwise op, not the matmul.
         fits = np.all(
@@ -378,28 +432,47 @@ class CatalogEngine:
             axis=-1,
         )
 
-        if self.num_offerings == 0:
-            has_offering = np.zeros((P, self.num_instances), dtype=bool)
-            return Feasibility(compat, fits, has_offering)
-
         if key_present is None:
             key_present = np.zeros((P, self._key_capacity), dtype=bool)
         key_present_p = np.zeros((P2, key_present.shape[1]), dtype=bool)
         key_present_p[:P] = key_present
-        if used:
-            offer_compat_h = np.zeros((R2, self.num_offerings), dtype=bool)
+        offer_compat_h = np.zeros((R2, self.num_offerings), dtype=bool)
+        if used and self.num_offerings:
             offer_compat_h[:R] = self._offer_compat[used]
-            offer_compat = jnp.asarray(offer_compat_h)
-        else:
-            offer_compat = jnp.zeros((R2, self.num_offerings), dtype=bool)
-        has_offering = np.asarray(
-            feas.offering_reduce(
-                membership_dev,
-                offer_compat,
-                self._dev("custom_need", self.offering_custom_need),
-                jnp.asarray(key_present_p),
-                self._dev("available", self.offering_available),
-                self._dev("owner_onehot", self._owner_onehot),
+
+        if on_device:
+            membership_dev = jnp.asarray(membership)
+            compat = np.asarray(
+                feas.membership_all(membership_dev, jnp.asarray(req_compat_h))
+            )[:P]
+            if self.num_offerings == 0:
+                return Feasibility(
+                    compat, fits, np.zeros((P, self.num_instances), dtype=bool)
+                )
+            has_offering = np.asarray(
+                feas.offering_reduce(
+                    membership_dev,
+                    jnp.asarray(offer_compat_h),
+                    self._dev("custom_need", self.offering_custom_need),
+                    jnp.asarray(key_present_p),
+                    self._dev("available", self.offering_available),
+                    self._dev("owner_onehot", self._owner_onehot),
+                )
+            )[:P]
+            return Feasibility(compat, fits, has_offering)
+
+        compat = feas.membership_all_np(membership, req_compat_h)[:P]
+        if self.num_offerings == 0:
+            return Feasibility(
+                compat, fits, np.zeros((P, self.num_instances), dtype=bool)
             )
+        has_offering = feas.offering_reduce_np(
+            membership,
+            offer_compat_h,
+            self.offering_custom_need,
+            key_present_p,
+            self.offering_available,
+            self.offering_owner,
+            self.num_instances,
         )[:P]
         return Feasibility(compat, fits, has_offering)

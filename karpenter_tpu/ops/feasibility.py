@@ -189,6 +189,36 @@ def membership_all(membership: jnp.ndarray, row_ok: jnp.ndarray) -> jnp.ndarray:
     return bad < 0.5
 
 
+def membership_all_np(membership: np.ndarray, row_ok: np.ndarray) -> np.ndarray:
+    """Host twin of membership_all (float32 BLAS; counts are small integers
+    represented exactly, so the <0.5 threshold is bit-identical)."""
+    bad = membership.astype(np.float32) @ (~row_ok).astype(np.float32)
+    return bad < 0.5
+
+
+def offering_reduce_np(
+    membership: np.ndarray,
+    offer_compat: np.ndarray,
+    custom_need: np.ndarray,
+    key_present: np.ndarray,
+    available: np.ndarray,
+    offering_owner: np.ndarray,
+    num_instances: int,
+) -> np.ndarray:
+    """Host twin of offering_reduce. The offering→instance any-reduce uses a
+    per-row scatter instead of the [O, I] one-hot matmul — the host path only
+    runs for cubes small enough that the matmul would be waste."""
+    offer_rows_ok = membership_all_np(membership, offer_compat)  # [P, O]
+    bad = custom_need.astype(np.float32) @ (~key_present).astype(np.float32).T
+    undef_ok = (bad < 0.5).T  # [P, O]
+    offer_ok = offer_rows_ok & undef_ok & available[None, :]
+    P = membership.shape[0]
+    out = np.zeros((P, num_instances), dtype=bool)
+    for p in range(P):
+        out[p, offering_owner[offer_ok[p]]] = True
+    return out
+
+
 @jax.jit
 def fits_matrix(requests: jnp.ndarray, allocatable: jnp.ndarray) -> jnp.ndarray:
     """fits[P, I]: requests[p] <= allocatable[i] element-wise.

@@ -126,6 +126,10 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     for nct in scheduler.nodeclaim_templates:
         if nct.requirements.has_min_values():
             return False
+        # hostname-constrained templates would break family sharing (the
+        # canonical family Requirements are hostname-free)
+        if nct.requirements.has(wk.LABEL_HOSTNAME):
+            return False
         if any(k not in dims for k in scheduler.daemon_overhead[nct]):
             return False
     return True
@@ -273,17 +277,22 @@ class _Claim:
     the remaining headroom `rem = allocatable − usage` over exactly the
     UNIQUE allocatable vectors that still fit the current usage — rows that
     stop fitting are pruned permanently, so every join is a handful of
-    small-array ops; the emitted option set is type_mask ∧ surviving rows."""
+    small-array ops; the emitted option set is type_mask ∧ surviving rows.
+
+    Requirement state is an interned FAMILY id: claims sharing a requirement
+    row-set share one id, one canonical (hostname-free) Requirements object,
+    and one memoized join-transition table — the expensive requirement
+    algebra runs once per (family, group), not once per (claim, group)."""
 
     __slots__ = (
-        "ti", "reqs", "rowkey", "type_mask", "u_ids", "rem", "count", "rank",
+        "ti", "fam", "hostname", "type_mask", "u_ids", "rem", "count", "rank",
         "members", "group_counts", "gdrop", "gknown",
     )
 
-    def __init__(self, ti, reqs, rowkey, type_mask, u_ids, rem, rank):
+    def __init__(self, ti, fam, hostname, type_mask, u_ids, rem, rank):
         self.ti = ti
-        self.reqs = reqs  # host Requirements incl. hostname placeholder
-        self.rowkey = rowkey  # frozenset of engine row ids, sans hostname
+        self.fam = fam  # interned row-set family id
+        self.hostname = hostname  # per-claim placeholder value
         self.type_mask = type_mask  # np bool [I]: requirement-level narrowing
         self.u_ids = u_ids  # np int [M] unique-allocatable row ids
         self.rem = rem  # np float64 [M, D] uniq_alloc - current usage
@@ -347,6 +356,13 @@ class _DeviceSolve:
         self.seq = 0  # bucket-entry counter for the stable-sort order model
         # joint requirement-set masks: frozenset(row ids) -> (compat, offer)
         self.joint_cache: dict[frozenset, tuple[np.ndarray, np.ndarray]] = {}
+        # requirement-set families: frozenset(row ids) -> id, plus the
+        # canonical hostname-free Requirements per id and the memoized join
+        # transitions (family, group) -> reject | same | narrow
+        self.fam_ids: dict[frozenset, int] = {}
+        self.fam_rows: list[frozenset] = []
+        self.fam_reqs: list[Requirements] = []
+        self.fam_join: dict[tuple[int, int], tuple] = {}
         self.remaining_resources = {
             name: dict(rl) for name, rl in scheduler.remaining_resources.items()
         }
@@ -359,11 +375,19 @@ class _DeviceSolve:
         # per-(template, group) static caches
         self.tg_tol: dict[tuple[int, int], bool] = {}
         self.tg_compat: dict[tuple[int, int], Optional[tuple]] = {}
-        # (claim rowkey, group) -> host-algebra compatibility; claims of the
-        # same family share rowkeys, so the check runs once per family
-        self.rowkey_compat: dict[tuple[frozenset, int], bool] = {}
         self.pod_errors: dict[Pod, Exception] = {}
         self.timed_out = False
+
+    def _intern_fam(self, rows: frozenset, reqs: Requirements) -> int:
+        """Intern a requirement row-set; `reqs` must be the hostname-free
+        requirement set whose interned rows are exactly `rows`."""
+        fam = self.fam_ids.get(rows)
+        if fam is None:
+            fam = len(self.fam_rows)
+            self.fam_ids[rows] = fam
+            self.fam_rows.append(rows)
+            self.fam_reqs.append(reqs)
+        return fam
 
     # -- encoding ------------------------------------------------------------
 
@@ -379,7 +403,15 @@ class _DeviceSolve:
         first_uid: list[str] = []
         cache = s.cached_pod_data
         for pod in self.pods:
-            sig = _raw_sig(pod)
+            # the spec signature is immutable alongside the spec; pods
+            # resolve across provisioner passes, so cache it on the object
+            sig = getattr(pod, "_kt_sig", None)
+            if sig is None:
+                sig = _raw_sig(pod)
+                try:
+                    pod._kt_sig = sig
+                except Exception:  # noqa: BLE001 — slotted/frozen pod type
+                    pass
             gi = index.get(sig)
             if gi is None:
                 if not _group_eligible(pod):
@@ -388,9 +420,14 @@ class _DeviceSolve:
                 data = cache[pod.metadata.uid]
                 if any(k not in dims for k in data.requests):
                     return None
+                group = _Group(data, dims)
+                if group.has_hostname:
+                    # per-claim hostname placeholders defeat family sharing;
+                    # hostname-pinned pods are rare — host path
+                    return None
                 gi = len(self.groups)
                 index[sig] = gi
-                self.groups.append(_Group(data, dims))
+                self.groups.append(group)
                 first_uid.append(pod.metadata.uid)
             else:
                 cache[pod.metadata.uid] = cache[first_uid[gi]]
@@ -638,11 +675,19 @@ class _DeviceSolve:
             return True
         return False
 
+    _REJECT, _SAME, _NARROW = 0, 1, 2
+
     def _try_first_join(self, c: _Claim, pod: Pod, g: _Group, gi: int):
         """First join of group g onto claim c: the full NodeClaim.can_add
         gate sequence (nodeclaim.go:114-163). Returns the fit-row mask over
         the claim's (possibly narrowed) headroom matrix, or None to reject
-        permanently. Commits requirement narrowing on success."""
+        permanently. Commits requirement narrowing on success.
+
+        The requirement algebra — compatibility, joint construction, joint
+        masks — depends only on (claim requirement family, group), so its
+        outcome is memoized as a family TRANSITION; per-claim work is a few
+        small-array ops. Hostname placeholders never participate: groups
+        constraining hostname are gated to the host path."""
         tol = self.tg_tol.get((c.ti, gi))
         if tol is None:
             nct = self.s.nodeclaim_templates[c.ti]
@@ -650,33 +695,14 @@ class _DeviceSolve:
             self.tg_tol[(c.ti, gi)] = tol
         if not tol:
             return None
-        # Compatibility depends only on (claim requirement rows, group) —
-        # hostname placeholders differ between claims but only matter when
-        # the GROUP constrains hostname.
-        if g.has_hostname:
-            ok = c.reqs.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is None
-        else:
-            ckey = (c.rowkey, gi)
-            ok = self.rowkey_compat.get(ckey)
-            if ok is None:
-                ok = (
-                    c.reqs.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
-                    is None
-                )
-                self.rowkey_compat[ckey] = ok
-        if not ok:
+        ent = self.fam_join.get((c.fam, gi))
+        if ent is None:
+            ent = self._build_fam_join(c.fam, gi)
+        kind = ent[0]
+        if kind == self._REJECT:
             return None
-        if g.rowset <= c.rowkey:
-            # every group row IS the claim's row for that key: joint == claim
-            rows = c.rowkey
-            joint = None
-        else:
-            joint = Requirements(*c.reqs.values())
-            joint.add(*g.reqs.values())
-            rows = self._rows_sans_hostname(joint)
-        if rows != c.rowkey:
-            compat_v, offer_v = self._joint_masks(rows, joint)
-            new_mask = c.type_mask & compat_v & offer_v
+        if kind == self._NARROW:
+            new_mask = c.type_mask & ent[2]
             # unique-alloc rows that still have a surviving type
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[self.uid_of_type[new_mask]] = True
@@ -689,17 +715,39 @@ class _DeviceSolve:
             c.type_mask = new_mask
             c.rem = c.rem[keep]
             c.u_ids = c.u_ids[keep]
-            c.rowkey = rows
-            c.reqs = joint
+            c.fam = ent[1]
             c.gknown.add(gi)
             return fitrows[keep]
         fitrows = (c.rem >= g.fit_floor).all(axis=1)
         if not fitrows.any():
             return None
-        if joint is not None:
-            c.reqs = joint
         c.gknown.add(gi)
         return fitrows
+
+    def _build_fam_join(self, fam: int, gi: int) -> tuple:
+        """Memoized family transition for group gi joining a claim of family
+        fam: reject (incompatible), same (joint row-set unchanged — adding
+        the group narrows nothing), or narrow (new family id + the combined
+        compat∧offering mask to AND into the claim's options)."""
+        g = self.groups[gi]
+        base = self.fam_reqs[fam]
+        if base.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+            ent = (self._REJECT,)
+        elif g.rowset <= self.fam_rows[fam]:
+            # every group row IS the claim's row for that key: joint == claim
+            ent = (self._SAME,)
+        else:
+            joint = Requirements(*base.values())
+            joint.add(*g.reqs.values())
+            rows = self._rows_sans_hostname(joint)
+            if rows == self.fam_rows[fam]:
+                ent = (self._SAME,)
+            else:
+                compat_v, offer_v = self._joint_masks(rows, joint)
+                new_fam = self._intern_fam(rows, joint)
+                ent = (self._NARROW, new_fam, compat_v & offer_v)
+        self.fam_join[(fam, gi)] = ent
+        return ent
 
     # -- new claims (addToNewNodeClaim, scheduler.go:478-556) ----------------
 
@@ -759,16 +807,14 @@ class _DeviceSolve:
                 continue
             # success: open the claim
             self.seq += 1
-            reqs = Requirements(*joint_tg.values())
-            reqs.add(
-                Requirement(
-                    wk.LABEL_HOSTNAME,
-                    Operator.IN,
-                    [f"device-placeholder-{next(_placeholder_counter):04d}"],
-                )
-            )
             c = _Claim(
-                ti, reqs, rows, candidate, cand_u[fitrows], rem0[fitrows], self.seq
+                ti,
+                self._intern_fam(rows, joint_tg),
+                f"device-placeholder-{next(_placeholder_counter):04d}",
+                candidate,
+                cand_u[fitrows],
+                rem0[fitrows],
+                self.seq,
             )
             c.count = 1
             c.members.append(pod)
@@ -914,15 +960,16 @@ class _DeviceSolve:
             en.remaining_resources = nd.remaining
             en.requirements = nd.reqs
         s.remaining_resources.update(self.remaining_resources)
+        opt_index_arr = [np.asarray(idxs, dtype=np.int64) for idxs in self.opt_index]
         for c in self.claims:
             nct = s.nodeclaim_templates[c.ti]
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[c.u_ids] = True
             final_types = c.type_mask & surv_u[self.uid_of_type]
+            tmpl_opts = self.tmpl_options[c.ti]
             options = [
-                self.tmpl_options[c.ti][j]
-                for j, i in enumerate(self.opt_index[c.ti])
-                if final_types[i]
+                tmpl_opts[j]
+                for j in np.nonzero(final_types[opt_index_arr[c.ti]])[0]
             ]
             nc = SchedNodeClaim(
                 nct,
@@ -935,7 +982,9 @@ class _DeviceSolve:
                 s.reserved_capacity_enabled,
                 engine=s.engine,
             )
-            nc.requirements = c.reqs
+            reqs = Requirements(*self.fam_reqs[c.fam].values())
+            reqs.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
+            nc.requirements = reqs
             nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] = "false"
             nc.pods = list(c.members)
             requests = dict(s.daemon_overhead[nct])

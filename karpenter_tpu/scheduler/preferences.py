@@ -21,6 +21,10 @@ class Preferences:
 
     def relax(self, pod: Pod) -> bool:
         """Mutates the pod, removing one soft constraint. True if relaxed."""
+        # the device fast path caches a spec-shape signature on the object;
+        # any in-place spec mutation must invalidate it (ops/ffd._raw_sig)
+        if hasattr(pod, "_kt_sig"):
+            del pod._kt_sig
         relaxations = [
             self.remove_required_node_affinity_term,
             self.remove_preferred_pod_affinity_term,

@@ -432,7 +432,11 @@ class Topology:
         self.excluded_pods: set[str] = {p.metadata.uid for p in pods}
         self._update_inverse_affinities()
         for p in pods:
-            self.update(p)
+            # plain pods (no spread constraints, no affinity) can neither
+            # create nor own topology groups — skipping them keeps the init
+            # scan O(1) per pod on large batches
+            if p.spec.topology_spread_constraints or p.spec.affinity is not None:
+                self.update(p)
 
     # -- group construction (topology.go:143-169, 432-474) ------------------
 

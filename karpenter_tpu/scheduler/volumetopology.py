@@ -33,6 +33,10 @@ class VolumeTopology:
             requirements.extend(self._requirements_for(pod, volume))
         if not requirements:
             return
+        # in-place spec mutation invalidates the cached device-path shape
+        # signature (ops/ffd._raw_sig)
+        if hasattr(pod, "_kt_sig"):
+            del pod._kt_sig
         if pod.spec.affinity is None:
             pod.spec.affinity = Affinity()
         if pod.spec.affinity.node_affinity is None:
