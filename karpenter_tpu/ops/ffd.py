@@ -91,6 +91,16 @@ _BIG = np.int64(2**31)
 
 _placeholder_counter = itertools.count(1)
 
+# process-global shape-signature interning: the full _raw_sig tuple hashes in
+# microseconds at 50k pods, so pods carry a small int instead and per-solve
+# group lookup is an int-keyed dict hit. The dict is cleared at a cap to
+# bound memory on high shape diversity; ids come from a never-reset counter,
+# so a re-interned shape gets a fresh id and its old/new pods merely split
+# into two value-identical groups (dedup cost, never a correctness issue).
+_SIG_IDS: dict[tuple, int] = {}
+_SIG_NEXT = itertools.count()
+_SIG_CAP = 200_000
+
 
 # -- eligibility -------------------------------------------------------------
 
@@ -115,13 +125,19 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     # tolerable (preferences.go:133-145) — shape groups would go stale.
     if scheduler.preferences.tolerate_prefer_no_schedule:
         return False
-    # Reserved capacity and minValues interplay stays host-side.
-    if scheduler.reserved_capacity_enabled and any(
-        o.capacity_type == wk.CAPACITY_TYPE_RESERVED
-        for it in scheduler.engine.instance_types
-        for o in it.offerings
-    ):
-        return False
+    # Reserved capacity and minValues interplay stays host-side. The scan is
+    # cached on the (immutable) engine catalog.
+    if scheduler.reserved_capacity_enabled:
+        has_reserved = getattr(scheduler.engine, "_kt_has_reserved", None)
+        if has_reserved is None:
+            has_reserved = any(
+                o.capacity_type == wk.CAPACITY_TYPE_RESERVED
+                for it in scheduler.engine.instance_types
+                for o in it.offerings
+            )
+            scheduler.engine._kt_has_reserved = has_reserved
+        if has_reserved:
+            return False
     dims = scheduler.engine.resource_dims
     for nct in scheduler.nodeclaim_templates:
         if nct.requirements.has_min_values():
@@ -332,6 +348,219 @@ class _Fallback(Exception):
     """Internal: abort the device solve and use the host loop."""
 
 
+class _NativeDriver:
+    """Drives the C steady-state kernel (ops/_native/ffd_kernel.cc).
+
+    The kernel owns the queue, per-group heaps, and claim headroom state;
+    this driver answers its four up-calls — taint tolerance, family-join
+    transitions, new-claim openings, existing-node joins — using the same
+    _DeviceSolve methods the Python loop uses, so both drivers share one
+    semantics implementation for everything that isn't a hot loop."""
+
+    def __init__(self, solve: "_DeviceSolve", qpods: list, timeout):
+        from karpenter_tpu.ops import native as nat
+
+        self.nat = nat
+        self.lib = nat.get_lib()
+        self.s = solve
+        self.pods = [p for p, _ in qpods]
+        gi_arr = np.fromiter(
+            (gi for _, gi in qpods), dtype=np.int32, count=len(qpods)
+        )
+        s = solve
+        G, D = len(s.groups), s.D
+        self.W = max(1, (s.I + 63) // 64)
+        g_req = (
+            np.ascontiguousarray(np.stack([g.req_f for g in s.groups]))
+            if s.groups
+            else np.zeros((0, D))
+        )
+        g_fit = (
+            np.ascontiguousarray(np.stack([g.fit_floor for g in s.groups]))
+            if s.groups
+            else np.zeros((0, D))
+        )
+        utype = np.zeros((s.U, self.W), dtype=np.uint64)
+        for u in range(s.U):
+            utype[u] = self._pack(s.uid_of_type == u)
+        utype = np.ascontiguousarray(utype)
+        self.claim_meta: list[str] = []  # hostname per claim index
+        self.err_by_idx: dict[int, Exception] = {}
+        self.timeout_idx: set[int] = set()
+        ctx = self.lib.kt_new(
+            len(self.pods),
+            G,
+            D,
+            s.U,
+            self.W,
+            len(s.s.nodeclaim_templates),
+            gi_arr.ctypes.data_as(nat.p_i32),
+            g_req.ctypes.data_as(nat.p_f64),
+            g_fit.ctypes.data_as(nat.p_f64),
+            utype.ctypes.data_as(nat.p_u64),
+            1 if s.nodes else 0,
+            -1.0 if timeout is None else float(timeout),
+        )
+        if not ctx:
+            raise _Fallback("native context allocation failed")
+        self.ctx = ctx
+
+    def _pack(self, mask: np.ndarray) -> np.ndarray:
+        b = np.packbits(np.ascontiguousarray(mask), bitorder="little")
+        out = np.zeros(self.W * 8, dtype=np.uint8)
+        out[: b.size] = b
+        return out.view(np.uint64)
+
+    def add_claim(self, ti, fam, hostname, pod, gi, candidate, u_ids, rem):
+        # called from _open_claim while resolving ACT_NEED_NEW_CLAIM; the
+        # opening pod is the one the kernel just handed us
+        nat = self.nat
+        self.claim_meta.append(hostname)
+        mask = self._pack(candidate)
+        u32 = np.ascontiguousarray(u_ids, dtype=np.int32)
+        remc = np.ascontiguousarray(rem, dtype=np.float64)
+        self.lib.kt_add_claim(
+            self.ctx,
+            ti,
+            fam,
+            self._cur_pod_idx,
+            gi,
+            mask.ctypes.data_as(nat.p_u64),
+            u32.ctypes.data_as(nat.p_i32),
+            remc.ctypes.data_as(nat.p_f64),
+            len(u32),
+        )
+
+    def drive(self) -> None:
+        nat, lib, ctx, s = self.nat, self.lib, self.ctx, self.s
+        out = (nat.i64 * 8)()
+        templates = s.s.nodeclaim_templates
+        while True:
+            act = lib.kt_run(ctx, out)
+            if act == nat.ACT_DONE:
+                break
+            if act == nat.ACT_TIMEOUT:
+                s.timed_out = True
+                head = int(out[0])
+                qlen = int(lib.kt_queue_len(ctx))
+                tail = np.zeros(max(qlen - head, 0), dtype=np.int32)
+                if tail.size:
+                    lib.kt_queue_tail(ctx, head, tail.ctypes.data_as(nat.p_i32))
+                for idx in tail.tolist():
+                    self.timeout_idx.add(idx)
+                    self.err_by_idx.setdefault(
+                        idx, TimeoutError("scheduling simulation timed out")
+                    )
+                break
+            if act == nat.ACT_NEED_TOL:
+                pidx, gi, _ci, ti = int(out[0]), int(out[1]), int(out[2]), int(out[3])
+                tol = Taints(templates[ti].spec.taints).tolerates_pod(
+                    self.pods[pidx]
+                ) is None
+                s.tg_tol[(ti, gi)] = tol
+                lib.kt_set_tol(ctx, ti, gi, 1 if tol else 0)
+                continue
+            if act == nat.ACT_NEED_JOIN:
+                _pidx, gi, _ci, fam = int(out[0]), int(out[1]), int(out[2]), int(out[3])
+                ent = s.fam_join.get((fam, gi))
+                if ent is None:
+                    ent = s._build_fam_join(fam, gi)
+                if ent[0] == s._REJECT:
+                    lib.kt_set_join(ctx, fam, gi, nat.JOIN_REJECT, 0, None)
+                elif ent[0] == s._SAME:
+                    lib.kt_set_join(ctx, fam, gi, nat.JOIN_SAME, 0, None)
+                else:
+                    mask = self._pack(ent[2])
+                    lib.kt_set_join(
+                        ctx,
+                        fam,
+                        gi,
+                        nat.JOIN_NARROW,
+                        ent[1],
+                        mask.ctypes.data_as(nat.p_u64),
+                    )
+                continue
+            if act == nat.ACT_NEED_NEW_CLAIM:
+                pidx, gi = int(out[0]), int(out[1])
+                pod = self.pods[pidx]
+                self._cur_pod_idx = pidx
+                if not templates:
+                    err: Optional[Exception] = ValueError(
+                        "nodepool requirements filtered out all available instance types"
+                    )
+                else:
+                    err = s._new_claim(pod, s.groups[gi], gi)
+                if err is None:
+                    lib.kt_resolve(ctx, 1)
+                else:
+                    self.err_by_idx[pidx] = err
+                    lib.kt_resolve(ctx, 2)
+                continue
+            if act == nat.ACT_NEED_NODES:
+                pidx, gi = int(out[0]), int(out[1])
+                pod = self.pods[pidx]
+                placed = s._try_nodes(pod, s.groups[gi], gi)
+                if s.nptr[gi] >= len(s.nodes):
+                    lib.kt_set_nodes_done(ctx, gi)
+                lib.kt_resolve(ctx, 1 if placed else 0)
+                continue
+            raise _Fallback(f"native kernel returned unknown action {act}")
+        self._finish()
+
+    def _finish(self) -> None:
+        """Materialize claims and pod errors back into the _DeviceSolve."""
+        nat, lib, ctx, s = self.nat, self.lib, self.ctx, self.s
+        failed = np.zeros(len(self.pods), dtype=np.uint8)
+        if len(self.pods):
+            lib.kt_failed(ctx, failed.ctypes.data_as(nat.p_u8))
+        for idx, err in self.err_by_idx.items():
+            if failed[idx] or idx in self.timeout_idx:
+                s.pod_errors[self.pods[idx]] = err
+        info = (nat.i64 * 8)()
+        n = int(lib.kt_num_claims(ctx))
+        for ci in range(n):
+            lib.kt_claim_info(ctx, ci, info)
+            ti, fam, count, M, n_members, n_groups = (int(info[k]) for k in range(6))
+            words = np.zeros(self.W, dtype=np.uint64)
+            u_ids = np.zeros(M, dtype=np.int32)
+            members = np.zeros(n_members, dtype=np.int32)
+            groups = np.zeros(n_groups, dtype=np.int32)
+            counts = np.zeros(n_groups, dtype=np.int32)
+            lib.kt_claim_read(
+                ctx,
+                ci,
+                words.ctypes.data_as(nat.p_u64),
+                u_ids.ctypes.data_as(nat.p_i32),
+                members.ctypes.data_as(nat.p_i32),
+                groups.ctypes.data_as(nat.p_i32),
+                counts.ctypes.data_as(nat.p_i32),
+            )
+            type_mask = (
+                np.unpackbits(words.view(np.uint8), bitorder="little")[: s.I]
+                .astype(bool)
+            )
+            c = _Claim(
+                ti,
+                fam,
+                self.claim_meta[ci],
+                type_mask,
+                u_ids.astype(np.int64),
+                np.zeros((0, s.D)),
+                0,
+            )
+            c.count = count
+            c.members = [self.pods[i] for i in members.tolist()]
+            c.group_counts = {
+                int(g): int(k) for g, k in zip(groups.tolist(), counts.tolist())
+            }
+            s.claims.append(c)
+
+    def close(self) -> None:
+        if self.ctx:
+            self.lib.kt_free(self.ctx)
+            self.ctx = None
+
+
 class _DeviceSolve:
     def __init__(self, scheduler, pods: Sequence[Pod]):
         self.s = scheduler
@@ -372,11 +601,16 @@ class _DeviceSolve:
         self.gsynced: list[int] = []
         self.nptr: list[int] = []
         self.gnewclaim_err: dict[int, tuple[int, Exception]] = {}
+        # (ti, gi) -> memoized claim-opening data, valid while no nodepool
+        # limits are in play (fam, candidate, u_ids, rem0) or (-1,...) = error
+        self.open_cache: dict[tuple[int, int], tuple] = {}
+        self._open_errs: dict[tuple[int, int], Exception] = {}
         # per-(template, group) static caches
         self.tg_tol: dict[tuple[int, int], bool] = {}
         self.tg_compat: dict[tuple[int, int], Optional[tuple]] = {}
         self.pod_errors: dict[Pod, Exception] = {}
         self.timed_out = False
+        self._native: Optional[_NativeDriver] = None
 
     def _intern_fam(self, rows: frozenset, reqs: Requirements) -> int:
         """Intern a requirement row-set; `reqs` must be the hostname-free
@@ -404,10 +638,17 @@ class _DeviceSolve:
         cache = s.cached_pod_data
         for pod in self.pods:
             # the spec signature is immutable alongside the spec; pods
-            # resolve across provisioner passes, so cache it on the object
+            # resolve across provisioner passes, so cache its interned id on
+            # the object (invalidated at spec mutation sites as _kt_sig)
             sig = getattr(pod, "_kt_sig", None)
             if sig is None:
-                sig = _raw_sig(pod)
+                raw = _raw_sig(pod)
+                sig = _SIG_IDS.get(raw)
+                if sig is None:
+                    if len(_SIG_IDS) >= _SIG_CAP:
+                        _SIG_IDS.clear()
+                    sig = next(_SIG_NEXT)
+                    _SIG_IDS[raw] = sig
                 try:
                     pod._kt_sig = sig
                 except Exception:  # noqa: BLE001 — slotted/frozen pod type
@@ -793,6 +1034,18 @@ class _DeviceSolve:
                     )
                 )
                 continue
+            # without limits in play, every opening for (ti, gi) computes the
+            # same candidate set / headroom matrix — memoize it
+            cached_open = (
+                self.open_cache.get((ti, gi)) if limits_mask is None else None
+            )
+            if cached_open is not None:
+                fam, candidate, u_ids, rem0_fit = cached_open
+                if fam < 0:
+                    errs.append(self._open_errs[(ti, gi)])
+                    continue
+                self._open_claim(ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy())
+                return None
             joint_tg, rows = tg
             compat_v, offer_v = self._joint_masks(rows, joint_tg)
             base = self.tmpl_mask[ti]
@@ -803,26 +1056,21 @@ class _DeviceSolve:
             rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
             fitrows = (rem0 >= -_EPS).all(axis=1)
             if not fitrows.any():
-                errs.append(self._filter_error(base, compat_v, offer_v, ti, g))
+                err = self._filter_error(base, compat_v, offer_v, ti, g)
+                if limits_mask is None:
+                    self.open_cache[(ti, gi)] = (-1, None, None, None)
+                    self._open_errs[(ti, gi)] = err
+                errs.append(err)
                 continue
             # success: open the claim
-            self.seq += 1
-            c = _Claim(
-                ti,
-                self._intern_fam(rows, joint_tg),
-                f"device-placeholder-{next(_placeholder_counter):04d}",
-                candidate,
-                cand_u[fitrows],
-                rem0[fitrows],
-                self.seq,
-            )
-            c.count = 1
-            c.members.append(pod)
-            c.group_counts[gi] = 1
-            c.gknown.add(gi)
-            self.claims.append(c)
+            fam = self._intern_fam(rows, joint_tg)
+            u_ids = cand_u[fitrows]
+            rem0_fit = rem0[fitrows]
+            if limits_mask is None:
+                self.open_cache[(ti, gi)] = (fam, candidate, u_ids, rem0_fit)
+            self._open_claim(ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy())
             surv_u = np.zeros(self.U, dtype=bool)
-            surv_u[c.u_ids] = True
+            surv_u[u_ids] = True
             self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
             return None
         if not errs:
@@ -834,6 +1082,30 @@ class _DeviceSolve:
         )
         self.gnewclaim_err[gi] = (self.limits_version, err)
         return err
+
+    def _open_claim(
+        self,
+        ti: int,
+        fam: int,
+        pod: Pod,
+        gi: int,
+        candidate: np.ndarray,
+        u_ids: np.ndarray,
+        rem: np.ndarray,
+    ) -> None:
+        """Register a freshly opened claim with the active driver (Python
+        loop or native kernel); the opening pod is its first member."""
+        hostname = f"device-placeholder-{next(_placeholder_counter):04d}"
+        if self._native is not None:
+            self._native.add_claim(ti, fam, hostname, pod, gi, candidate, u_ids, rem)
+            return
+        self.seq += 1
+        c = _Claim(ti, fam, hostname, candidate, u_ids, rem, self.seq)
+        c.count = 1
+        c.members.append(pod)
+        c.group_counts[gi] = 1
+        c.gknown.add(gi)
+        self.claims.append(c)
 
     def _limits_mask(self, remaining: dict) -> np.ndarray:
         """Types whose CAPACITY fits inside the nodepool's remaining limits
@@ -902,6 +1174,17 @@ class _DeviceSolve:
             raise _Fallback("ineligible pod shape")
         self._prepare_templates()
         qpods = self._sorted(pairs)
+        from karpenter_tpu.ops import native as nat
+
+        if nat.get_lib() is not None:
+            driver = _NativeDriver(self, qpods, timeout)
+            self._native = driver
+            try:
+                driver.drive()
+            finally:
+                driver.close()
+                self._native = None
+            return
         head = 0
         last_len: dict[str, int] = {}
         pod_errors = self.pod_errors

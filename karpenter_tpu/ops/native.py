@@ -1,0 +1,123 @@
+"""Build and load the native FFD steady-state kernel (ffd_kernel.cc).
+
+The shared library is compiled on first use with the system C++ toolchain
+and cached beside the source, keyed by a source hash — mirroring how the
+reference ships a compiled scheduler core while we stay pip-less. Loading is
+best-effort: any failure (no compiler, unwritable dir, exotic platform)
+degrades to the pure-Python loop in ops/ffd.py, which computes identical
+decisions. Set KARPENTER_TPU_NATIVE=0 to force the Python loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_SRC = os.path.join(_DIR, "ffd_kernel.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+i32, i64, u8, u64, f64 = (
+    ctypes.c_int32,
+    ctypes.c_int64,
+    ctypes.c_uint8,
+    ctypes.c_uint64,
+    ctypes.c_double,
+)
+p_i32 = ctypes.POINTER(i32)
+p_i64 = ctypes.POINTER(i64)
+p_u8 = ctypes.POINTER(u8)
+p_u64 = ctypes.POINTER(u64)
+p_f64 = ctypes.POINTER(f64)
+voidp = ctypes.c_void_p
+
+ACT_DONE = 0
+ACT_NEED_TOL = 1
+ACT_NEED_JOIN = 2
+ACT_NEED_NEW_CLAIM = 3
+ACT_NEED_NODES = 4
+ACT_TIMEOUT = 5
+
+JOIN_REJECT = 1
+JOIN_SAME = 2
+JOIN_NARROW = 3
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so = os.path.join(_DIR, f"ffd_kernel_{tag}.so")
+    if os.path.exists(so):
+        return so
+    tmp = f"{so}.{os.getpid()}.tmp"  # unique per process: concurrent builders
+    for cxx in ("g++", "c++", "clang++"):
+        try:
+            r = subprocess.run(
+                [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            os.replace(tmp, so)
+            return so
+    return None
+
+
+def _sigs(lib: ctypes.CDLL) -> None:
+    lib.kt_new.restype = voidp
+    lib.kt_new.argtypes = [
+        i32, i32, i32, i32, i32, i32, p_i32, p_f64, p_f64, p_u64, u8, f64,
+    ]
+    lib.kt_free.argtypes = [voidp]
+    lib.kt_set_tol.argtypes = [voidp, i32, i32, u8]
+    lib.kt_set_join.argtypes = [voidp, i32, i32, ctypes.c_int8, i32, p_u64]
+    lib.kt_add_claim.restype = i32
+    lib.kt_add_claim.argtypes = [voidp, i32, i32, i32, i32, p_u64, p_i32, p_f64, i32]
+    lib.kt_set_nodes_done.argtypes = [voidp, i32]
+    lib.kt_resolve.argtypes = [voidp, i32]
+    lib.kt_run.restype = ctypes.c_int
+    lib.kt_run.argtypes = [voidp, p_i64]
+    lib.kt_timed_out.restype = u8
+    lib.kt_timed_out.argtypes = [voidp]
+    lib.kt_head.restype = i64
+    lib.kt_head.argtypes = [voidp]
+    lib.kt_queue_len.restype = i64
+    lib.kt_queue_len.argtypes = [voidp]
+    lib.kt_queue_tail.argtypes = [voidp, i64, p_i32]
+    lib.kt_failed.argtypes = [voidp, p_u8]
+    lib.kt_num_claims.restype = i32
+    lib.kt_num_claims.argtypes = [voidp]
+    lib.kt_claim_info.argtypes = [voidp, i32, p_i64]
+    lib.kt_claim_read.argtypes = [voidp, i32, p_u64, p_i32, p_i32, p_i32, p_i32]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded kernel library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if os.environ.get("KARPENTER_TPU_NATIVE", "1") == "0":
+        return None
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        try:
+            so = _build()
+            if so is not None:
+                lib = ctypes.CDLL(so)
+                _sigs(lib)
+                _lib = lib
+        except Exception:  # noqa: BLE001 — degrade to the Python loop
+            _lib = None
+        _tried = True
+    return _lib

@@ -168,3 +168,45 @@ class TestRegressions:
         for delta in (-1024.0, 1024.0):
             requests = {wk.RESOURCE_MEMORY: alloc_mem + delta, "cpu": 0.1}
             run_case(engine, catalog, Requirements(), requests)
+
+
+class TestBackendTwins:
+    """The numpy host twins and the device kernels must produce identical
+    feasibility bits regardless of the adaptive RTT dispatch decision."""
+
+    @pytest.mark.parametrize("case", range(4))
+    def test_host_device_identical(self, catalog, case):
+        from karpenter_tpu.ops import catalog as cat
+
+        rng = np.random.RandomState(case)
+        zones = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+        reqs_list = []
+        for i in range(17):
+            reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+            if rng.rand() < 0.5:
+                reqs.add(Requirement(wk.LABEL_ARCH, Operator.IN, [rng.choice(["amd64", "arm64"])]))
+            if rng.rand() < 0.4:
+                op = Operator.IN if rng.rand() < 0.7 else Operator.NOT_IN
+                reqs.add(Requirement(wk.LABEL_TOPOLOGY_ZONE, op, list(rng.choice(zones, 2, replace=False))))
+            if rng.rand() < 0.3:
+                reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ["spot"]))
+            reqs_list.append(reqs)
+        requests = np.zeros((len(reqs_list), len(CatalogEngine(catalog).resource_dims)))
+
+        outs = {}
+        for backend in ("host", "device"):
+            engine = CatalogEngine(catalog)
+            rows = [engine.rows_for(r) for r in reqs_list]
+            old = cat.FORCE_BACKEND
+            cat.FORCE_BACKEND = backend
+            try:
+                f = engine.feasibility(rows, requests, engine.key_presence(reqs_list))
+            finally:
+                cat.FORCE_BACKEND = old
+            outs[backend] = f
+
+        np.testing.assert_array_equal(outs["host"].compat, outs["device"].compat)
+        np.testing.assert_array_equal(outs["host"].fits, outs["device"].fits)
+        np.testing.assert_array_equal(
+            outs["host"].has_offering, outs["device"].has_offering
+        )

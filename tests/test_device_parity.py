@@ -216,6 +216,18 @@ class TestDeviceParity:
         assert host == dev
         assert ran, "device path unexpectedly fell back to the host loop"
 
+    @pytest.mark.parametrize("seed", range(12))
+    def test_python_loop_parity(self, seed, monkeypatch):
+        """The pure-Python steady-state loop (fallback when the native kernel
+        can't build) must make the same decisions as the native kernel."""
+        from karpenter_tpu.ops import native
+
+        monkeypatch.setattr(native, "_tried", True)
+        monkeypatch.setattr(native, "_lib", None)
+        host, dev, ran = run_case(seed)
+        assert host == dev
+        assert ran
+
     def test_device_solves_counter_never_regresses_to_fallback(self):
         """The production-shaped workload (≥64 plain pods, kwok catalog) must
         take the device path — guards against silent eligibility regressions."""
