@@ -121,6 +121,8 @@ struct Ctx {
   std::vector<int32_t> pod_group;   // [P]
   std::vector<double> g_req;        // [G*D]
   std::vector<double> g_fit;        // [G*D] fit floors (req - eps)
+  std::vector<int32_t> g_ndim;      // [G] nonzero request dims
+  std::vector<int32_t> g_didx;      // [G*D] their indices (first g_ndim)
   std::vector<int64_t> last_len;    // [P]
   std::vector<uint8_t> pod_failed;  // [P]
   std::vector<uint64_t> utype_mask;  // [U*W] types per unique-alloc row
@@ -163,6 +165,11 @@ int try_claims(Ctx* c, int32_t pod, int32_t gi, int64_t* out, int* act) {
   const double* req = &c->g_req[size_t(gi) * c->D];
   const double* fit = &c->g_fit[size_t(gi) * c->D];
   const int D = c->D, W = c->W;
+  // zero-request dims always pass the fit floor (headroom there is
+  // >= -eps from claim open and never shrinks), so loops touch only the
+  // group's nonzero dims — bit-identical, ~2x fewer double ops
+  const int nd = c->g_ndim[gi];
+  const int32_t* didx = &c->g_didx[size_t(gi) * c->D];
 
   while (!heap.v.empty()) {
     HeapItem top = heap.v[0];
@@ -182,7 +189,8 @@ int try_claims(Ctx* c, int32_t pod, int32_t gi, int64_t* out, int* act) {
       for (int32_t r = 0; r < cl.M; ++r) {
         const double* rem = &cl.rem[size_t(r) * D];
         bool ok = true;
-        for (int d = 0; d < D; ++d) {
+        for (int k = 0; k < nd; ++k) {
+          int d = didx[k];
           if (!(rem[d] >= fit[d])) {
             ok = false;
             break;
@@ -251,7 +259,8 @@ int try_claims(Ctx* c, int32_t pod, int32_t gi, int64_t* out, int* act) {
           bool ok = kr;
           if (ok) {
             const double* rem = &cl.rem[size_t(r) * D];
-            for (int d = 0; d < D; ++d) {
+            for (int k = 0; k < nd; ++k) {
+              int d = didx[k];
               if (!(rem[d] >= fit[d])) {
                 ok = false;
                 break;
@@ -299,7 +308,8 @@ int try_claims(Ctx* c, int32_t pod, int32_t gi, int64_t* out, int* act) {
         for (int32_t r = 0; r < cl.M; ++r) {
           const double* rem = &cl.rem[size_t(r) * D];
           bool ok = true;
-          for (int d = 0; d < D; ++d) {
+          for (int k = 0; k < nd; ++k) {
+            int d = didx[k];
             if (!(rem[d] >= fit[d])) {
               ok = false;
               break;
@@ -321,7 +331,7 @@ int try_claims(Ctx* c, int32_t pod, int32_t gi, int64_t* out, int* act) {
     if (all) {
       for (int32_t r = 0; r < cl.M; ++r) {
         double* rem = &cl.rem[size_t(r) * D];
-        for (int d = 0; d < D; ++d) rem[d] -= req[d];
+        for (int k = 0; k < nd; ++k) rem[didx[k]] -= req[didx[k]];
       }
     } else {
       int32_t m2 = 0;
@@ -340,7 +350,7 @@ int try_claims(Ctx* c, int32_t pod, int32_t gi, int64_t* out, int* act) {
       cl.u_ids.resize(size_t(m2));
       for (int32_t r = 0; r < m2; ++r) {
         double* rem = &cl.rem[size_t(r) * D];
-        for (int d = 0; d < D; ++d) rem[d] -= req[d];
+        for (int k = 0; k < nd; ++k) rem[didx[k]] -= req[didx[k]];
       }
     }
     cl.count = top.count + 1;
@@ -361,6 +371,7 @@ extern "C" {
 
 Ctx* kt_new(int32_t P, int32_t G, int32_t D, int32_t U, int32_t W, int32_t T,
             const int32_t* pod_group, const double* g_req, const double* g_fit,
+            const int32_t* g_ndim, const int32_t* g_didx,
             const uint64_t* utype_mask, uint8_t nodes_active,
             double timeout_s) {
   Ctx* c = new (std::nothrow) Ctx();
@@ -377,6 +388,8 @@ Ctx* kt_new(int32_t P, int32_t G, int32_t D, int32_t U, int32_t W, int32_t T,
   c->pod_group.assign(pod_group, pod_group + P);
   c->g_req.assign(g_req, g_req + size_t(G) * D);
   c->g_fit.assign(g_fit, g_fit + size_t(G) * D);
+  c->g_ndim.assign(g_ndim, g_ndim + G);
+  c->g_didx.assign(g_didx, g_didx + size_t(G) * D);
   c->last_len.assign(size_t(P), -1);
   c->pod_failed.assign(size_t(P), 0);
   c->utype_mask.assign(utype_mask, utype_mask + size_t(U) * W);

@@ -55,6 +55,7 @@ from karpenter_tpu.scheduling.requirements import (
     Requirement,
     Requirements,
 )
+from karpenter_tpu.scheduling.hostportusage import HostPortUsage
 from karpenter_tpu.scheduling.requirements import Operator
 from karpenter_tpu.scheduling.taints import Taints
 from karpenter_tpu.utils import resources as res
@@ -357,16 +358,13 @@ class _NativeDriver:
     _DeviceSolve methods the Python loop uses, so both drivers share one
     semantics implementation for everything that isn't a hot loop."""
 
-    def __init__(self, solve: "_DeviceSolve", qpods: list, timeout):
+    def __init__(self, solve: "_DeviceSolve", pods_sorted: list, gi_arr, timeout):
         from karpenter_tpu.ops import native as nat
 
         self.nat = nat
         self.lib = nat.get_lib()
         self.s = solve
-        self.pods = [p for p, _ in qpods]
-        gi_arr = np.fromiter(
-            (gi for _, gi in qpods), dtype=np.int32, count=len(qpods)
-        )
+        self.pods = pods_sorted
         s = solve
         G, D = len(s.groups), s.D
         self.W = max(1, (s.I + 63) // 64)
@@ -384,6 +382,13 @@ class _NativeDriver:
         for u in range(s.U):
             utype[u] = self._pack(s.uid_of_type == u)
         utype = np.ascontiguousarray(utype)
+        # nonzero request dims per group: the C fit/subtract loops touch
+        # only these (zero dims provably always pass)
+        g_ndim = np.zeros(G, dtype=np.int32)
+        g_didx = np.zeros((G, D), dtype=np.int32)
+        for k, g in enumerate(s.groups):
+            g_ndim[k] = len(g.div_dims)
+            g_didx[k, : len(g.div_dims)] = g.div_dims
         self.claim_meta: list[str] = []  # hostname per claim index
         self.err_by_idx: dict[int, Exception] = {}
         self.timeout_idx: set[int] = set()
@@ -397,6 +402,8 @@ class _NativeDriver:
             gi_arr.ctypes.data_as(nat.p_i32),
             g_req.ctypes.data_as(nat.p_f64),
             g_fit.ctypes.data_as(nat.p_f64),
+            g_ndim.ctypes.data_as(nat.p_i32),
+            g_didx.ctypes.data_as(nat.p_i32),
             utype.ctypes.data_as(nat.p_u64),
             1 if s.nodes else 0,
             -1.0 if timeout is None else float(timeout),
@@ -625,87 +632,93 @@ class _DeviceSolve:
 
     # -- encoding ------------------------------------------------------------
 
-    def _group_pods(self) -> Optional[list[tuple[Pod, int]]]:
+    def _group_pods(self) -> Optional[np.ndarray]:
         """Collapse pods into value-identical shape groups; PodData is
-        computed ONCE per group and shared into the scheduler's cache — the
-        per-pod host parse is the single biggest cost at 50k pods. Returns
-        (pod, group index) pairs, or None when a shape fails the per-group
-        eligibility gates (→ host path)."""
+        computed ONCE per group (the per-pod host parse is the single
+        biggest cost at 50k pods). Returns the per-pod group-index array, or
+        None when a shape fails the per-group eligibility gates (→ host
+        path). Group numbering follows interned-signature order — decisions
+        never depend on it (only pod queue order matters)."""
         s, dims = self.s, self.dims
-        index: dict[tuple, int] = {}
-        out: list[tuple[Pod, int]] = []
-        first_uid: list[str] = []
-        cache = s.cached_pod_data
-        for pod in self.pods:
-            # the spec signature is immutable alongside the spec; pods
-            # resolve across provisioner passes, so cache its interned id on
-            # the object (invalidated at spec mutation sites as _kt_sig)
-            sig = getattr(pod, "_kt_sig", None)
-            if sig is None:
-                raw = _raw_sig(pod)
-                sig = _SIG_IDS.get(raw)
+        pods = self.pods
+        # the spec signature is immutable alongside the spec; pods resolve
+        # across provisioner passes, so its interned id is cached on the
+        # object (invalidated at spec mutation sites as _kt_sig)
+        try:
+            sigs = [p._kt_sig for p in pods]
+        except AttributeError:
+            sigs = []
+            for pod in pods:
+                sig = getattr(pod, "_kt_sig", None)
                 if sig is None:
-                    if len(_SIG_IDS) >= _SIG_CAP:
-                        _SIG_IDS.clear()
-                    sig = next(_SIG_NEXT)
-                    _SIG_IDS[raw] = sig
-                try:
-                    pod._kt_sig = sig
-                except Exception:  # noqa: BLE001 — slotted/frozen pod type
-                    pass
-            gi = index.get(sig)
-            if gi is None:
-                if not _group_eligible(pod):
-                    return None
-                s.update_cached_pod_data(pod)
-                data = cache[pod.metadata.uid]
-                if any(k not in dims for k in data.requests):
-                    return None
-                group = _Group(data, dims)
-                if group.has_hostname:
-                    # per-claim hostname placeholders defeat family sharing;
-                    # hostname-pinned pods are rare — host path
-                    return None
-                gi = len(self.groups)
-                index[sig] = gi
-                self.groups.append(group)
-                first_uid.append(pod.metadata.uid)
-            else:
-                cache[pod.metadata.uid] = cache[first_uid[gi]]
-            self.groups[gi].n_pods += 1
-            out.append((pod, gi))
+                    raw = _raw_sig(pod)
+                    sig = _SIG_IDS.get(raw)
+                    if sig is None:
+                        if len(_SIG_IDS) >= _SIG_CAP:
+                            _SIG_IDS.clear()
+                        sig = next(_SIG_NEXT)
+                        _SIG_IDS[raw] = sig
+                    try:
+                        pod._kt_sig = sig
+                    except Exception:  # noqa: BLE001 — slotted/frozen pod
+                        pass
+                sigs.append(sig)
+        _, first_idx, inverse, counts = np.unique(
+            np.asarray(sigs, dtype=np.int64),
+            return_index=True,
+            return_inverse=True,
+            return_counts=True,
+        )
+        for k, fi in enumerate(first_idx):
+            pod = pods[int(fi)]
+            if not _group_eligible(pod):
+                return None
+            s.update_cached_pod_data(pod)
+            data = s.cached_pod_data[pod.metadata.uid]
+            if any(name not in dims for name in data.requests):
+                return None
+            group = _Group(data, dims)
+            if group.has_hostname:
+                # per-claim hostname placeholders defeat family sharing;
+                # hostname-pinned pods are rare — host path
+                return None
+            group.n_pods = int(counts[k])
+            self.groups.append(group)
         G = len(self.groups)
         self.gheaps = [[] for _ in range(G)]
         self.gsynced = [0] * G
         self.nptr = [0] * G
-        return out
+        return inverse.astype(np.int32)
 
-    def _sorted(self, pairs: list[tuple[Pod, int]]) -> list[tuple[Pod, int]]:
+    def _order(self, gi_arr: np.ndarray) -> np.ndarray:
         """Exact host queue order (queue.go:72-108): cpu desc, mem desc,
         creation timestamp, uid. Vectorized via lexsort (numpy string
-        comparison is code-point order — identical to Python's)."""
+        comparison is code-point order — identical to Python's). Returns
+        the permutation of pod indices."""
         groups = self.groups
+        pods = self.pods
         try:
-            gi_arr = np.fromiter((gi for _, gi in pairs), dtype=np.int64, count=len(pairs))
             cpu = np.array([g.sort_cpu for g in groups])[gi_arr]
             mem = np.array([g.sort_mem for g in groups])[gi_arr]
             ts = np.fromiter(
-                (p.metadata.creation_timestamp for p, _ in pairs),
+                (p.metadata.creation_timestamp for p in pods),
                 dtype=np.float64,
-                count=len(pairs),
+                count=len(pods),
             )
-            uid = np.array([p.metadata.uid for p, _ in pairs])
-            order = np.lexsort((uid, ts, -mem, -cpu))
-            return [pairs[i] for i in order]
+            uid = np.array([p.metadata.uid for p in pods])
+            return np.lexsort((uid, ts, -mem, -cpu))
         except (TypeError, ValueError):
-            return sorted(
-                pairs,
-                key=lambda pg: (
-                    -groups[pg[1]].sort_cpu,
-                    -groups[pg[1]].sort_mem,
-                    pg[0].metadata.creation_timestamp,
-                    pg[0].metadata.uid,
+            return np.array(
+                sorted(
+                    range(len(pods)),
+                    key=lambda i: (
+                        -groups[gi_arr[i]].sort_cpu,
+                        -groups[gi_arr[i]].sort_mem,
+                        pods[i].metadata.creation_timestamp,
+                        pods[i].metadata.uid,
+                    ),
                 ),
+                dtype=np.int64,
             )
 
     def _rows_sans_hostname(self, reqs: Requirements) -> frozenset:
@@ -1169,15 +1182,18 @@ class _DeviceSolve:
     # -- main loop (Scheduler._solve, scheduler.go:346-429) ------------------
 
     def run(self, timeout: Optional[float]) -> None:
-        pairs = self._group_pods()
-        if pairs is None:
+        gi_arr = self._group_pods()
+        if gi_arr is None:
             raise _Fallback("ineligible pod shape")
         self._prepare_templates()
-        qpods = self._sorted(pairs)
+        order = self._order(gi_arr)
         from karpenter_tpu.ops import native as nat
 
         if nat.get_lib() is not None:
-            driver = _NativeDriver(self, qpods, timeout)
+            pods_sorted = [self.pods[i] for i in order]
+            driver = _NativeDriver(
+                self, pods_sorted, np.ascontiguousarray(gi_arr[order]), timeout
+            )
             self._native = driver
             try:
                 driver.drive()
@@ -1185,6 +1201,7 @@ class _DeviceSolve:
                 driver.close()
                 self._native = None
             return
+        qpods = [(self.pods[i], int(gi_arr[i])) for i in order]
         head = 0
         last_len: dict[str, int] = {}
         pod_errors = self.pod_errors
@@ -1244,6 +1261,11 @@ class _DeviceSolve:
             en.requirements = nd.reqs
         s.remaining_resources.update(self.remaining_resources)
         opt_index_arr = [np.asarray(idxs, dtype=np.int64) for idxs in self.opt_index]
+        # an empty daemon HostPortUsage (the common case) needs no deepcopy
+        empty_hostports = {
+            nct: not s.daemon_hostports[nct]._reserved
+            for nct in s.nodeclaim_templates
+        }
         for c in self.claims:
             nct = s.nodeclaim_templates[c.ti]
             surv_u = np.zeros(self.U, dtype=bool)
@@ -1258,7 +1280,9 @@ class _DeviceSolve:
                 nct,
                 s.topology,
                 s.daemon_overhead[nct],
-                _copy.deepcopy(s.daemon_hostports[nct]),
+                HostPortUsage()
+                if empty_hostports[nct]
+                else _copy.deepcopy(s.daemon_hostports[nct]),
                 options,
                 s.reservation_manager,
                 s.reserved_offering_mode,

@@ -58,25 +58,33 @@ def _build() -> Optional[str]:
     if os.path.exists(so):
         return so
     tmp = f"{so}.{os.getpid()}.tmp"  # unique per process: concurrent builders
-    for cxx in ("g++", "c++", "clang++"):
-        try:
-            r = subprocess.run(
-                [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
-                capture_output=True,
-                timeout=120,
-            )
-        except (OSError, subprocess.TimeoutExpired):
-            continue
-        if r.returncode == 0:
-            os.replace(tmp, so)
-            return so
-    return None
+    try:
+        for cxx in ("g++", "c++", "clang++"):
+            try:
+                r = subprocess.run(
+                    [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.TimeoutExpired):
+                continue
+            if r.returncode == 0:
+                os.replace(tmp, so)
+                return so
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _sigs(lib: ctypes.CDLL) -> None:
     lib.kt_new.restype = voidp
     lib.kt_new.argtypes = [
-        i32, i32, i32, i32, i32, i32, p_i32, p_f64, p_f64, p_u64, u8, f64,
+        i32, i32, i32, i32, i32, i32,
+        p_i32, p_f64, p_f64, p_i32, p_i32, p_u64, u8, f64,
     ]
     lib.kt_free.argtypes = [voidp]
     lib.kt_set_tol.argtypes = [voidp, i32, i32, u8]

@@ -382,11 +382,37 @@ class TopologyGroup:
         return f"TopologyGroup({self.type}, key={self.key}, domains={self.domains})"
 
 
+_domain_groups_cache: dict[tuple, dict] = {}
+_DOMAIN_CACHE_CAP = 16
+
+
 def build_domain_groups(
     node_pools: Sequence[NodePool], instance_types: dict
 ) -> dict[str, TopologyDomainGroup]:
     """Domain universe per topology key from nodepool ∩ instance-type
-    requirements (topology.go:94-131)."""
+    requirements (topology.go:94-131).
+
+    Memoized per (nodepool uid+version, catalog list identity): the scan is
+    O(nodepools × instance types × requirement rows) and its inputs only
+    change on nodepool updates or catalog refreshes, while the provisioner
+    rebuilds topology every batch. The result is treated as immutable by
+    all readers."""
+    try:
+        key = tuple(
+            (
+                np.metadata.uid,
+                np.metadata.resource_version,
+                id(instance_types.get(np.metadata.name)),
+                len(instance_types.get(np.metadata.name) or ()),
+            )
+            for np in node_pools
+        )
+    except (AttributeError, TypeError):
+        key = None
+    if key is not None:
+        hit = _domain_groups_cache.get(key)
+        if hit is not None:
+            return hit[0]
     domain_groups: dict[str, TopologyDomainGroup] = {}
     for np in node_pools:
         its = instance_types.get(np.metadata.name, [])
@@ -406,6 +432,15 @@ def build_domain_groups(
                 group = domain_groups.setdefault(req.key, TopologyDomainGroup())
                 for domain in req.values_list():
                     group.insert(domain, taints)
+    if key is not None:
+        if len(_domain_groups_cache) >= _DOMAIN_CACHE_CAP:
+            _domain_groups_cache.clear()
+        # the entry holds the instance-type lists so their id()s (part of
+        # the key) cannot be recycled onto different content while cached
+        _domain_groups_cache[key] = (
+            domain_groups,
+            [instance_types.get(np.metadata.name) for np in node_pools],
+        )
     return domain_groups
 
 
