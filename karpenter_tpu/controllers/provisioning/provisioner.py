@@ -68,7 +68,25 @@ def _type_fingerprint(it) -> tuple:
     )
 
 
-def default_engine_factory():
+def _build_solver_mesh(shard_devices: int):
+    """jax Mesh over the first `shard_devices` local devices for DP-sharded
+    cube sweeps (options.solver_pod_shard_axis); None when unavailable."""
+    if shard_devices <= 1:
+        return None
+    try:
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) < shard_devices:
+            return None
+        return Mesh(_np.array(devices[:shard_devices]), ("pods",))
+    except Exception:  # noqa: BLE001 — no usable backend: single device
+        return None
+
+
+def default_engine_factory(shard_devices: int = 1):
     """CatalogEngine per distinct instance-type union. Two cache levels: an
     id-keyed fast path (providers return stable InstanceType objects, so the
     steady-state lookup is free) backed by a process-wide content-keyed cache
@@ -94,7 +112,9 @@ def default_engine_factory():
             content_key = tuple(_type_fingerprint(it) for it in all_types)
             engine = _ENGINE_CONTENT_CACHE.get(content_key)
             if engine is None:
-                engine = CatalogEngine(all_types)
+                engine = CatalogEngine(
+                    all_types, mesh=_build_solver_mesh(shard_devices)
+                )
                 _ENGINE_CONTENT_CACHE[content_key] = engine
             # hold type refs so ids stay unique for the cache key's lifetime
             id_cache[id_key] = engine
@@ -130,7 +150,9 @@ class Provisioner:
         # ON (options.solver_backend == "tpu"): the fast path IS the real
         # path; pass solver_backend="host" or engine_factory=False to opt out.
         if engine_factory is None and self.options.solver_backend == "tpu":
-            engine_factory = default_engine_factory()
+            engine_factory = default_engine_factory(
+                shard_devices=self.options.solver_pod_shard_axis
+            )
         self.engine_factory = engine_factory or None
 
     def trigger(self, uid: str) -> None:

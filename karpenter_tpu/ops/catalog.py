@@ -120,9 +120,13 @@ class CatalogEngine:
         instance_types: Sequence[InstanceType],
         extra_resources: Sequence[str] = (),
         vocab: Optional[enc.Vocab] = None,
+        mesh=None,
     ):
         self.instance_types = list(instance_types)
         self.vocab = vocab or enc.Vocab()
+        # jax.sharding.Mesh for multi-chip cube sweeps (pod axis DP); None =
+        # single device
+        self.mesh = mesh
 
         names = list(DEFAULT_RESOURCE_DIMS)
         for it in self.instance_types:
@@ -335,6 +339,21 @@ class CatalogEngine:
             self._device_cache[name] = arr
         return arr
 
+    def _mesh_dev(self, name: str, host_array: np.ndarray):
+        """Mesh-replicated copy of a catalog matrix (the _dev analogue for
+        sharded sweeps): shipped to every chip once, not per query."""
+        key = f"mesh:{name}"
+        arr = self._device_cache.get(key)
+        if arr is None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            arr = jax.device_put(
+                host_array, NamedSharding(self.mesh, PartitionSpec())
+            )
+            self._device_cache[key] = arr
+        return arr
+
     # -- queries ------------------------------------------------------------
 
     def key_presence(self, reqs_list: Sequence[Requirements]) -> np.ndarray:
@@ -441,25 +460,48 @@ class CatalogEngine:
             offer_compat_h[:R] = self._offer_compat[used]
 
         if on_device:
-            membership_dev = jnp.asarray(membership)
-            compat = np.asarray(
-                feas.membership_all(membership_dev, jnp.asarray(req_compat_h))
-            )[:P]
             if self.num_offerings == 0:
+                compat = np.asarray(
+                    feas.membership_all(
+                        jnp.asarray(membership), jnp.asarray(req_compat_h)
+                    )
+                )[:P]
                 return Feasibility(
                     compat, fits, np.zeros((P, self.num_instances), dtype=bool)
                 )
-            has_offering = np.asarray(
-                feas.offering_reduce(
-                    membership_dev,
+            # ONE fused dispatch (both matmuls + offering reduce): through a
+            # tunneled chip the round-trip dominates, so program count is the
+            # cost model. With a mesh, the entity axis shards across chips.
+            if self.mesh is not None:
+                # pad the entity axis to a multiple of the mesh size (P2 is a
+                # power of two but the mesh need not be)
+                n = int(np.prod(self.mesh.devices.shape))
+                P3 = -(-max(P2, n) // n) * n
+                if P3 > P2:
+                    membership = np.pad(membership, ((0, P3 - P2), (0, 0)))
+                    key_present_p = np.pad(key_present_p, ((0, P3 - P2), (0, 0)))
+                compat_d, offering_d = feas.sharded_cube(self.mesh)(
+                    membership,
+                    req_compat_h,
+                    offer_compat_h,
+                    self._mesh_dev("custom_need", self.offering_custom_need),
+                    key_present_p,
+                    self._mesh_dev("available", self.offering_available),
+                    self._mesh_dev("owner_onehot", self._owner_onehot),
+                )
+            else:
+                compat_d, offering_d = feas.production_cube(
+                    jnp.asarray(membership),
+                    jnp.asarray(req_compat_h),
                     jnp.asarray(offer_compat_h),
                     self._dev("custom_need", self.offering_custom_need),
                     jnp.asarray(key_present_p),
                     self._dev("available", self.offering_available),
                     self._dev("owner_onehot", self._owner_onehot),
                 )
-            )[:P]
-            return Feasibility(compat, fits, has_offering)
+            return Feasibility(
+                np.asarray(compat_d)[:P], fits, np.asarray(offering_d)[:P]
+            )
 
         compat = feas.membership_all_np(membership, req_compat_h)[:P]
         if self.num_offerings == 0:

@@ -262,6 +262,59 @@ def quantize_resources(
     return np.clip(out, -(2**31) + 1, 2**30).astype(np.int64)
 
 
+def _cube_math(
+    membership,
+    req_compat,
+    offer_compat,
+    custom_need,
+    key_present,
+    available,
+    owner_onehot,
+):
+    """compat[P, I] and has_offering[P, I] in one program — the production
+    feasibility cube (both membership matmuls + offering reduce fused)."""
+    bad = membership.astype(jnp.float32) @ (~req_compat).astype(jnp.float32)
+    compat = bad < 0.5
+    offer_bad = membership.astype(jnp.float32) @ (~offer_compat).astype(jnp.float32)
+    offer_rows_ok = offer_bad < 0.5
+    undef_bad = custom_need.astype(jnp.float32) @ (~key_present).astype(jnp.float32).T
+    undef_ok = (undef_bad < 0.5).T
+    offer_ok = offer_rows_ok & undef_ok & available[None, :]
+    has_offering = (
+        offer_ok.astype(jnp.float32) @ owner_onehot.astype(jnp.float32)
+    ) > 0.5
+    return compat, has_offering
+
+
+production_cube = jax.jit(_cube_math)
+
+_sharded_cube_cache: dict = {}
+
+
+def sharded_cube(mesh):
+    """The production cube under shard_map: the entity axis (pods/groups ×
+    templates) is data-parallel across the mesh, the catalog matrices are
+    replicated, so every matmul is local to its chip and no collectives are
+    needed until results gather (SURVEY §7: DP-style sharding of the pod
+    dimension over ICI)."""
+    fn = _sharded_cube_cache.get(mesh)
+    if fn is None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        fn = jax.jit(
+            shard_map(
+                _cube_math,
+                mesh=mesh,
+                in_specs=(P(axis), P(), P(), P(), P(axis), P(), P()),
+                out_specs=(P(axis), P(axis)),
+            )
+        )
+        _sharded_cube_cache[mesh] = fn
+    return fn
+
+
 @jax.jit
 def offering_reduce(
     membership: jnp.ndarray,  # [P, R] bool

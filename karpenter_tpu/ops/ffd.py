@@ -1263,8 +1263,7 @@ class _DeviceSolve:
         opt_index_arr = [np.asarray(idxs, dtype=np.int64) for idxs in self.opt_index]
         # an empty daemon HostPortUsage (the common case) needs no deepcopy
         empty_hostports = {
-            nct: not s.daemon_hostports[nct]._reserved
-            for nct in s.nodeclaim_templates
+            nct: not s.daemon_hostports[nct] for nct in s.nodeclaim_templates
         }
         for c in self.claims:
             nct = s.nodeclaim_templates[c.ti]

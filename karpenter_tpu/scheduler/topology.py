@@ -398,12 +398,13 @@ def build_domain_groups(
     rebuilds topology every batch. The result is treated as immutable by
     all readers."""
     try:
+        # instance-type ELEMENT identities, not the wrapper list's (providers
+        # hand back a fresh list per call around stable InstanceType objects)
         key = tuple(
             (
                 np.metadata.uid,
                 np.metadata.resource_version,
-                id(instance_types.get(np.metadata.name)),
-                len(instance_types.get(np.metadata.name) or ()),
+                tuple(map(id, instance_types.get(np.metadata.name) or ())),
             )
             for np in node_pools
         )

@@ -47,6 +47,9 @@ class HostPortUsage:
     def __init__(self):
         self._reserved: dict[tuple[str, str], list[HostPort]] = {}
 
+    def __bool__(self) -> bool:
+        return bool(self._reserved)
+
     def add(self, pod: Pod, ports: list[HostPort]) -> None:
         self._reserved[(pod.metadata.namespace, pod.metadata.name)] = ports
 

@@ -210,3 +210,36 @@ class TestBackendTwins:
         np.testing.assert_array_equal(
             outs["host"].has_offering, outs["device"].has_offering
         )
+
+    def test_sharded_cube_identical(self, catalog):
+        """shard_map over the 8-device test mesh must produce the same cube
+        as the single-device path (pod axis DP, catalog replicated)."""
+        import jax
+        from jax.sharding import Mesh
+        from karpenter_tpu.ops import catalog as cat
+
+        devices = np.array(jax.devices("cpu")[:8])
+        mesh = Mesh(devices, ("pods",))
+        reqs_list = [
+            Requirements(
+                Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+                Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64" if i % 2 else "arm64"]),
+            )
+            for i in range(16)
+        ]
+        outs = {}
+        for mesh_arg in (None, mesh):
+            engine = CatalogEngine(catalog, mesh=mesh_arg)
+            rows = [engine.rows_for(r) for r in reqs_list]
+            requests = np.zeros((len(reqs_list), len(engine.resource_dims)))
+            old = cat.FORCE_BACKEND
+            cat.FORCE_BACKEND = "device"
+            try:
+                f = engine.feasibility(rows, requests, engine.key_presence(reqs_list))
+            finally:
+                cat.FORCE_BACKEND = old
+            outs[mesh_arg is not None] = f
+        np.testing.assert_array_equal(outs[False].compat, outs[True].compat)
+        np.testing.assert_array_equal(
+            outs[False].has_offering, outs[True].has_offering
+        )
