@@ -55,6 +55,14 @@ class KwokCloudProvider(CloudProvider):
         self.registration_delay = registration_delay
         self._instances: dict[str, _Instance] = {}
         self._counter = 0
+        # NodeOverlay application at launch (the provider-side half: the
+        # operator wraps get_instance_types consumers with the same overlays,
+        # so launch picks by the SAME adjusted prices the scheduler saw).
+        # Fail-safe off; the operator enables it from the feature gate.
+        self.honor_overlays = False
+        from karpenter_tpu.apis.nodeoverlay import OverlayApplier
+
+        self._overlay_applier = OverlayApplier(store)
 
     # -- CloudProvider boundary ---------------------------------------------
 
@@ -62,10 +70,16 @@ class KwokCloudProvider(CloudProvider):
         reqs = requirements_from_dicts(node_claim.spec.requirements)
         from karpenter_tpu.utils import resources as res
 
+        catalog = self.instance_types
+        if self.honor_overlays:
+            pool = self.store.try_get(
+                "NodePool", node_claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")
+            )
+            catalog = self._overlay_applier.apply(pool, catalog)
         requests = node_claim.spec.resources.requests
         compatible = [
             it
-            for it in self.instance_types
+            for it in catalog
             if it.requirements.intersects(reqs) is None
             and it.offerings.available().has_compatible(reqs)
             and res.fits(requests, it.allocatable())

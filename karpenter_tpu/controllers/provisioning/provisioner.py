@@ -109,7 +109,11 @@ def default_engine_factory(shard_devices: int = 1):
         id_key = tuple(sorted(seen))
         engine = id_cache.get(id_key)
         if engine is None:
-            content_key = tuple(_type_fingerprint(it) for it in all_types)
+            # shard_devices is part of the key: an engine carries its mesh
+            content_key = (
+                shard_devices,
+                tuple(_type_fingerprint(it) for it in all_types),
+            )
             engine = _ENGINE_CONTENT_CACHE.get(content_key)
             if engine is None:
                 engine = CatalogEngine(
@@ -246,6 +250,9 @@ class Provisioner:
         )
         if not node_pools:
             raise NoNodePoolsError("no nodepools found")
+        # NodeOverlay application happens at the provider boundary (operator
+        # wraps the provider with OverlayedCloudProvider when the gate is on)
+        # so every consumer prices instance types identically
         instance_types = {}
         for np in node_pools:
             its = self.cloud_provider.get_instance_types(np)

@@ -66,8 +66,17 @@ class Operator:
     ):
         self.clock = clock or Clock()
         self.store = store
-        self.cloud_provider = cloud_provider
         self.options = options or Options()
+        if self.options.feature_gates.node_overlay:
+            from karpenter_tpu.cloudprovider.overlay import OverlayedCloudProvider
+
+            # launch-side application is the provider's own half of the gate
+            if hasattr(cloud_provider, "honor_overlays"):
+                cloud_provider.honor_overlays = True
+            # one wrap at the operator boundary: every instance-type consumer
+            # (provisioning, disruption, drift, counters) sees adjusted types
+            cloud_provider = OverlayedCloudProvider(cloud_provider, store)
+        self.cloud_provider = cloud_provider
         self.recorder = Recorder(clock=self.clock)
         self.cluster = Cluster(
             self.clock, store, cloud_provider,
@@ -110,6 +119,15 @@ class Operator:
         self.np_registration_health = RegistrationHealthController(store, self.clock)
         self.np_validation = ValidationController(store, self.clock)
         self.binding = BindingController(store, self.cluster, self.clock, self.recorder)
+        self.overlay_validation = None
+        if self.options.feature_gates.node_overlay:
+            from karpenter_tpu.controllers.nodeoverlay import (
+                NodeOverlayValidationController,
+            )
+
+            self.overlay_validation = NodeOverlayValidationController(
+                store, self.clock
+            )
         self.pod_metrics = PodMetricsController(store, self.cluster, self.clock)
         self.node_metrics = NodeMetricsController(self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(store, self.cluster)
@@ -153,6 +171,8 @@ class Operator:
         # controller.go RequeueAfter): re-trigger each pass so pods left
         # pending after a batch re-enter the next window instead of being
         # stranded once their watch event is consumed.
+        if self.overlay_validation is not None:
+            self.overlay_validation.reconcile_all()
         for pending in self.store.list("Pod", predicate=podutil.is_provisionable):
             self.provisioner.trigger(pending.metadata.uid)
         self.provisioner.reconcile()

@@ -1,0 +1,247 @@
+"""NodeOverlay (v1alpha1): price adjustment semantics, weight precedence,
+validation, catalog application, and the e2e/drift interaction behind the
+feature gate (reference pkg/apis/v1alpha1/nodeoverlay.go:29-136)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import ObjectMeta
+from karpenter_tpu.apis.nodeoverlay import (
+    NodeOverlay,
+    NodeOverlaySpec,
+    apply_overlays,
+    order_by_weight,
+)
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import FeatureGates, Options
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+
+def overlay(name, weight=0, requirements=(), **spec):
+    return NodeOverlay(
+        metadata=ObjectMeta(name=name),
+        spec=NodeOverlaySpec(
+            requirements=list(requirements), weight=weight, **spec
+        ),
+    )
+
+
+class TestAdjustedPrice:
+    def test_no_adjustment_returns_same(self):
+        assert overlay("a").adjusted_price(1.5) == 1.5
+
+    def test_absolute_price_override(self):
+        assert overlay("a", price="2.25").adjusted_price(1.5) == 2.25
+
+    def test_fixed_delta(self):
+        assert overlay("a", price_adjustment="+0.5").adjusted_price(1.0) == 1.5
+        assert overlay("a", price_adjustment="-0.25").adjusted_price(1.0) == 0.75
+
+    def test_percentage(self):
+        assert overlay("a", price_adjustment="+10%").adjusted_price(2.0) == pytest.approx(2.2)
+        assert overlay("a", price_adjustment="-50%").adjusted_price(2.0) == pytest.approx(1.0)
+        assert overlay("a", price_adjustment="-100%").adjusted_price(2.0) == 0.0
+
+    def test_never_negative(self):
+        assert overlay("a", price_adjustment="-5").adjusted_price(1.0) == 0.0
+
+
+class TestOrderByWeight:
+    def test_higher_weight_first(self):
+        a, b = overlay("a", weight=1), overlay("b", weight=100)
+        assert order_by_weight([a, b]) == [b, a]
+
+    def test_ties_break_reverse_alphabetical(self):
+        # nodeoverlay.go:99-103: same weight → name later in the alphabet first
+        a, b = overlay("alpha", weight=5), overlay("beta", weight=5)
+        assert order_by_weight([a, b]) == [b, a]
+
+
+class TestValidation:
+    def test_price_and_adjustment_mutually_exclusive(self):
+        o = overlay("a", price="1.0", price_adjustment="+1")
+        assert "cannot set both" in o.validate()
+
+    def test_invalid_patterns(self):
+        assert overlay("a", price="-1.0").validate() is not None
+        assert overlay("a", price_adjustment="10").validate() is not None
+        assert overlay("a", price_adjustment="+10%").validate() is None
+        assert overlay("a", price_adjustment="-250%").validate() is not None
+
+    def test_weight_bounds(self):
+        assert overlay("a", weight=10_001).validate() is not None
+        assert overlay("a", weight=10_000).validate() is None
+
+    def test_restricted_capacity(self):
+        assert overlay("a", capacity={"cpu": 4.0}).validate() is not None
+        assert overlay("a", capacity={"example.com/gpu": 2.0}).validate() is None
+
+    def test_requirement_operators(self):
+        o = overlay("a", requirements=[{"key": "k", "operator": "In", "values": []}])
+        assert o.validate() is not None
+        o = overlay("a", requirements=[{"key": "k", "operator": "Gt", "values": ["-3"]}])
+        assert o.validate() is not None
+
+
+class TestApplyOverlays:
+    def setup_method(self):
+        self.catalog = construct_instance_types()
+        self.pool = nodepool("workers", labels={"team": "infra"})
+
+    def test_no_match_returns_same_objects(self):
+        o = overlay(
+            "a",
+            price="9.9",
+            requirements=[
+                {"key": wk.LABEL_INSTANCE_TYPE, "operator": "In", "values": ["nope"]}
+            ],
+        )
+        out = apply_overlays([o], self.pool, self.catalog)
+        assert all(a is b for a, b in zip(out, self.catalog))
+
+    def test_instance_type_price_override(self):
+        target = self.catalog[0]
+        o = overlay(
+            "a",
+            price="9.9",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "In",
+                    "values": [target.name],
+                }
+            ],
+        )
+        out = apply_overlays([o], self.pool, self.catalog)
+        adjusted = next(it for it in out if it.name == target.name)
+        assert adjusted is not target
+        assert all(off.price == 9.9 for off in adjusted.offerings)
+        untouched = next(it for it in out if it.name != target.name)
+        assert untouched is self.catalog[out.index(untouched)]
+
+    def test_zone_scoped_overlay_adjusts_only_matching_offerings(self):
+        o = overlay(
+            "a",
+            price_adjustment="+100%",
+            requirements=[
+                {
+                    "key": wk.LABEL_TOPOLOGY_ZONE,
+                    "operator": "In",
+                    "values": ["kwok-zone-1"],
+                }
+            ],
+        )
+        out = apply_overlays([o], self.pool, self.catalog)
+        base = self.catalog[0]
+        adjusted = out[0]
+        for b_off, a_off in zip(base.offerings, adjusted.offerings):
+            if b_off.zone == "kwok-zone-1":
+                assert a_off.price == pytest.approx(b_off.price * 2)
+            else:
+                assert a_off.price == b_off.price
+
+    def test_weight_precedence(self):
+        reqs = [
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": [self.catalog[0].name],
+            }
+        ]
+        low = overlay("low", weight=1, price="1.11", requirements=reqs)
+        high = overlay("high", weight=9, price="9.99", requirements=reqs)
+        out = apply_overlays([low, high], self.pool, self.catalog)
+        assert all(off.price == 9.99 for off in out[0].offerings)
+
+    def test_capacity_merge_adds_extended_resources(self):
+        o = overlay("a", capacity={"example.com/gpu": 2.0})
+        out = apply_overlays([o], self.pool, self.catalog)
+        assert out[0].capacity["example.com/gpu"] == 2.0
+        # standard resources untouched
+        assert out[0].capacity["cpu"] == self.catalog[0].capacity["cpu"]
+
+    def test_nodepool_template_label_matching(self):
+        o = overlay(
+            "a",
+            price="5.5",
+            requirements=[{"key": "team", "operator": "In", "values": ["infra"]}],
+        )
+        out = apply_overlays([o], self.pool, self.catalog)
+        assert all(off.price == 5.5 for off in out[0].offerings)
+        other_pool = nodepool("other")  # no team label: In on undefined → no match
+        out2 = apply_overlays([o], other_pool, self.catalog)
+        assert out2[0] is self.catalog[0]
+
+    def test_invalid_overlays_skipped(self):
+        o = overlay("a", price="9.9", price_adjustment="+1")
+        out = apply_overlays([o], self.pool, self.catalog)
+        assert out[0] is self.catalog[0]
+
+
+def gated_options():
+    return Options(feature_gates=FeatureGates(node_overlay=True))
+
+
+def settle(clock, op, passes=12, step=2.0):
+    for _ in range(passes):
+        clock.step(step)
+        op.run_once()
+
+
+class TestEndToEnd:
+    def test_overlay_steers_instance_selection(self):
+        """Making every non-target type pricier steers the cheapest-first
+        packing toward the target; the overlay rides the full operator loop."""
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = KwokCloudProvider(store, clock)
+        op = Operator(store, provider, clock=clock, options=gated_options())
+        store.create(nodepool("workers"))
+        store.create(
+            NodeOverlay(
+                metadata=ObjectMeta(name="pricey-amd"),
+                spec=NodeOverlaySpec(
+                    requirements=[
+                        {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]}
+                    ],
+                    price_adjustment="+1000%",
+                ),
+            )
+        )
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        claims = store.list("NodeClaim")
+        assert claims
+        # every claim prefers the un-inflated arch now
+        for claim in claims:
+            assert claim.metadata.labels.get(wk.LABEL_ARCH) == "arm64"
+        # validation controller stamped the overlay
+        ov = store.list(NodeOverlay.KIND)[0]
+        assert ov.condition_is_true("ValidationSucceeded")
+
+    def test_overlay_change_does_not_drift_existing_claims(self):
+        """Price overlays keep instance-type names stable, so pre-existing
+        NodeClaims must not be marked Drifted when an overlay appears."""
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = KwokCloudProvider(store, clock)
+        op = Operator(store, provider, clock=clock, options=gated_options())
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        claims = store.list("NodeClaim")
+        assert claims and all(not c.condition_is_true("Drifted") for c in claims)
+        store.create(
+            NodeOverlay(
+                metadata=ObjectMeta(name="repriced"),
+                spec=NodeOverlaySpec(requirements=[], price_adjustment="+50%"),
+            )
+        )
+        settle(clock, op, passes=6)
+        for claim in store.list("NodeClaim"):
+            assert not claim.condition_is_true("Drifted")
