@@ -1,0 +1,90 @@
+"""Binary entry point: `python -m karpenter_tpu`.
+
+Mirrors the reference's kwok binary (kwok/main.go:28-47): build the
+operator with the in-tree kwok provider, wire controllers, serve
+metrics/health, run the reconcile loop until signalled. The in-memory
+Store stands in for the API server (SURVEY.md §5: the store is the durable
+substrate; all state rebuilds from it on restart).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import time
+
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator import logging as klog
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.operator.serving import Server, ServingConfig
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import Clock
+
+
+def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -> int:
+    options = Options.parse(argv)
+    klog.configure(options.log_level)
+    log = klog.logger("operator")
+
+    clock = Clock()
+    store = Store(clock=clock)
+    provider = KwokCloudProvider(store, clock)
+    operator = Operator(store, provider, clock=clock, options=options)
+
+    servers = []
+    try:
+        serving = ServingConfig(
+            metrics_text=operator.metrics_text,
+            healthy=operator.healthy,
+            ready=operator.healthy,
+            enable_profiling=options.enable_profiling,
+        )
+        if options.metrics_port > 0:
+            servers.append(Server(options.metrics_port, serving).start())
+        if options.health_probe_port > 0 and options.health_probe_port != options.metrics_port:
+            servers.append(Server(options.health_probe_port, serving).start())
+    except OSError as e:
+        log.error("failed to bind serving ports", error=str(e))
+        return 1
+
+    stop = {"requested": False}
+
+    def _signal(signum, frame):
+        log.info("shutdown requested", signal=signum)
+        stop["requested"] = True
+
+    try:
+        signal.signal(signal.SIGINT, _signal)
+        signal.signal(signal.SIGTERM, _signal)
+    except ValueError:
+        pass  # not the main thread (tests)
+
+    log.info(
+        "starting operator",
+        provider="kwok",
+        metrics_port=options.metrics_port,
+        health_port=options.health_probe_port,
+        feature_gates=vars(options.feature_gates),
+    )
+    passes = 0
+    while not stop["requested"]:
+        started = time.monotonic()
+        try:
+            operator.run_once()
+        except Exception:  # noqa: BLE001 — the loop must survive
+            log.error("reconcile pass failed", exc_info=True)
+        passes += 1
+        if max_passes is not None and passes >= max_passes:
+            break
+        delay = pass_interval - (time.monotonic() - started)
+        if delay > 0 and not stop["requested"]:
+            time.sleep(delay)
+    log.info("operator stopped", passes=passes)
+    for server in servers:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,7 @@ from karpenter_tpu.state.statenode import StateNode, active, deleting
 from karpenter_tpu.utils import nodepool as nodepoolutil
 from karpenter_tpu.utils.clock import Clock
 from karpenter_tpu.utils.pdb import Limits
+from karpenter_tpu.operator import logging as klog
 
 if TYPE_CHECKING:
     from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
@@ -70,8 +71,11 @@ def simulate_scheduling(
         (p.metadata.namespace, p.metadata.name) for p in deleting_node_pods
     }
 
-    scheduler = provisioner.new_scheduler(pods, state_nodes)
-    results = scheduler.solve(pods, timeout=60.0)
+    # simulations are silent (the reference's NopLogger injection,
+    # helpers.go:102,115): consolidation runs hundreds per pass
+    with klog.nop():
+        scheduler = provisioner.new_scheduler(pods, state_nodes)
+        results = scheduler.solve(pods, timeout=60.0)
     results.truncate_instance_types()
     # Pods landing on uninitialized nodes are speculative — fail them so
     # consolidation doesn't rely on capacity that may never materialize.

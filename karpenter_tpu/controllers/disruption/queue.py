@@ -23,7 +23,10 @@ from karpenter_tpu.state.statenode import (
     clear_node_claims_condition,
     require_no_schedule_taint,
 )
+from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.utils.clock import Clock
+
+_log = klog.logger("disruption")
 
 if TYPE_CHECKING:
     from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
@@ -90,6 +93,12 @@ class Queue:
         if len(marked) != len(cmd.candidates) and (cmd.replacements or not marked):
             raise ValueError("marking disrupted failed")
         cmd.candidates = marked
+        _log.info(
+            "disrupting nodeclaim(s)",
+            reason=cmd.reason,
+            candidates=[c.name() for c in cmd.candidates],
+            replacements=len(cmd.replacements),
+        )
         self._create_replacements(cmd)
         if cmd.results is not None:
             cmd.results.record(self.recorder, self.cluster)

@@ -37,6 +37,9 @@ from karpenter_tpu.scheduling.taints import (
 )
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu.operator import logging as klog
+
+_log = klog.logger("nodeclaim.lifecycle")
 
 LAUNCH_TTL = 300.0  # liveness.go: unlaunched claims die after 5m
 REGISTRATION_TTL = 900.0  # liveness.go:46-51: unregistered after 15m
@@ -113,6 +116,12 @@ class LifecycleController:
             return
         _populate_node_claim_details(claim, created)
         claim.set_condition(CONDITION_LAUNCHED, "True", now=self.clock.now())
+        _log.info(
+            "launched nodeclaim",
+            nodeclaim=claim.metadata.name,
+            provider_id=claim.status.provider_id,
+            instance_type=claim.metadata.labels.get(wk.LABEL_INSTANCE_TYPE, ""),
+        )
 
     def _delete_claim(self, claim: NodeClaim, reason: str) -> None:
         _NODECLAIMS_DISRUPTED.inc(

@@ -28,8 +28,11 @@ from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.statenode import StateNode, active, deleting
 from karpenter_tpu.utils import nodepool as nodepoolutil
 from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.utils.clock import Clock
 from karpenter_tpu.utils.pdb import Limits
+
+_log = klog.logger("provisioner")
 
 PROVISIONED_REASON = "provisioned"
 
@@ -175,6 +178,12 @@ class Provisioner:
         results = self.schedule()
         if results is None or not results.new_node_claims:
             return results
+        _log.info(
+            "computed new nodeclaim(s) to fit pod(s)",
+            nodeclaims=len(results.new_node_claims),
+            pods=sum(len(nc.pods) for nc in results.new_node_claims),
+            failed=len(results.pod_errors),
+        )
         self.create_node_claims(
             results.new_node_claims, reason=PROVISIONED_REASON, record_pod_nomination=True
         )

@@ -10,6 +10,7 @@ operator exposes it (operator.py).
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -26,6 +27,12 @@ def _label_key(labels: dict[str, str]) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+# One lock for all series mutation/exposition: the serving thread scrapes
+# while the operator loop records; dict iteration during insert would
+# otherwise race. Metric ops are rare enough that one lock is fine.
+_LOCK = threading.Lock()
+
+
 class Metric:
     def __init__(self, name: str, help: str, label_names: Iterable[str] = ()):
         self.name = name
@@ -39,6 +46,10 @@ class Counter(Metric):
         self._values: dict[tuple, float] = {}
 
     def inc(self, labels: Optional[dict[str, str]] = None, value: float = 1.0) -> None:
+        with _LOCK:
+            self._inc(labels, value)
+
+    def _inc(self, labels: Optional[dict[str, str]], value: float) -> None:
         key = _label_key(labels or {})
         self._values[key] = self._values.get(key, 0.0) + value
 
@@ -55,9 +66,14 @@ class Gauge(Metric):
         self._values: dict[tuple, float] = {}
 
     def set(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
-        self._values[_label_key(labels or {})] = value
+        with _LOCK:
+            self._values[_label_key(labels or {})] = value
 
     def add(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        with _LOCK:
+            self._add(value, labels)
+
+    def _add(self, value: float, labels: Optional[dict[str, str]]) -> None:
         key = _label_key(labels or {})
         self._values[key] = self._values.get(key, 0.0) + value
 
@@ -86,6 +102,10 @@ class Histogram(Metric):
         self._totals: dict[tuple, int] = {}
 
     def observe(self, value: float, labels: Optional[dict[str, str]] = None) -> None:
+        with _LOCK:
+            self._observe(value, labels)
+
+    def _observe(self, value: float, labels: Optional[dict[str, str]]) -> None:
         key = _label_key(labels or {})
         counts = self._counts.setdefault(key, [0] * len(self.buckets))
         for i, b in enumerate(self.buckets):
@@ -135,7 +155,11 @@ class Registry:
         return self._metrics.get(name)
 
     def expose(self) -> str:
-        """Prometheus text-format dump."""
+        """Prometheus text-format dump (atomic vs concurrent recording)."""
+        with _LOCK:
+            return self._expose()
+
+    def _expose(self) -> str:
         lines = []
         for m in self._metrics.values():
             lines.append(f"# HELP {m.name} {m.help}")
@@ -195,8 +219,9 @@ class Store:
         self._owned[key] = owned
 
     def delete(self, key: str) -> None:
-        for gauge, label_key in self._owned.pop(key, []):
-            gauge._values.pop(label_key, None)
+        with _LOCK:
+            for gauge, label_key in self._owned.pop(key, []):
+                gauge._values.pop(label_key, None)
 
     def reset(self) -> None:
         for key in list(self._owned):

@@ -76,6 +76,11 @@ class Operator:
             # one wrap at the operator boundary: every instance-type consumer
             # (provisioning, disruption, drift, counters) sees adjusted types
             cloud_provider = OverlayedCloudProvider(cloud_provider, store)
+        # per-method duration/error instrumentation, decorated by default
+        # (reference pkg/cloudprovider/metrics/cloudprovider.go)
+        from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+
+        cloud_provider = MetricsCloudProvider(cloud_provider)
         self.cloud_provider = cloud_provider
         self.recorder = Recorder(clock=self.clock)
         self.cluster = Cluster(

@@ -1,0 +1,152 @@
+"""HTTP serving: /metrics, /healthz, /readyz, and profiling endpoints.
+
+Mirrors the reference's operator serving surface
+(pkg/operator/operator.go:169-208): a metrics server exposing the
+Prometheus registry, health/readiness probes, and — behind
+--enable-profiling — pprof-style introspection (/debug/stacks dumps all
+thread stacks; /debug/profile?seconds=N runs a cProfile sample and returns
+the stats text). Runs on daemon threads; never blocks the operator loop.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class ServingConfig:
+    def __init__(
+        self,
+        metrics_text: Callable[[], str],
+        healthy: Callable[[], bool],
+        ready: Callable[[], bool],
+        enable_profiling: bool = False,
+    ):
+        self.metrics_text = metrics_text
+        self.healthy = healthy
+        self.ready = ready
+        self.enable_profiling = enable_profiling
+
+
+def _profile_sample(seconds: float, interval: float = 0.01) -> str:
+    """Statistical CPU sampler across ALL threads (cProfile is thread-local
+    and would only see this handler sleeping): sample sys._current_frames
+    every `interval`, aggregate leaf and whole-stack counts — the pprof-style
+    view of where the operator loop and solver actually spend time."""
+    import collections
+    import time
+
+    deadline = time.monotonic() + min(seconds, 30.0)
+    me = threading.get_ident()
+    leaf_counts: collections.Counter = collections.Counter()
+    stack_counts: collections.Counter = collections.Counter()
+    samples = 0
+    while time.monotonic() < deadline:
+        for thread_id, frame in sys_current_frames().items():
+            if thread_id == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 40:
+                code = f.f_code
+                stack.append(f"{code.co_filename}:{f.f_lineno}:{code.co_name}")
+                f = f.f_back
+            if not stack:
+                continue
+            leaf_counts[stack[0]] += 1
+            stack_counts[";".join(reversed(stack))] += 1
+        samples += 1
+        time.sleep(interval)
+    out = [f"# {samples} samples over {seconds}s at {interval * 1000:.0f}ms"]
+    out.append("\n== hottest frames ==")
+    for loc, n in leaf_counts.most_common(40):
+        out.append(f"{n:6d} {loc}")
+    out.append("\n== hottest stacks ==")
+    for stack, n in stack_counts.most_common(15):
+        out.append(f"{n:6d} {stack}")
+    return "\n".join(out)
+
+
+def _stacks() -> str:
+    out = []
+    for thread_id, frame in sys_current_frames().items():
+        out.append(f"--- thread {thread_id} ---")
+        out.extend(traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+def sys_current_frames():
+    import sys
+
+    return sys._current_frames()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    config: ServingConfig  # set on the subclass per server
+
+    def log_message(self, *args) -> None:  # quiet: operator logs are JSON
+        pass
+
+    def _respond(self, code: int, body: str, content_type: str = "text/plain") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        cfg = self.config
+        url = urlparse(self.path)
+        try:
+            if url.path == "/metrics":
+                self._respond(200, cfg.metrics_text(), "text/plain; version=0.0.4")
+            elif url.path == "/healthz":
+                ok = cfg.healthy()
+                self._respond(200 if ok else 500, "ok" if ok else "unhealthy")
+            elif url.path == "/readyz":
+                ok = cfg.ready()
+                self._respond(200 if ok else 500, "ok" if ok else "not ready")
+            elif url.path == "/debug/stacks" and cfg.enable_profiling:
+                self._respond(200, _stacks())
+            elif url.path == "/debug/profile" and cfg.enable_profiling:
+                seconds = float(
+                    parse_qs(url.query).get("seconds", ["1.0"])[0]
+                )
+                self._respond(200, _profile_sample(seconds))
+            else:
+                self._respond(404, "not found")
+        except Exception as e:  # noqa: BLE001 — serving must not die
+            try:
+                self._respond(500, f"error: {e}")
+            except OSError:
+                pass
+
+
+class Server:
+    """One ThreadingHTTPServer on a daemon thread."""
+
+    def __init__(self, port: int, config: ServingConfig, host: str = ""):
+        handler = type("BoundHandler", (_Handler,), {"config": config})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "Server":
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, name="karpenter-serving", daemon=True
+        )
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

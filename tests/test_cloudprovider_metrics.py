@@ -1,0 +1,66 @@
+"""CloudProvider metrics decorator (reference
+pkg/cloudprovider/metrics/cloudprovider.go): per-method duration/error
+instrumentation, decorated by default in the operator."""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.metrics import (
+    MetricsCloudProvider,
+    _DURATION,
+    _ERRORS,
+)
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+
+class TestMetricsCloudProvider:
+    def test_duration_recorded_per_method(self):
+        provider = MetricsCloudProvider(FakeCloudProvider())
+        before = _DURATION.count(
+            {"controller": "", "method": "list", "provider": "fake"}
+        )
+        provider.list()
+        assert (
+            _DURATION.count({"controller": "", "method": "list", "provider": "fake"})
+            == before + 1
+        )
+
+    def test_errors_counted_by_type(self):
+        provider = MetricsCloudProvider(FakeCloudProvider())
+        labels = {
+            "controller": "",
+            "method": "get",
+            "provider": "fake",
+            "error": "NodeClaimNotFoundError",
+        }
+        before = _ERRORS.value(labels)
+        with pytest.raises(NodeClaimNotFoundError):
+            provider.get("kwok://nope")
+        assert _ERRORS.value(labels) == before + 1
+
+    def test_delegates_unwrapped_attributes(self):
+        inner = FakeCloudProvider()
+        provider = MetricsCloudProvider(inner)
+        assert provider.name() == "fake"
+        assert provider.created is inner.created
+
+    def test_operator_decorates_by_default_and_exposes(self):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+        assert isinstance(op.cloud_provider, MetricsCloudProvider)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        for _ in range(8):
+            clock.step(2.0)
+            op.run_once()
+        text = global_registry.expose()
+        assert "karpenter_cloudprovider_duration_seconds" in text
+        assert 'method="create"' in text or "method=\"create\"" in text
