@@ -46,6 +46,8 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             servers.append(Server(options.health_probe_port, serving).start())
     except OSError as e:
         log.error("failed to bind serving ports", error=str(e))
+        for server in servers:
+            server.stop()
         return 1
 
     stop = {"requested": False}

@@ -24,17 +24,6 @@ _ERRORS = global_registry.counter(
     labels=("controller", "method", "provider", "error"),
 )
 
-_METHODS = (
-    "create",
-    "delete",
-    "get",
-    "list",
-    "get_instance_types",
-    "is_drifted",
-    "repair_policies",
-)
-
-
 class MetricsCloudProvider:
     """Duration/error instrumentation around every provider method; all
     other attributes delegate to the wrapped provider."""

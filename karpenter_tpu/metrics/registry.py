@@ -143,13 +143,19 @@ class Registry:
         )
 
     def _get_or_create(self, name, factory, cls):
-        m = self._metrics.get(name)
-        if m is None:
-            m = factory()
-            self._metrics[name] = m
-        elif not isinstance(m, cls):
-            raise TypeError(f"metric {name} already registered as {type(m).__name__}")
-        return m
+        # registration happens at import time — including LAZY imports mid-run
+        # (the first device solve pulls in ops/ffd) — so it must not race a
+        # concurrent scrape iterating the metric dict
+        with _LOCK:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name} already registered as {type(m).__name__}"
+                )
+            return m
 
     def get(self, name: str) -> Optional[Metric]:
         return self._metrics.get(name)

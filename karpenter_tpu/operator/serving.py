@@ -11,6 +11,7 @@ the stats text). Runs on daemon threads; never blocks the operator loop.
 from __future__ import annotations
 
 import io
+import sys
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -73,16 +74,10 @@ def _profile_sample(seconds: float, interval: float = 0.01) -> str:
 
 def _stacks() -> str:
     out = []
-    for thread_id, frame in sys_current_frames().items():
+    for thread_id, frame in sys._current_frames().items():
         out.append(f"--- thread {thread_id} ---")
         out.extend(traceback.format_stack(frame))
     return "\n".join(out)
-
-
-def sys_current_frames():
-    import sys
-
-    return sys._current_frames()
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -12,6 +12,8 @@ import pytest
 from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.operator.serving import Server, ServingConfig
 
+from helpers import nodepool, unschedulable_pod
+
 
 class TestCLI:
     def test_help(self):
@@ -117,9 +119,6 @@ class TestServing:
         from karpenter_tpu.runtime.store import Store
         from karpenter_tpu.utils.clock import FakeClock
 
-        sys.path.insert(0, "tests")
-        from helpers import nodepool, unschedulable_pod
-
         clock = FakeClock()
         store = Store(clock=clock)
         op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
@@ -175,9 +174,6 @@ class TestLogging:
         from karpenter_tpu.operator.operator import Operator
         from karpenter_tpu.runtime.store import Store
         from karpenter_tpu.utils.clock import FakeClock
-
-        sys.path.insert(0, "tests")
-        from helpers import nodepool, unschedulable_pod
 
         clock = FakeClock()
         store = Store(clock=clock)
