@@ -103,6 +103,116 @@ def build_pods():
     return pods
 
 
+def consolidation_bench(rounds: int = 3) -> float:
+    """Median wall-clock of one multi-node consolidation compute over 1000
+    underutilized candidate nodes (binary search ≤100, each probe a full
+    scheduling simulation) — the reference caps this at 1 minute
+    (multinodeconsolidation.go:36)."""
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.core import (
+        Condition,
+        Container,
+        Node,
+        NodeSpec,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from karpenter_tpu.apis.nodeclaim import NodeClaim
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.disruption import Controller as DisruptionController
+    from karpenter_tpu.controllers.disruption.queue import Queue as DisruptionQueue
+    from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
+    from karpenter_tpu.events.recorder import Recorder
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.runtime.store import Store
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informer import StateInformer
+    from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = FakeCloudProvider()
+    cluster = Cluster(clock, store, provider)
+    informer = StateInformer(store, cluster)
+    recorder = Recorder(clock=clock)
+    provisioner = Provisioner(store, provider, cluster, recorder, clock, Options())
+    queue = DisruptionQueue(store, recorder, cluster, clock, provisioner)
+    controller = DisruptionController(
+        clock, store, provisioner, provider, recorder, cluster, queue
+    )
+    pool = NodePool(metadata=ObjectMeta(name="workers"))
+    pool.set_condition("Ready", "True")
+    store.create(pool)
+    cap = parse_resource_list({"cpu": "4", "memory": "16Gi", "pods": "110"})
+    for i in range(1000):
+        name = f"cand-{i:04d}"
+        labels = {
+            wk.NODEPOOL_LABEL_KEY: "workers",
+            wk.LABEL_INSTANCE_TYPE: "c-4x-amd64-linux",
+            wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1",
+            wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+            wk.LABEL_OS: "linux",
+            wk.LABEL_ARCH: "amd64",
+            wk.NODE_REGISTERED_LABEL_KEY: "true",
+            wk.NODE_INITIALIZED_LABEL_KEY: "true",
+            wk.LABEL_HOSTNAME: name,
+        }
+        node = Node(
+            metadata=ObjectMeta(name=name, labels=dict(labels)),
+            spec=NodeSpec(provider_id=f"fake://{name}"),
+            status=NodeStatus(capacity=dict(cap), allocatable=dict(cap)),
+        )
+        node.status.conditions.append(Condition(type="Ready", status="True"))
+        claim = NodeClaim(
+            metadata=ObjectMeta(
+                name=f"{name}-claim",
+                labels={
+                    k: v
+                    for k, v in labels.items()
+                    if k
+                    not in (
+                        wk.NODE_REGISTERED_LABEL_KEY,
+                        wk.NODE_INITIALIZED_LABEL_KEY,
+                        wk.LABEL_HOSTNAME,
+                    )
+                },
+            )
+        )
+        claim.status.provider_id = f"fake://{name}"
+        claim.status.node_name = name
+        claim.status.capacity = dict(cap)
+        claim.status.allocatable = dict(cap)
+        for cond in ("Launched", "Registered", "Initialized", "Consolidatable"):
+            claim.set_condition(cond, "True")
+        store.create(claim)
+        store.create(node)
+        for j in range(2):
+            pod = Pod(
+                metadata=ObjectMeta(name=f"{name}-p{j}"),
+                spec=PodSpec(
+                    node_name=name,
+                    containers=[Container(requests=parse_resource_list({"cpu": "200m"}))],
+                ),
+            )
+            pod.status.conditions.append(Condition(type="PodScheduled", status="True"))
+            store.create(pod)
+    informer.flush()
+    clock.step(120)
+    times = []
+    for _ in range(rounds + 1):
+        start = time.perf_counter()
+        controller.reconcile()
+        times.append((time.perf_counter() - start) * 1000.0)
+        controller._pending = None  # drop the parked command; recompute fresh
+        clock.step(60)
+        cluster.mark_unconsolidated()
+    return float(np.median(times[1:]))  # first round pays compile/caches
+
+
 def main() -> None:
     from karpenter_tpu.apis.nodepool import NodePool
     from karpenter_tpu.apis.core import ObjectMeta
@@ -168,6 +278,7 @@ def main() -> None:
     assert len(results.new_node_claims) == claims
 
     p50 = float(np.percentile(times, 50))
+    consolidation_ms = consolidation_bench()
     print(
         json.dumps(
             {
@@ -175,7 +286,9 @@ def main() -> None:
                     f"p50 production solve (Scheduler.solve, device fast path), "
                     f"{NUM_PODS} pods x {engine.num_instances} instance types (kwok) "
                     f"-> {claims} claims, {errors} errors; cold pass "
-                    f"{cold_ms:.0f}ms; decisions host-oracle-identical"
+                    f"{cold_ms:.0f}ms; decisions host-oracle-identical; "
+                    f"multi-node consolidation @1000 candidates: "
+                    f"{consolidation_ms:.0f}ms/compute (ref cap 60s)"
                 ),
                 "value": round(p50, 2),
                 "unit": "ms",

@@ -194,7 +194,13 @@ class MultiNodeConsolidation:
     def _first_n_consolidation_option(
         self, candidates: list[Candidate], max_n: int
     ) -> Command:
-        """multinodeconsolidation.go:117-170."""
+        """multinodeconsolidation.go:117-170.
+
+        Each probe is a full scheduling simulation; consecutive probes share
+        the engine's interned requirement rows and feasibility masks, so
+        after the first simulation the device work per probe is just the
+        joint sets the previous probes haven't seen — the binary search
+        itself stays sequential (each bound depends on the last verdict)."""
         if len(candidates) < 2:
             return Command()
         lo_n, hi_n = 1, min(max_n, len(candidates) - 1)

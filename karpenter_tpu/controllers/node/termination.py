@@ -86,9 +86,7 @@ class Terminator:
     def drain(self, node: Node, grace_expiration: Optional[float]) -> Optional[str]:
         """Evict pods in groups, critical last; None when drained
         (terminator.go:96-138)."""
-        pods = self.store.list(
-            "Pod", predicate=lambda p: p.spec.node_name == node.metadata.name
-        )
+        pods = self.store.pods_on_node(node.metadata.name)
         # TGP enforcement: pods whose own grace period overruns the node
         # deadline are force-deleted (terminator.go:140-166)
         if grace_expiration is not None:
@@ -105,9 +103,7 @@ class Terminator:
                         )
                     )
                     self.store.delete(p)
-            pods = self.store.list(
-                "Pod", predicate=lambda p: p.spec.node_name == node.metadata.name
-            )
+            pods = self.store.pods_on_node(node.metadata.name)
         drainable = [p for p in pods if podutil.is_waiting_eviction(p, self.clock)]
         evictable = [p for p in drainable if podutil.is_evictable(p)]
         # group: non-critical first, critical (priority >= 2e9 or node-critical

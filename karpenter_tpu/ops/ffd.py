@@ -67,8 +67,11 @@ if TYPE_CHECKING:
 # every feature; the device path's fixed costs don't pay off.
 DEVICE_MIN_PODS = 64
 # Existing-node joins run through host requirement algebra per (node, group)
-# pair; cap the node count so that stays off the critical path.
-DEVICE_MAX_EXISTING = 512
+# pair with monotone scan pointers, so large clusters stay O(nodes + pods);
+# the cap is a safety valve for pathological node counts. 4096 keeps the
+# 1k-candidate consolidation simulations (7 binary-search rounds over ~1000
+# surviving nodes each) on the fast path.
+DEVICE_MAX_EXISTING = 4096
 
 # Observability: how often the fast path ran vs fell back. Mirrored into the
 # metrics registry so operators can alert on fallback storms.

@@ -82,6 +82,14 @@ class Store:
         self._version = 0
         # repr snapshots backing apply()'s update-if-changed guard
         self._applied_repr: dict[tuple[str, tuple[str, str]], str] = {}
+        # pod-by-node field index (the reference registers a field indexer
+        # for exactly this query, operator.go:235-278): candidate discovery
+        # asks "pods on node X" once per node per pass, which would be
+        # O(nodes x pods) as a predicate scan
+        self._pod_node: dict[tuple[str, str], str] = {}
+        # inner dict used as an insertion-ordered set: iteration order is
+        # deterministic (a real set would hash-randomize pod order)
+        self._node_pods: dict[str, dict[tuple[str, str], None]] = {}
 
     # -- watches -----------------------------------------------------------
 
@@ -111,6 +119,8 @@ class Store:
         # Keep the apply() snapshot current: the DeepEqual guard compares
         # against the object's latest written state, not the last patch.
         self._applied_repr[(kind, key)] = repr(obj)
+        if kind == "Pod":
+            self._index_pod(key, obj)
         self._emit(ADDED, obj)
         return obj
 
@@ -156,6 +166,8 @@ class Store:
         # an object must not let a later apply() suppress the revert (the
         # reference's DeepEqual guard compares against the stored object).
         self._applied_repr[(obj.KIND, key)] = repr(obj)
+        if obj.KIND == "Pod":
+            self._index_pod(key, obj)
         self._emit(MODIFIED, obj)
         # Deleting object whose finalizers were all stripped is removed now.
         if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
@@ -208,7 +220,35 @@ class Store:
         if bucket.pop(_key(obj), None) is not None:
             self._version += 1
             self._applied_repr.pop((obj.KIND, _key(obj)), None)
+            if obj.KIND == "Pod":
+                self._index_pod(_key(obj), None)
             self._emit(DELETED, obj)
+
+    def _index_pod(self, key: tuple[str, str], obj: Optional[Any]) -> None:
+        node_name = obj.spec.node_name if obj is not None else ""
+        old = self._pod_node.get(key)
+        if old == node_name:
+            return
+        if old:
+            self._node_pods.get(old, {}).pop(key, None)
+        if node_name:
+            self._pod_node[key] = node_name
+            self._node_pods.setdefault(node_name, {})[key] = None
+        else:
+            self._pod_node.pop(key, None)
+
+    def pods_on_node(self, node_name: str) -> list[Any]:
+        """Indexed equivalent of list("Pod", node_name predicate). Pods
+        whose node_name changed WITHOUT a store write are filtered here but
+        only re-indexed on their next write (same staleness window as the
+        reference's informer-cache indexer)."""
+        bucket = self._objects.get("Pod", {})
+        out = []
+        for key in self._node_pods.get(node_name, ()):
+            p = bucket.get(key)
+            if p is not None and p.spec.node_name == node_name:
+                out.append(p)
+        return out
 
     def remove_finalizer(self, obj: Any, finalizer: str) -> None:
         if finalizer in obj.metadata.finalizers:

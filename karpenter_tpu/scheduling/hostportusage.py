@@ -50,6 +50,12 @@ class HostPortUsage:
     def __bool__(self) -> bool:
         return bool(self._reserved)
 
+    def copy(self) -> "HostPortUsage":
+        """Independent copy for simulations; HostPort entries are frozen."""
+        out = HostPortUsage()
+        out._reserved = {k: list(v) for k, v in self._reserved.items()}
+        return out
+
     def add(self, pod: Pod, ports: list[HostPort]) -> None:
         self._reserved[(pod.metadata.namespace, pod.metadata.name)] = ports
 

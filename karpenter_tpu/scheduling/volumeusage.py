@@ -97,6 +97,17 @@ class VolumeUsage:
     def add_limit(self, driver: str, value: int) -> None:
         self._limits[driver] = value
 
+    def copy(self) -> "VolumeUsage":
+        """Independent copy for simulations (pvc-id sets copied)."""
+        out = VolumeUsage()
+        out._volumes = Volumes({k: set(v) for k, v in self._volumes.items()})
+        out._pod_volumes = {
+            pk: Volumes({k: set(v) for k, v in vols.items()})
+            for pk, vols in self._pod_volumes.items()
+        }
+        out._limits = dict(self._limits)
+        return out
+
     def exceeds_limits(self, vols: Volumes) -> Optional[str]:
         for driver, pvc_ids in self._volumes.union(vols).items():
             limit = self._limits.get(driver)

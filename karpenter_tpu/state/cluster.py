@@ -241,7 +241,7 @@ class Cluster:
 
     def _populate_resource_requests(self, n: StateNode) -> None:
         node_name = n.node.metadata.name
-        for pod in self.store.list("Pod", predicate=lambda p: p.spec.node_name == node_name):
+        for pod in self.store.pods_on_node(node_name):
             if podutil.is_terminal(pod):
                 continue
             n.update_for_pod(self.store, pod)

@@ -171,8 +171,7 @@ class StateNode:
     def pods(self, store: Store) -> list[Pod]:
         if self.node is None:
             return []
-        node_name = self.node.metadata.name
-        return store.list("Pod", predicate=lambda p: p.spec.node_name == node_name)
+        return store.pods_on_node(self.node.metadata.name)
 
     def reschedulable_pods(self, store: Store) -> list[Pod]:
         return [p for p in self.pods(store) if podutil.is_reschedulable(p)]
@@ -239,15 +238,13 @@ class StateNode:
         solver mutates hostports/volumes/requests on its copy, never the
         live mirror. The Node/NodeClaim objects stay shared — simulations
         only read them."""
-        import copy as _copy
-
         out = StateNode.__new__(StateNode)
         out.node = self.node
         out.node_claim = self.node_claim
         out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}
         out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
-        out.hostport_usage = _copy.deepcopy(self.hostport_usage)
-        out.volume_usage = _copy.deepcopy(self.volume_usage)
+        out.hostport_usage = self.hostport_usage.copy()
+        out.volume_usage = self.volume_usage.copy()
         out.marked_for_deletion = self.marked_for_deletion
         out.nominated_until = self.nominated_until
         return out
