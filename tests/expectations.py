@@ -1,0 +1,120 @@
+"""Test expectation helpers, mirroring the reference's expectation library
+(pkg/test/expectations/expectations.go): the verbs suites use to drive
+controllers and assert cluster outcomes without re-implementing store
+plumbing per test. Python/pytest idiom — plain functions raising
+AssertionError — replacing the Gomega matchers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from karpenter_tpu.apis import labels as wk
+
+
+def expect_applied(store, *objects):
+    """Create-or-update each object (ExpectApplied)."""
+    for obj in objects:
+        key = (obj.metadata.namespace, obj.metadata.name)
+        if store.try_get(obj.KIND, key[1], key[0]) is None:
+            store.create(obj)
+        else:
+            store.update(obj)
+    return objects[0] if len(objects) == 1 else objects
+
+
+def expect_exists(store, kind: str, name: str, namespace: str = "default"):
+    obj = store.try_get(kind, name, namespace)
+    assert obj is not None, f"{kind} {namespace}/{name} should exist"
+    return obj
+
+
+def expect_not_found(store, kind: str, name: str, namespace: str = "default"):
+    obj = store.try_get(kind, name, namespace)
+    assert obj is None, f"{kind} {namespace}/{name} should not exist"
+
+
+def expect_scheduled(store, pod) -> Any:
+    """The pod must be bound to a node; returns the Node (ExpectScheduled)."""
+    live = store.try_get("Pod", pod.metadata.name, pod.metadata.namespace)
+    assert live is not None, f"pod {pod.metadata.name} vanished"
+    assert live.spec.node_name, f"pod {pod.metadata.name} should be scheduled"
+    return expect_exists(store, "Node", live.spec.node_name)
+
+
+def expect_not_scheduled(store, pod) -> None:
+    live = store.try_get("Pod", pod.metadata.name, pod.metadata.namespace)
+    assert live is not None, f"pod {pod.metadata.name} vanished"
+    assert not live.spec.node_name, (
+        f"pod {pod.metadata.name} should not be scheduled "
+        f"(bound to {live.spec.node_name})"
+    )
+
+
+def expect_node_claims(store, count: Optional[int] = None) -> list:
+    claims = store.list("NodeClaim")
+    if count is not None:
+        assert len(claims) == count, f"expected {count} nodeclaims, got {len(claims)}"
+    return claims
+
+
+def expect_nodes(store, count: Optional[int] = None) -> list:
+    nodes = store.list("Node")
+    if count is not None:
+        assert len(nodes) == count, f"expected {count} nodes, got {len(nodes)}"
+    return nodes
+
+
+def expect_launched(store, claim) -> Any:
+    """Claim registered+initialized with a provider id (ExpectLaunched)."""
+    live = expect_exists(store, "NodeClaim", claim.metadata.name)
+    assert live.condition_is_true("Launched"), f"{live.metadata.name} not Launched"
+    assert live.status.provider_id
+    return live
+
+
+def expect_initialized(store, claim) -> Any:
+    live = expect_exists(store, "NodeClaim", claim.metadata.name)
+    for condition in ("Launched", "Registered", "Initialized"):
+        assert live.condition_is_true(condition), (
+            f"{live.metadata.name} should be {condition}"
+        )
+    return live
+
+
+def expect_provisioned(clock, operator, *pods, passes: int = 12, step: float = 2.0):
+    """Drive the operator loop until the batch window and lifecycle settle,
+    then return each pod's Node (ExpectProvisioned). Pods must already be in
+    the store."""
+    for _ in range(passes):
+        clock.step(step)
+        operator.run_once()
+    return [expect_scheduled(operator.store, p) for p in pods]
+
+
+def expect_condition(obj, condition_type: str, status: str = "True") -> None:
+    cond = obj.get_condition(condition_type)
+    assert cond is not None, f"{obj.metadata.name}: no condition {condition_type}"
+    assert cond.status == status, (
+        f"{obj.metadata.name}: {condition_type}={cond.status}, want {status}"
+    )
+
+
+def expect_metric_value(metric, want: float, labels: Optional[dict] = None) -> None:
+    got = metric.value(labels or {})
+    assert got == want, f"metric {metric.name}{labels or ''}: {got} != {want}"
+
+
+def expect_node_labels(node, **labels) -> None:
+    for key, value in labels.items():
+        key = key.replace("_", "/") if "/" not in key else key
+        assert node.metadata.labels.get(key) == value, (
+            f"node {node.metadata.name}: label {key}="
+            f"{node.metadata.labels.get(key)!r}, want {value!r}"
+        )
+
+
+def expect_no_disruption_taint(node) -> None:
+    assert not any(
+        t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints
+    ), f"node {node.metadata.name} should not carry the disruption taint"

@@ -1,0 +1,61 @@
+"""Expectation-library-driven e2e flows (the reference suites' idiom:
+ExpectApplied → drive → ExpectScheduled/ExpectProvisioned;
+pkg/test/expectations/expectations.go)."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from expectations import (
+    expect_applied,
+    expect_condition,
+    expect_initialized,
+    expect_node_claims,
+    expect_not_scheduled,
+    expect_provisioned,
+    expect_scheduled,
+)
+from helpers import nodepool, unschedulable_pod
+
+
+def make_operator():
+    clock = FakeClock()
+    store = Store(clock=clock)
+    op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+    return clock, store, op
+
+
+class TestExpectationFlows:
+    def test_provisioned_pods_land_on_nodes(self):
+        clock, store, op = make_operator()
+        expect_applied(store, nodepool("workers"))
+        pods = [unschedulable_pod(requests={"cpu": "1"}) for _ in range(3)]
+        expect_applied(store, *pods)
+        nodes = expect_provisioned(clock, op, *pods)
+        assert len({n.metadata.name for n in nodes}) >= 1
+        for claim in expect_node_claims(store):
+            expect_initialized(store, claim)
+            expect_condition(claim, "Launched")
+
+    def test_unsatisfiable_pod_stays_pending(self):
+        clock, store, op = make_operator()
+        expect_applied(store, nodepool("workers"))
+        good = expect_applied(store, unschedulable_pod(requests={"cpu": "1"}))
+        bad = expect_applied(store, unschedulable_pod(requests={"cpu": "9999"}))
+        expect_provisioned(clock, op, good)
+        expect_not_scheduled(store, bad)
+
+    def test_selector_respected_end_to_end(self):
+        clock, store, op = make_operator()
+        expect_applied(store, nodepool("workers"))
+        pod = expect_applied(
+            store,
+            unschedulable_pod(
+                requests={"cpu": "1"}, node_selector={wk.LABEL_ARCH: "arm64"}
+            ),
+        )
+        expect_provisioned(clock, op, pod)
+        node = expect_scheduled(store, pod)
+        assert node.metadata.labels[wk.LABEL_ARCH] == "arm64"

@@ -169,3 +169,70 @@ class TestOptions:
         assert opts.batch_idle_duration == 2.5
         assert opts.batch_max_duration == 20.0
         assert opts.feature_gates.spot_to_spot_consolidation is True
+
+
+class TestPodNodeIndex:
+    """The pod-by-node field index (the reference's field-indexer analog,
+    operator.go:235-278) must stay coherent across every write transition."""
+
+    def _store(self):
+        from karpenter_tpu.utils.clock import FakeClock
+
+        return Store(clock=FakeClock())
+
+    def _pod(self, name, node=""):
+        from karpenter_tpu.apis.core import ObjectMeta, Pod, PodSpec
+
+        return Pod(metadata=ObjectMeta(name=name), spec=PodSpec(node_name=node))
+
+    def test_bound_pod_indexed_on_create(self):
+        store = self._store()
+        store.create(self._pod("a", node="n1"))
+        assert [p.metadata.name for p in store.pods_on_node("n1")] == ["a"]
+        assert store.pods_on_node("n2") == []
+
+    def test_unbound_pod_not_indexed_until_bind(self):
+        store = self._store()
+        pod = store.create(self._pod("a"))
+        assert store.pods_on_node("n1") == []
+        pod.spec.node_name = "n1"
+        store.update(pod)
+        assert [p.metadata.name for p in store.pods_on_node("n1")] == ["a"]
+
+    def test_rebind_moves_index_entry(self):
+        store = self._store()
+        pod = store.create(self._pod("a", node="n1"))
+        pod.spec.node_name = "n2"
+        store.update(pod)
+        assert store.pods_on_node("n1") == []
+        assert [p.metadata.name for p in store.pods_on_node("n2")] == ["a"]
+
+    def test_delete_removes_entry(self):
+        store = self._store()
+        pod = store.create(self._pod("a", node="n1"))
+        store.delete(pod)
+        assert store.pods_on_node("n1") == []
+
+    def test_finalizer_deferred_delete(self):
+        store = self._store()
+        pod = self._pod("a", node="n1")
+        pod.metadata.finalizers = ["example.com/finalizer"]
+        store.create(pod)
+        store.delete(pod)  # only sets deletionTimestamp
+        assert [p.metadata.name for p in store.pods_on_node("n1")] == ["a"]
+        store.remove_finalizer(pod, "example.com/finalizer")  # object removed
+        assert store.pods_on_node("n1") == []
+
+    def test_stale_in_place_mutation_filtered(self):
+        store = self._store()
+        pod = store.create(self._pod("a", node="n1"))
+        pod.spec.node_name = "n2"  # mutated WITHOUT a store write
+        assert store.pods_on_node("n1") == []  # stale entry filtered
+        store.update(pod)
+        assert [p.metadata.name for p in store.pods_on_node("n2")] == ["a"]
+
+    def test_deterministic_insertion_order(self):
+        store = self._store()
+        for name in ("c", "a", "b"):
+            store.create(self._pod(name, node="n1"))
+        assert [p.metadata.name for p in store.pods_on_node("n1")] == ["c", "a", "b"]
