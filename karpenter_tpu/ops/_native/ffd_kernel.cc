@@ -529,25 +529,46 @@ void kt_failed(Ctx* c, uint8_t* dst) {
 
 int32_t kt_num_claims(Ctx* c) { return int32_t(c->claims.size()); }
 
-// per-claim readback for emit
-void kt_claim_info(Ctx* c, int32_t ci, int64_t* info) {
-  const Claim& cl = c->claims[ci];
-  info[0] = cl.ti;
-  info[1] = cl.fam;
-  info[2] = cl.count;
-  info[3] = cl.M;
-  info[4] = int64_t(cl.members.size());
-  info[5] = int64_t(cl.group_order.size());
+// bulk readback for emit: one call sizes everything, one call fills the
+// caller's flat buffers (per-claim calls cost ~509 x 5 ctypes round trips)
+void kt_export_sizes(Ctx* c, int64_t* out) {
+  int64_t u = 0, m = 0, g = 0;
+  for (const Claim& cl : c->claims) {
+    u += cl.M;
+    m += int64_t(cl.members.size());
+    g += int64_t(cl.group_order.size());
+  }
+  out[0] = int64_t(c->claims.size());
+  out[1] = u;
+  out[2] = m;
+  out[3] = g;
 }
-void kt_claim_read(Ctx* c, int32_t ci, uint64_t* type_mask, int32_t* u_ids,
-                   int32_t* members, int32_t* groups, int32_t* counts) {
-  const Claim& cl = c->claims[ci];
-  std::memcpy(type_mask, cl.type_mask.data(), sizeof(uint64_t) * c->W);
-  std::memcpy(u_ids, cl.u_ids.data(), sizeof(int32_t) * cl.M);
-  std::memcpy(members, cl.members.data(), sizeof(int32_t) * cl.members.size());
-  for (size_t i = 0; i < cl.group_order.size(); ++i) {
-    groups[i] = cl.group_order[i];
-    counts[i] = cl.group_count[cl.group_order[i]];
+
+// info layout per claim: [ti, fam, count, M, n_members, n_groups]
+void kt_export(Ctx* c, int64_t* info, uint64_t* type_masks, int32_t* u_ids,
+               int32_t* members, int32_t* groups, int32_t* counts) {
+  int64_t ui = 0, mi = 0, gi2 = 0;
+  for (size_t ci = 0; ci < c->claims.size(); ++ci) {
+    const Claim& cl = c->claims[ci];
+    int64_t* row = info + ci * 6;
+    row[0] = cl.ti;
+    row[1] = cl.fam;
+    row[2] = cl.count;
+    row[3] = cl.M;
+    row[4] = int64_t(cl.members.size());
+    row[5] = int64_t(cl.group_order.size());
+    std::memcpy(type_masks + ci * c->W, cl.type_mask.data(),
+                sizeof(uint64_t) * c->W);
+    std::memcpy(u_ids + ui, cl.u_ids.data(), sizeof(int32_t) * cl.M);
+    ui += cl.M;
+    std::memcpy(members + mi, cl.members.data(),
+                sizeof(int32_t) * cl.members.size());
+    mi += int64_t(cl.members.size());
+    for (int32_t g : cl.group_order) {
+      groups[gi2] = g;
+      counts[gi2] = cl.group_count[g];
+      ++gi2;
+    }
   }
 }
 

@@ -105,8 +105,8 @@ def _sigs(lib: ctypes.CDLL) -> None:
     lib.kt_failed.argtypes = [voidp, p_u8]
     lib.kt_num_claims.restype = i32
     lib.kt_num_claims.argtypes = [voidp]
-    lib.kt_claim_info.argtypes = [voidp, i32, p_i64]
-    lib.kt_claim_read.argtypes = [voidp, i32, p_u64, p_i32, p_i32, p_i32, p_i32]
+    lib.kt_export_sizes.argtypes = [voidp, p_i64]
+    lib.kt_export.argtypes = [voidp, p_i64, p_u64, p_i32, p_i32, p_i32, p_i32]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -224,6 +224,47 @@ class NodeClaim:
         self.annotations = dict(template.annotations)
         self.labels = dict(template.labels)
 
+    @classmethod
+    def from_precomputed(
+        cls,
+        template: NodeClaimTemplate,
+        topology: Topology,
+        daemon_resources: ResourceList,
+        daemon_hostports: HostPortUsage,
+        instance_types: list[InstanceType],
+        reservation_manager: ReservationManager,
+        reserved_offering_mode: str,
+        reserved_capacity_enabled: bool,
+        engine,
+        hostname: str,
+        requirements: Requirements,
+        pods: list,
+        requests: ResourceList,
+    ) -> "NodeClaim":
+        """Construct from solver-precomputed state (the device fast path,
+        ops/ffd.py emit): identical attribute set to __init__, but the
+        requirement set, members, and accumulated requests are supplied
+        instead of built — __init__'s template-requirements copy would be
+        discarded work at hundreds of claims per solve."""
+        nc = cls.__new__(cls)
+        nc.template = template
+        nc.hostname = hostname
+        nc.requirements = requirements
+        nc.instance_type_options = instance_types
+        nc.requests = requests
+        nc.daemon_resources = daemon_resources
+        nc.topology = topology
+        nc.hostport_usage = daemon_hostports
+        nc.reservation_manager = reservation_manager
+        nc.reserved_offering_mode = reserved_offering_mode
+        nc.reserved_capacity_enabled = reserved_capacity_enabled
+        nc.reserved_offerings = []
+        nc.engine = engine
+        nc.pods = pods
+        nc.annotations = dict(template.annotations)
+        nc.labels = dict(template.labels)
+        return nc
+
     @property
     def nodepool_name(self) -> str:
         return self.template.nodepool_name

@@ -105,9 +105,8 @@ def expect_metric_value(metric, want: float, labels: Optional[dict] = None) -> N
     assert got == want, f"metric {metric.name}{labels or ''}: {got} != {want}"
 
 
-def expect_node_labels(node, **labels) -> None:
+def expect_node_labels(node, labels: dict) -> None:
     for key, value in labels.items():
-        key = key.replace("_", "/") if "/" not in key else key
         assert node.metadata.labels.get(key) == value, (
             f"node {node.metadata.name}: label {key}="
             f"{node.metadata.labels.get(key)!r}, want {value!r}"
