@@ -135,8 +135,23 @@ class ConsistencyController:
         for name, quantity in claim.status.allocatable.items():
             if quantity > 0 and node.status.allocatable.get(name, 0.0) <= 0:
                 failures.append(f"expected resource {name!r} not found on node")
-        # claim-required taints must not be missing post-startup
         if claim.condition_is_true(CONDITION_INITIALIZED):
+            # NodeShape (consistency/nodeshape.go:35-59): for every requested
+            # resource, the registered node must carry ≥90% of the capacity
+            # the claim promised
+            requests = claim.spec.resources.requests
+            for name, requested in requests.items():
+                expected = claim.status.capacity.get(name, 0.0)
+                if requested <= 0 or expected <= 0:
+                    continue
+                found = node.status.capacity.get(name, 0.0)
+                pct = found / expected
+                if pct < 0.90:
+                    failures.append(
+                        f"expected {expected} of resource {name}, but found "
+                        f"{found} ({pct * 100:.1f}% of expected)"
+                    )
+            # claim-required taints must not be missing post-startup
             node_taints = {(t.key, t.effect) for t in node.spec.taints}
             for t in claim.spec.taints:
                 if (t.key, t.effect) not in node_taints:

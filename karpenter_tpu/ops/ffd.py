@@ -422,22 +422,28 @@ class _NativeDriver:
         out[: b.size] = b
         return out.view(np.uint64)
 
-    def add_claim(self, ti, fam, hostname, pod, gi, candidate, u_ids, rem):
+    def add_claim(self, ti, fam, hostname, pod, gi, candidate, u_ids, rem, reusable):
         # called from _open_claim while resolving ACT_NEED_NEW_CLAIM; the
-        # opening pod is the one the kernel just handed us. The packed mask
-        # and int32 u_ids are cached per candidate-array identity: openings
-        # for the same (template, group) reuse one encoding (see open_cache).
+        # opening pod is the one the kernel just handed us. For open_cache-
+        # shared candidate arrays (reusable), the packed mask and int32 u_ids
+        # are cached per array identity: openings for the same (template,
+        # group) reuse one encoding. One-shot arrays (limits in play) are
+        # encoded inline — caching them could never hit.
         nat = self.nat
         self.claim_meta.append(hostname)
-        cached = self._pack_cache.get(id(candidate))
-        if cached is None:
-            cached = (
-                self._pack(candidate),
-                np.ascontiguousarray(u_ids, dtype=np.int32),
-                candidate,  # hold the array so its id can't recycle
-            )
-            self._pack_cache[id(candidate)] = cached
-        mask, u32 = cached[0], cached[1]
+        if reusable:
+            cached = self._pack_cache.get(id(candidate))
+            if cached is None:
+                cached = (
+                    self._pack(candidate),
+                    np.ascontiguousarray(u_ids, dtype=np.int32),
+                    candidate,  # hold the array so its id can't recycle
+                )
+                self._pack_cache[id(candidate)] = cached
+            mask, u32 = cached[0], cached[1]
+        else:
+            mask = self._pack(candidate)
+            u32 = np.ascontiguousarray(u_ids, dtype=np.int32)
         remc = np.ascontiguousarray(rem, dtype=np.float64)
         self.lib.kt_add_claim(
             self.ctx,
@@ -1116,7 +1122,9 @@ class _DeviceSolve:
                 if fam < 0:
                     errs.append(self._open_errs[(ti, gi)])
                     continue
-                self._open_claim(ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy())
+                self._open_claim(
+                    ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy(), reusable=True
+                )
                 return None
             joint_tg, rows = tg
             compat_v, offer_v = self._joint_masks(rows, joint_tg)
@@ -1140,7 +1148,16 @@ class _DeviceSolve:
             rem0_fit = rem0[fitrows]
             if limits_mask is None:
                 self.open_cache[(ti, gi)] = (fam, candidate, u_ids, rem0_fit)
-            self._open_claim(ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy())
+            self._open_claim(
+                ti,
+                fam,
+                pod,
+                gi,
+                candidate,
+                u_ids,
+                rem0_fit.copy(),
+                reusable=limits_mask is None,
+            )
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[u_ids] = True
             self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
@@ -1164,12 +1181,17 @@ class _DeviceSolve:
         candidate: np.ndarray,
         u_ids: np.ndarray,
         rem: np.ndarray,
+        reusable: bool = False,
     ) -> None:
         """Register a freshly opened claim with the active driver (Python
-        loop or native kernel); the opening pod is its first member."""
+        loop or native kernel); the opening pod is its first member.
+        `reusable` marks candidate/u_ids arrays shared via open_cache (the
+        native driver caches their packed encodings only then)."""
         hostname = f"device-placeholder-{next(_placeholder_counter):04d}"
         if self._native is not None:
-            self._native.add_claim(ti, fam, hostname, pod, gi, candidate, u_ids, rem)
+            self._native.add_claim(
+                ti, fam, hostname, pod, gi, candidate, u_ids, rem, reusable
+            )
             return
         self.seq += 1
         c = _Claim(ti, fam, hostname, candidate, u_ids, rem, self.seq)

@@ -10,6 +10,7 @@ from karpenter_tpu.utils.clock import FakeClock
 
 from expectations import (
     expect_applied,
+    expect_node_labels,
     expect_condition,
     expect_initialized,
     expect_node_claims,
@@ -58,4 +59,4 @@ class TestExpectationFlows:
         )
         expect_provisioned(clock, op, pod)
         node = expect_scheduled(store, pod)
-        assert node.metadata.labels[wk.LABEL_ARCH] == "arm64"
+        expect_node_labels(node, {wk.LABEL_ARCH: "arm64"})

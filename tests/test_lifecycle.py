@@ -29,6 +29,7 @@ from karpenter_tpu.controllers.node.termination import (
 )
 from karpenter_tpu.controllers.nodeclaim.disruption import DisruptionController
 from karpenter_tpu.controllers.nodeclaim.gc import (
+    ConsistencyController,
     ExpirationController,
     GarbageCollectionController,
 )
@@ -402,3 +403,59 @@ class TestNodePoolControllers:
         CounterController(store, cluster).reconcile(pool)
         assert pool.status.node_count == 1
         assert pool.status.resources["cpu"] == 4.0
+
+
+class TestConsistency:
+    """NodeShape + taint invariants (consistency/controller.go:66-161,
+    nodeshape.go:35-59)."""
+
+    def _pair(self, store, clock, cpu_found="4", cpu_expected=4.0):
+        from karpenter_tpu.apis.core import Node, NodeSpec, NodeStatus
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        claim = NodeClaim(metadata=ObjectMeta(name="claim-c1"))
+        claim.status.provider_id = "fake://c1"
+        claim.spec.resources.requests = {"cpu": 1.0, "memory": 1.0}
+        claim.status.capacity = {"cpu": cpu_expected, "memory": float(2**30)}
+        claim.status.allocatable = dict(claim.status.capacity)
+        for cond in ("Launched", "Registered", "Initialized"):
+            claim.set_condition(cond, "True")
+        store.create(claim)
+        node = Node(
+            metadata=ObjectMeta(name="node-c1"),
+            spec=NodeSpec(provider_id="fake://c1"),
+            status=NodeStatus(
+                capacity=parse_resource_list({"cpu": cpu_found, "memory": "1Gi"}),
+                allocatable=parse_resource_list({"cpu": cpu_found, "memory": "1Gi"}),
+            ),
+        )
+        store.create(node)
+        return claim, node
+
+    def test_consistent_pair_passes(self, env):
+        clock, store, provider, recorder = env
+        claim, _ = self._pair(store, clock)
+        ConsistencyController(store, recorder, clock).reconcile(claim)
+        cond = claim.get_condition("ConsistentStateFound")
+        assert cond is not None and cond.status == "True"
+
+    def test_undersized_node_flagged(self, env):
+        clock, store, provider, recorder = env
+        # node carries 2 cpu where the claim promised 4 → 50% < 90%
+        claim, _ = self._pair(store, clock, cpu_found="2", cpu_expected=4.0)
+        ConsistencyController(store, recorder, clock).reconcile(claim)
+        cond = claim.get_condition("ConsistentStateFound")
+        assert cond is not None and cond.status == "False"
+        assert "% of expected" in cond.message
+
+    def test_missing_required_taint_flagged(self, env):
+        from karpenter_tpu.apis.core import Taint
+
+        clock, store, provider, recorder = env
+        claim, node = self._pair(store, clock)
+        claim.spec.taints = [Taint(key="team", value="infra", effect="NoSchedule")]
+        store.update(claim)
+        ConsistencyController(store, recorder, clock).reconcile(claim)
+        cond = claim.get_condition("ConsistentStateFound")
+        assert cond is not None and cond.status == "False"
+        assert "taint" in cond.message
