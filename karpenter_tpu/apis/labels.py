@@ -71,6 +71,13 @@ LABEL_DOMAIN_EXCEPTIONS = frozenset(
 )
 
 # Restricted-domain labels Karpenter understands and allows (reference labels.go:83-92)
+# Uniquely identifies a capacity reservation on a reserved offering. The
+# reference leaves this provider-overridable and its in-tree providers
+# register it as well-known (cloudprovider/types.go:44-49,
+# fake/cloudprovider.go:45) — without that, no claim could ever be
+# compatible with a reserved offering's requirements.
+RESERVATION_ID_LABEL_KEY = GROUP + "/reservation-id"
+
 WELL_KNOWN_LABELS = frozenset(
     {
         NODEPOOL_LABEL_KEY,
@@ -81,6 +88,7 @@ WELL_KNOWN_LABELS = frozenset(
         LABEL_OS,
         CAPACITY_TYPE_LABEL_KEY,
         LABEL_WINDOWS_BUILD,
+        RESERVATION_ID_LABEL_KEY,
     }
 )
 

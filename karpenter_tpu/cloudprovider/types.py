@@ -23,8 +23,9 @@ if TYPE_CHECKING:
     from karpenter_tpu.apis.nodepool import NodePool
 
 # Label injected into a reserved offering's requirements to uniquely identify
-# a reservation (types.go:44-49). Providers may override.
-RESERVATION_ID_LABEL = "karpenter.sh/reservation-id"
+# a reservation (types.go:44-49); registered well-known in apis/labels so
+# claims are compatible with reserved offerings without defining it.
+RESERVATION_ID_LABEL = wk.RESERVATION_ID_LABEL_KEY
 
 SPOT_REQUIREMENT = Requirements(
     Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, [wk.CAPACITY_TYPE_SPOT])

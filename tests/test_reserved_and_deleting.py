@@ -1,0 +1,196 @@
+"""Reserved-capacity scheduling (reference suite_test.go:3976-4455) and
+deleting-node rescheduling (suite_test.go:3545-3699) specs."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Condition
+from karpenter_tpu.state.statenode import deleting
+from karpenter_tpu.utils.pdb import Limits
+from karpenter_tpu.cloudprovider.types import (
+    InstanceType,
+    Offering,
+    Offerings,
+    RESERVATION_ID_LABEL,
+)
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from helpers import (
+    bind_pod,
+    daemonset,
+    daemonset_pod,
+    node_claim_pair,
+    nodepool,
+    unschedulable_pod,
+)
+from test_scheduler import Env
+
+
+def reserved_catalog(reservation_capacity=2):
+    """One 4-cpu instance type: on-demand at 1.0 plus a reserved offering
+    (reservation cr-1) at a tenth of the price."""
+
+    def offering(ct, price, rid=None, capacity=0):
+        rows = [
+            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, [ct]),
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]),
+        ]
+        if rid is not None:
+            rows.append(Requirement(RESERVATION_ID_LABEL, Operator.IN, [rid]))
+        return Offering(
+            requirements=Requirements(*rows),
+            price=price,
+            available=True,
+            reservation_capacity=capacity,
+        )
+
+    return [
+        InstanceType(
+            name="r-4x",
+            requirements=Requirements(
+                Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN, ["r-4x"]),
+                Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+                Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]),
+                Requirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_RESERVED],
+                ),
+            ),
+            offerings=Offerings(
+                [
+                    offering(wk.CAPACITY_TYPE_ON_DEMAND, 1.0),
+                    offering(
+                        wk.CAPACITY_TYPE_RESERVED,
+                        0.1,
+                        rid="cr-1",
+                        capacity=reservation_capacity,
+                    ),
+                ]
+            ),
+            capacity=parse_resource_list(
+                {"cpu": "4", "memory": "16Gi", "pods": "110"}
+            ),
+        )
+    ]
+
+
+class TestReservedCapacity:
+    """scheduling/reservationmanager.go + nodeclaim.go reserved offerings."""
+
+    def test_reserved_offering_preferred(self):
+        env = Env(catalog=reserved_catalog())
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        [nc] = results.new_node_claims
+        # the claim holds the reservation: capacity-type narrowed to reserved
+        assert nc.reserved_offerings
+        assert nc.reserved_offerings[0].reservation_id == "cr-1"
+
+    def test_reservation_capacity_tracked_across_claims(self):
+        # 2 reserved instances available; 3 claims' worth of pods → the third
+        # claim falls back to on-demand (fallback mode default)
+        env = Env(catalog=reserved_catalog(reservation_capacity=2))
+        pods = [unschedulable_pod(requests={"cpu": "3"}) for _ in range(3)]
+        results = env.schedule(pods)
+        assert len(results.new_node_claims) == 3
+        reserved_claims = [
+            nc for nc in results.new_node_claims if nc.reserved_offerings
+        ]
+        assert len(reserved_claims) == 2
+
+    def test_exhausted_reservation_falls_back_to_on_demand(self):
+        env = Env(catalog=reserved_catalog(reservation_capacity=0))
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        [nc] = results.new_node_claims
+        assert not nc.reserved_offerings
+        assert not results.pod_errors
+
+    def test_reserved_disabled_by_feature_gate(self):
+        env = Env(
+            catalog=reserved_catalog(), reserved_capacity_enabled=False
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        [nc] = results.new_node_claims
+        assert not nc.reserved_offerings
+
+
+class TestDeletingNodeRescheduling:
+    """provisioner.go:294-311 — pods on deleting nodes re-enter the pending
+    set so replacement capacity is provisioned before the drain completes."""
+
+    def test_active_pods_rescheduled_through_provisioner(self):
+        """The full path: a bound pod on a deleting node joins the batch and
+        the provisioner computes replacement capacity for it."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
+        from karpenter_tpu.events.recorder import Recorder
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.state.cluster import Cluster
+        from karpenter_tpu.state.informer import StateInformer
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = FakeCloudProvider()
+        cluster = Cluster(clock, store, provider)
+        informer = StateInformer(store, cluster)
+        recorder = Recorder(clock=clock)
+        prov = Provisioner(store, provider, cluster, recorder, clock, Options())
+        store.create(nodepool("default"))
+        node, claim = node_claim_pair("dying-1")
+        node.metadata.deletion_timestamp = 1.0
+        claim.metadata.deletion_timestamp = 1.0
+        store.create(node)
+        store.create(claim)
+        pod = bind_pod(unschedulable_pod(requests={"cpu": "1"}), node)
+        store.create(pod)
+        informer.flush()
+        prov.trigger(pod.metadata.uid)
+        clock.step(1.5)
+        results = prov.reconcile()
+        assert results is not None
+        # the bound pod was treated as pending: a replacement claim exists
+        replacement = [
+            c for c in store.list("NodeClaim") if c.metadata.name != claim.metadata.name
+        ]
+        assert len(replacement) == 1
+
+    def test_inactive_pods_not_rescheduled(self):
+        env = Env(state_nodes=[])
+        node, claim = node_claim_pair("dying-2")
+        node.metadata.deletion_timestamp = 1.0
+        claim.metadata.deletion_timestamp = 1.0
+        env.store.create(node)
+        env.store.create(claim)
+        pod = bind_pod(unschedulable_pod(requests={"cpu": "1"}), node)
+        pod.status.phase = "Succeeded"
+        env.store.create(pod)
+        env.informer.flush()
+        dying = deleting(env.cluster.state_nodes())
+        resched = [
+            p
+            for n in dying
+            for p in n.currently_reschedulable_pods(env.store, Limits.from_pdbs([]))
+        ]
+        assert resched == []
+
+    def test_daemonset_pods_not_rescheduled(self):
+        env = Env(state_nodes=[])
+        node, claim = node_claim_pair("dying-3")
+        node.metadata.deletion_timestamp = 1.0
+        claim.metadata.deletion_timestamp = 1.0
+        env.store.create(node)
+        env.store.create(claim)
+        ds = daemonset(requests={"cpu": "1"})
+        ds_pod = daemonset_pod(ds, node_name=node.metadata.name)
+        ds_pod.status.conditions.append(Condition(type="PodScheduled", status="True"))
+        env.store.create(ds_pod)
+        env.informer.flush()
+        dying = deleting(env.cluster.state_nodes())
+        resched = [
+            p
+            for n in dying
+            for p in n.currently_reschedulable_pods(env.store, Limits.from_pdbs([]))
+        ]
+        assert resched == []
