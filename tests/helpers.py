@@ -184,3 +184,27 @@ def node_claim_pair(
     if consolidatable:
         claim.set_condition("Consolidatable", "True")
     return node, claim
+
+
+def make_provisioner_harness(options=None):
+    """Store + cluster + informer + Provisioner wiring shared by the
+    provisioner-level suites (one copy; keep constructor churn here)."""
+    from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
+    from karpenter_tpu.events.recorder import Recorder
+    from karpenter_tpu.operator.options import Options as _Options
+    from karpenter_tpu.runtime.store import Store
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informer import StateInformer
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = FakeCloudProvider()
+    cluster = Cluster(clock, store, provider)
+    informer = StateInformer(store, cluster)
+    recorder = Recorder(clock=clock)
+    prov = Provisioner(
+        store, provider, cluster, recorder, clock, options or _Options()
+    )
+    return clock, store, provider, cluster, informer, prov

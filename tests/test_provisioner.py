@@ -19,14 +19,9 @@ from helpers import nodepool, registered_node, unschedulable_pod
 
 @pytest.fixture
 def env():
-    clock = FakeClock()
-    store = Store(clock=clock)
-    provider = FakeCloudProvider()
-    cluster = Cluster(clock, store, provider)
-    informer = StateInformer(store, cluster)
-    recorder = Recorder(clock=clock)
-    prov = Provisioner(store, provider, cluster, recorder, clock, Options())
-    return clock, store, provider, cluster, informer, prov
+    from helpers import make_provisioner_harness
+
+    return make_provisioner_harness()
 
 
 def run_batch(clock, informer, prov, pods):

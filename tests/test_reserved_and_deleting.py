@@ -121,22 +121,9 @@ class TestDeletingNodeRescheduling:
     def test_active_pods_rescheduled_through_provisioner(self):
         """The full path: a bound pod on a deleting node joins the batch and
         the provisioner computes replacement capacity for it."""
-        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
-        from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
-        from karpenter_tpu.events.recorder import Recorder
-        from karpenter_tpu.operator.options import Options
-        from karpenter_tpu.runtime.store import Store
-        from karpenter_tpu.state.cluster import Cluster
-        from karpenter_tpu.state.informer import StateInformer
-        from karpenter_tpu.utils.clock import FakeClock
+        from helpers import make_provisioner_harness
 
-        clock = FakeClock()
-        store = Store(clock=clock)
-        provider = FakeCloudProvider()
-        cluster = Cluster(clock, store, provider)
-        informer = StateInformer(store, cluster)
-        recorder = Recorder(clock=clock)
-        prov = Provisioner(store, provider, cluster, recorder, clock, Options())
+        clock, store, provider, cluster, informer, prov = make_provisioner_harness()
         store.create(nodepool("default"))
         node, claim = node_claim_pair("dying-1")
         node.metadata.deletion_timestamp = 1.0

@@ -1,0 +1,105 @@
+"""Volume-limit scheduling specs (reference suite_test.go:2776-2919):
+CSI attach limits on existing nodes force overflow onto new capacity;
+pods sharing one PVC count it once; strict reserved offering mode."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    CSINode,
+    CSINodeDriver,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    StorageClass,
+    Volume,
+)
+from karpenter_tpu.scheduler.nodeclaim import (
+    RESERVED_OFFERING_MODE_STRICT,
+    ReservedOfferingError,
+)
+
+from helpers import node_claim_pair, nodepool, unschedulable_pod
+from test_reserved_and_deleting import reserved_catalog
+from test_scheduler import Env
+
+DRIVER = "ebs.csi.example.com"
+
+
+def volume_env(attach_limit: int, **env_kwargs):
+    # CSINode must exist before the Node event is ingested: limits are read
+    # when cluster state (re)builds the node (cluster.py CSINode lookup)
+    env = Env(**env_kwargs)
+    env.store.create(StorageClass(metadata=ObjectMeta(name="fast"), provisioner=DRIVER))
+    env.store.create(
+        CSINode(
+            metadata=ObjectMeta(name="vol-node-1"),
+            drivers=[CSINodeDriver(name=DRIVER, allocatable_count=attach_limit)],
+        )
+    )
+    node, claim = node_claim_pair("vol-node-1")
+    env.store.create(node)
+    env.store.create(claim)
+    env.informer.flush()
+    return env
+
+
+def pvc_pod(env, pvc_name):
+    env.store.try_get("PersistentVolumeClaim", pvc_name) or env.store.create(
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name=pvc_name), storage_class_name="fast"
+        )
+    )
+    return unschedulable_pod(
+        requests={"cpu": "100m"},
+        volumes=[Volume(name="data", persistent_volume_claim=pvc_name)],
+    )
+
+
+class TestVolumeLimits:
+    def test_attach_limit_forces_overflow_to_new_node(self):
+        # limit 1: first PVC pod lands on the existing node, second overflows
+        env = volume_env(attach_limit=1)
+        pods = [pvc_pod(env, "pvc-a"), pvc_pod(env, "pvc-b")]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert sum(len(en.pods) for en in results.existing_nodes) == 1
+        assert len(results.new_node_claims) == 1
+
+    def test_same_pvc_counted_once(self):
+        # limit 1, both pods share one PVC → both fit the existing node
+        env = volume_env(attach_limit=1)
+        pods = [pvc_pod(env, "pvc-shared"), pvc_pod(env, "pvc-shared")]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert sum(len(en.pods) for en in results.existing_nodes) == 2
+        assert not results.new_node_claims
+
+    def test_unlimited_driver_unconstrained(self):
+        env = volume_env(attach_limit=None)
+        pods = [pvc_pod(env, f"pvc-{i}") for i in range(4)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert not results.new_node_claims
+
+
+class TestStrictReservedMode:
+    def test_strict_mode_errors_instead_of_falling_back(self):
+        """suite_test.go:3976 — with compatible reserved offerings that can't
+        be reserved, strict mode surfaces ReservedOfferingError instead of
+        silently falling back to on-demand."""
+        env = Env(
+            catalog=reserved_catalog(reservation_capacity=0),
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert not results.new_node_claims
+        [err] = list(results.pod_errors.values())
+        assert isinstance(err, ReservedOfferingError)
+
+    def test_strict_mode_reserves_when_capacity_available(self):
+        env = Env(
+            catalog=reserved_catalog(reservation_capacity=1),
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.reserved_offerings
