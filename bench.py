@@ -32,7 +32,7 @@ import numpy as np
 NUM_PODS = 50_000
 CATALOG_REPEAT = 7  # 144 * 7 = 1008 instance types
 TARGET_MS = 200.0
-RUNS = 7
+RUNS = 9
 
 
 def build_catalog():
