@@ -7,6 +7,7 @@ from __future__ import annotations
 import math
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
+from functools import cached_property
 from typing import TYPE_CHECKING, Optional, Sequence
 
 from karpenter_tpu.apis import labels as wk
@@ -58,15 +59,17 @@ class Offering:
     available: bool = True
     reservation_capacity: int = 0
 
-    @property
+    # cached: requirements are immutable and these run in per-pod loops
+    # (dataclass repr/eq use declared fields only, so the cache is inert)
+    @cached_property
     def capacity_type(self) -> str:
         return self.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY).any()
 
-    @property
+    @cached_property
     def zone(self) -> str:
         return self.requirements.get(wk.LABEL_TOPOLOGY_ZONE).any()
 
-    @property
+    @cached_property
     def reservation_id(self) -> str:
         return self.requirements.get(RESERVATION_ID_LABEL).any()
 
@@ -138,6 +141,14 @@ class InstanceType:
         if self._allocatable is None:
             self._allocatable = res.subtract(self.capacity, self.overhead.total())
         return self._allocatable
+
+    @cached_property
+    def has_reserved_offerings(self) -> bool:
+        """Whether ANY offering is reserved-capacity — lets per-pod loops
+        skip the offering scan for the (typical) all-unreserved catalog."""
+        return any(
+            o.capacity_type == wk.CAPACITY_TYPE_RESERVED for o in self.offerings
+        )
 
     def __repr__(self) -> str:
         return f"InstanceType({self.name})"

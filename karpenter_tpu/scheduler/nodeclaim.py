@@ -161,7 +161,7 @@ def filter_instance_types(
 def _triples_host(instance_types, requirements, total_requests):
     out = []
     for it in instance_types:
-        it_compat = it.requirements.intersects(requirements) is None
+        it_compat = it.requirements.intersects_ok(requirements)
         it_fits = res.fits(total_requests, it.allocatable())
         it_offering = any(
             o.available
@@ -361,6 +361,9 @@ class NodeClaim:
         has_compatible = False
         reserved: list[Offering] = []
         for it in instance_types:
+            # most catalogs carry no reserved offerings at all
+            if not it.has_reserved_offerings:
+                continue
             for o in it.offerings:
                 if o.capacity_type != wk.CAPACITY_TYPE_RESERVED or not o.available:
                     continue

@@ -333,11 +333,22 @@ class Requirements:
     def is_compatible(
         self, incoming: "Requirements", allow_undefined: frozenset[str] = frozenset()
     ) -> bool:
-        return self.compatible(incoming, allow_undefined) is None
+        """Boolean twin of compatible(): same gates, no error formatting —
+        this runs in per-(pod, offering) loops."""
+        for key in incoming._map:
+            if key in allow_undefined:
+                continue
+            op = incoming.get(key).operator
+            if key in self._map or op in (Operator.NOT_IN, Operator.DOES_NOT_EXIST):
+                continue
+            return False
+        return self.intersects_ok(incoming)
 
-    def intersects(self, incoming: "Requirements") -> Optional[str]:
-        """None if all shared keys have overlapping values (requirements.go:248-268)."""
-        errs = []
+    def _conflicting_pairs(self, incoming: "Requirements"):
+        """Shared core of intersects()/intersects_ok(): yields each
+        (key, incoming row, existing row) whose value sets don't intersect,
+        honoring the NotIn/DoesNotExist double-negative carve-out
+        (requirements.go:248-268)."""
         small, large = self._map, incoming._map
         if len(small) > len(large):
             small, large = large, small
@@ -351,8 +362,20 @@ class Requirements:
                     existing.operator in (Operator.NOT_IN, Operator.DOES_NOT_EXIST)
                 ):
                     continue
-                errs.append(f"key {key}, {inc!r} not in {existing!r}")
+                yield key, inc, existing
+
+    def intersects(self, incoming: "Requirements") -> Optional[str]:
+        """None if all shared keys have overlapping values, else an error
+        string naming every conflict."""
+        errs = [
+            f"key {key}, {inc!r} not in {existing!r}"
+            for key, inc, existing in self._conflicting_pairs(incoming)
+        ]
         return "; ".join(errs) if errs else None
+
+    def intersects_ok(self, incoming: "Requirements") -> bool:
+        """Early-exit boolean twin of intersects()."""
+        return next(iter(self._conflicting_pairs(incoming)), None) is None
 
     def labels(self) -> dict[str, str]:
         """Concretize to node labels, skipping restricted keys (requirements.go:270-280)."""

@@ -37,8 +37,11 @@ class TestRestartResume:
         assert claims, "claims should exist before the 'crash'"
         assert not all(c.condition_is_true("Initialized") for c in claims)
 
-        # "restart": new operator + provider instances, same store; the kwok
-        # provider also rebuilds its instance view from the store
+        # "restart": new operator + provider instances, same store. The fresh
+        # kwok provider starts with no instance records, so its Get/List
+        # raise NodeClaimNotFound for the old provider ids — the GC
+        # controller reaps the orphaned claims and provisioning replaces the
+        # capacity (the same recovery a real provider-side wipe gets).
         provider2 = KwokCloudProvider(store, clock)
         op2 = Operator(store, provider2, clock=clock, options=None)
         settle(clock, op2)
