@@ -1,0 +1,223 @@
+"""Pod affinity / anti-affinity oracle: specs ported from the reference's
+topology suite (topology_test.go:1939-2930 — names kept, lines cited).
+Host-loop territory: pod (anti-)affinity shapes decline the device path."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    WeightedPodAffinityTerm,
+)
+
+from helpers import bind_pod, nodepool, registered_node, unschedulable_pod
+from test_scheduler import Env
+
+WEB = {"app": "web"}
+DB = {"app": "db"}
+
+
+def term(key=wk.LABEL_TOPOLOGY_ZONE, match=None):
+    return PodAffinityTerm(
+        topology_key=key,
+        label_selector=LabelSelector(match_labels=dict(WEB if match is None else match)),
+    )
+
+
+def pod_with(labels=None, affinity=None, anti=None, preferred_anti=None,
+             preferred=None, requests=None, **kwargs):
+    aff = None
+    if affinity or anti or preferred or preferred_anti:
+        aff = Affinity(
+            pod_affinity=PodAffinity(
+                required=list(affinity or ()),
+                preferred=list(preferred or ()),
+            )
+            if (affinity or preferred)
+            else None,
+            pod_anti_affinity=PodAntiAffinity(
+                required=list(anti or ()),
+                preferred=list(preferred_anti or ()),
+            )
+            if (anti or preferred_anti)
+            else None,
+        )
+    return unschedulable_pod(
+        labels=dict(WEB if labels is None else labels),
+        affinity=aff,
+        requests=requests or {"cpu": "100m"},
+        **kwargs,
+    )
+
+
+def claim_zones(results):
+    zones = set()
+    for nc in results.new_node_claims:
+        zones.update(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list())
+    return zones
+
+
+class TestPodAffinity:
+    def test_empty_pod_affinity_and_anti_affinity(self):
+        # topology_test.go:1939
+        env = Env()
+        pod = pod_with(labels={})
+        pod.spec.affinity = Affinity(
+            pod_affinity=PodAffinity(), pod_anti_affinity=PodAntiAffinity()
+        )
+        results = env.schedule([pod])
+        assert not results.pod_errors
+
+    def test_respect_pod_affinity_hostname(self):
+        # topology_test.go:1949 — affine pods share one hostname
+        env = Env()
+        pods = [pod_with(affinity=[term(key=wk.LABEL_HOSTNAME)]) for _ in range(4)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+
+    def test_self_affinity_zone(self):
+        # topology_test.go:2136 — all pods land in one zone
+        env = Env()
+        results = env.schedule([pod_with(affinity=[term()]) for _ in range(6)])
+        assert not results.pod_errors
+        assert len(claim_zones(results)) == 1
+
+    def test_self_affinity_zone_with_constraint(self):
+        # topology_test.go:2160 — every pod provides its own zonal affinity
+        # AND a zone-3 limit: one node in zone-3
+        env = Env()
+        pods = [
+            pod_with(
+                affinity=[term()],
+                node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-3"},
+            )
+            for _ in range(3)
+        ]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+        assert claim_zones(results) == {"kwok-zone-3"}
+
+    def test_affinity_to_nonexistent_pod_fails(self):
+        # topology_test.go:2723 — nothing to be affine to
+        env = Env()
+        results = env.schedule([pod_with(labels=DB, affinity=[term(match=WEB)])])
+        assert len(results.pod_errors) == 1
+
+    def test_affinity_with_zone_topology_unconstrained_target(self):
+        # topology_test.go:2740 — the target's zone is undetermined within
+        # the batch, so the affine pods CANNOT schedule this round; only the
+        # target lands (they follow once it's bound, next round)
+        env = Env()
+        target = pod_with(labels=WEB)
+        followers = [pod_with(labels=DB, affinity=[term(match=WEB)]) for _ in range(3)]
+        results = env.schedule([target] + followers)
+        assert set(results.pod_errors) == set(followers)
+        assert sum(len(nc.pods) for nc in results.new_node_claims) == 1
+
+    def test_affinity_with_zone_topology_constrained_target(self):
+        # topology_test.go:2773
+        env = Env()
+        target = pod_with(
+            labels=WEB, node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"}
+        )
+        followers = [pod_with(labels=DB, affinity=[term(match=WEB)]) for _ in range(3)]
+        results = env.schedule([target] + followers)
+        assert not results.pod_errors
+        assert claim_zones(results) == {"kwok-zone-2"}
+
+    def test_multiple_dependent_affinities(self):
+        # topology_test.go:2802 — db -> web -> cache -> ui hostname chain
+        # converges regardless of processing order (the solver requeues)
+        env = Env()
+        chain = [
+            pod_with(labels={"app": "a"}),
+            pod_with(
+                labels={"app": "b"},
+                affinity=[term(key=wk.LABEL_HOSTNAME, match={"app": "a"})],
+            ),
+            pod_with(
+                labels={"app": "c"},
+                affinity=[term(key=wk.LABEL_HOSTNAME, match={"app": "b"})],
+            ),
+            pod_with(
+                labels={"app": "d"},
+                affinity=[term(key=wk.LABEL_HOSTNAME, match={"app": "c"})],
+            ),
+        ]
+        results = env.schedule(chain)
+        assert not results.pod_errors
+
+    def test_unsatisfiable_dependencies_fail(self):
+        # topology_test.go:2837 — mutually exclusive zones break the chain
+        env = Env()
+        a = pod_with(
+            labels={"app": "a"},
+            node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"},
+        )
+        b = pod_with(
+            labels={"app": "b"},
+            affinity=[term(match={"app": "a"})],
+            node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"},
+        )
+        results = env.schedule([a, b])
+        assert len(results.pod_errors) == 1
+
+    def test_allow_violation_of_preferred_pod_affinity(self):
+        # topology_test.go:2244 — preference to a pod that doesn't exist
+        env = Env()
+        preferred = WeightedPodAffinityTerm(
+            weight=50, pod_affinity_term=term(match={"app": "ghost"})
+        )
+        results = env.schedule([pod_with(preferred=[preferred])])
+        assert not results.pod_errors
+
+
+class TestPodAntiAffinity:
+    def test_separate_nodes_simple_anti_affinity_hostname(self):
+        # topology_test.go:2310
+        env = Env()
+        pods = [pod_with(anti=[term(key=wk.LABEL_HOSTNAME)]) for _ in range(4)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 4
+
+    def test_not_violate_anti_affinity_zone(self):
+        # topology_test.go:2332 — big zone-pinned web pods occupy every zone
+        # first (FFD sorts them ahead); the anti-affine pod has nowhere left
+        env = Env()
+        zone_pods = [
+            pod_with(
+                requests={"cpu": "2"},
+                node_selector={wk.LABEL_TOPOLOGY_ZONE: f"kwok-zone-{i}"},
+            )
+            for i in (1, 2, 3, 4)
+        ]
+        anti = pod_with(labels=DB, anti=[term(match=WEB)])
+        results = env.schedule(zone_pods + [anti])
+        assert set(results.pod_errors) == {anti}
+
+    def test_inverse_anti_affinity_blocks_targets(self):
+        # topology_test.go:2476 — an anti-affine pod already in a zone
+        # repels matching pods from that zone
+        node = registered_node(zone="kwok-zone-1", pool="default")
+        repeller = bind_pod(
+            pod_with(labels=DB, anti=[term(match=WEB)]), node
+        )
+        env = Env(state_nodes=[node], pods=[repeller])
+        results = env.schedule([pod_with(labels=WEB) for _ in range(3)])
+        assert not results.pod_errors
+        assert "kwok-zone-1" not in claim_zones(results)
+
+    def test_allow_violation_of_preferred_anti_affinity(self):
+        # topology_test.go:2277
+        env = Env()
+        preferred = WeightedPodAffinityTerm(
+            weight=50, pod_affinity_term=term(match=WEB)
+        )
+        pods = [pod_with(preferred_anti=[preferred]) for _ in range(6)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
