@@ -1,0 +1,168 @@
+"""Taint/toleration scheduling specs (topology_test.go:2996-3060) and
+ReservationManager unit specs (reservationmanager_test.go:112-210), both
+run against the host and device paths where eligible."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Taint, Toleration
+from karpenter_tpu.scheduler.reservationmanager import ReservationManager
+
+from helpers import nodepool, unschedulable_pod
+from test_reserved_and_deleting import reserved_catalog
+from test_scheduling_oracle import path, schedule  # noqa: F401 — fixture
+
+
+def tainted_pool(taints=(), startup_taints=()):
+    pool = nodepool("default", taints=taints)
+    pool.spec.template.spec.startup_taints = list(startup_taints)
+    return pool
+
+
+class TestTaints:
+    """topology_test.go:2996-3060."""
+
+    def test_taint_nodes_with_nodepool_taints(self, path):
+        taint = Taint(key="test", value="bar", effect="NoSchedule")
+        pod = unschedulable_pod(
+            tolerations=[Toleration(operator="Exists", effect="NoSchedule")]
+        )
+        results = schedule(path, [pod], node_pools=[tainted_pool(taints=[taint])])
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert any(
+            t.key == "test" and t.value == "bar" for t in nc.template.spec.taints
+        )
+
+    def test_schedule_pods_that_tolerate_nodepool_constraints(self, path):
+        taint = Taint(key="test-key", value="test-value", effect="NoSchedule")
+        pools = [tainted_pool(taints=[taint])]
+        tolerating = [
+            unschedulable_pod(
+                tolerations=[
+                    Toleration(key="test-key", operator="Exists", effect="NoSchedule")
+                ]
+            ),
+            unschedulable_pod(
+                tolerations=[
+                    Toleration(
+                        key="test-key",
+                        value="test-value",
+                        operator="Equal",
+                        effect="NoSchedule",
+                    )
+                ]
+            ),
+        ]
+        results = schedule(path, tolerating, node_pools=pools)
+        assert not results.pod_errors
+
+        not_tolerating = [
+            unschedulable_pod(),  # missing toleration
+            unschedulable_pod(
+                tolerations=[Toleration(key="invalid", operator="Exists")]
+            ),  # key mismatch
+            unschedulable_pod(
+                tolerations=[
+                    Toleration(key="test-key", operator="Equal", effect="NoSchedule")
+                ]
+            ),  # value mismatch
+        ]
+        results = schedule(path, not_tolerating, node_pools=pools)
+        assert len(results.pod_errors) == 3
+
+    def test_startup_taints_do_not_block_scheduling(self, path):
+        startup = Taint(key="ignore-me", value="nothing-to-see-here", effect="NoSchedule")
+        results = schedule(
+            path,
+            [unschedulable_pod()],
+            node_pools=[tainted_pool(startup_taints=[startup])],
+        )
+        assert not results.pod_errors
+
+
+class TestReservationManager:
+    """reservationmanager_test.go:112-210."""
+
+    def _manager(self, capacity=2):
+        return ReservationManager(
+            {"default": reserved_catalog(reservation_capacity=capacity)}
+        )
+
+    def _offering(self, capacity=2):
+        return reserved_catalog(reservation_capacity=capacity)[0].offerings[1]
+
+    def test_can_reserve_when_capacity_available(self):
+        manager = self._manager(capacity=1)
+        assert manager.can_reserve("host-a", self._offering())
+
+    def test_can_reserve_when_hostname_holds_reservation(self):
+        manager = self._manager(capacity=1)
+        offering = self._offering()
+        manager.reserve("host-a", offering)
+        assert manager.can_reserve("host-a", offering)
+
+    def test_cannot_reserve_when_exhausted(self):
+        manager = self._manager(capacity=1)
+        offering = self._offering()
+        manager.reserve("host-a", offering)
+        assert not manager.can_reserve("host-b", offering)
+
+    def test_existing_hostname_ok_even_when_exhausted(self):
+        manager = self._manager(capacity=1)
+        offering = self._offering()
+        manager.reserve("host-a", offering)
+        # host-a already holds it: idempotently reservable
+        assert manager.can_reserve("host-a", offering)
+
+    def test_unknown_reservation_id_raises(self):
+        manager = self._manager()
+        from karpenter_tpu.cloudprovider.types import (
+            Offering,
+            RESERVATION_ID_LABEL,
+        )
+        from karpenter_tpu.scheduling.requirements import (
+            Operator,
+            Requirement,
+            Requirements,
+        )
+
+        ghost = Offering(
+            requirements=Requirements(
+                Requirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [wk.CAPACITY_TYPE_RESERVED],
+                ),
+                Requirement(RESERVATION_ID_LABEL, Operator.IN, ["cr-ghost"]),
+            ),
+            price=0.1,
+        )
+        with pytest.raises(KeyError):
+            manager.can_reserve("host-a", ghost)
+
+    def test_reserve_decrements_capacity(self):
+        manager = self._manager(capacity=2)
+        offering = self._offering()
+        manager.reserve("host-a", offering)
+        assert manager.remaining_capacity(offering) == 1
+        manager.reserve("host-b", offering)
+        assert manager.remaining_capacity(offering) == 0
+
+    def test_no_double_reserve_same_hostname(self):
+        manager = self._manager(capacity=2)
+        offering = self._offering()
+        manager.reserve("host-a", offering)
+        manager.reserve("host-a", offering)
+        assert manager.remaining_capacity(offering) == 1
+
+    def test_release_restores_capacity(self):
+        manager = self._manager(capacity=1)
+        offering = self._offering()
+        manager.reserve("host-a", offering)
+        assert manager.remaining_capacity(offering) == 0
+        manager.release("host-a", offering)
+        assert manager.remaining_capacity(offering) == 1
+        # releasing a hostname without the reservation is a no-op
+        manager.release("host-b", offering)
+        assert manager.remaining_capacity(offering) == 1
