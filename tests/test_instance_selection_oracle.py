@@ -1,0 +1,218 @@
+"""Instance-selection specs (instance_selection_test.go:87-431): the launch
+picks one of the cheapest instances compatible with pod + nodepool
+constraints — asserted end-to-end through the kwok provider, which owns
+launch-time price ordering. Plus namespace-filtered affinity
+(topology_test.go:2853-2930) and device-path timeout surfacing."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    PodAffinity,
+    PodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import bind_pod, nodepool, registered_node, unschedulable_pod
+from test_scheduler import Env
+
+CATALOG = construct_instance_types()
+
+
+def cheapest_price(predicate):
+    prices = [
+        offering.price
+        for it in CATALOG
+        if predicate(it)
+        for offering in it.offerings
+        if offering.available
+    ]
+    return min(prices)
+
+
+def launch_and_get_node(pod=None, pool=None):
+    clock = FakeClock()
+    store = Store(clock=clock)
+    op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+    store.create(pool or nodepool("workers"))
+    store.create(pod or unschedulable_pod(requests={"cpu": "100m"}))
+    for _ in range(12):
+        clock.step(2.0)
+        op.run_once()
+    [node] = store.list("Node")
+    return node
+
+
+def node_price(node):
+    name = node.metadata.labels[wk.LABEL_INSTANCE_TYPE]
+    it = next(i for i in CATALOG if i.name == name)
+    zone = node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+    ct = node.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY]
+    return next(
+        o.price for o in it.offerings if o.zone == zone and o.capacity_type == ct
+    )
+
+
+class TestCheapestInstanceSelection:
+    """instance_selection_test.go:87-431 — launch lands on a cheapest
+    compatible offering."""
+
+    def test_unconstrained(self):
+        node = launch_and_get_node()
+        assert node_price(node) == cheapest_price(lambda it: True)
+
+    @pytest.mark.parametrize("arch", ["amd64", "arm64"])
+    def test_pod_arch(self, arch):
+        node = launch_and_get_node(
+            pod=unschedulable_pod(
+                requests={"cpu": "100m"}, node_selector={wk.LABEL_ARCH: arch}
+            )
+        )
+        assert node.metadata.labels[wk.LABEL_ARCH] == arch
+        assert node_price(node) == cheapest_price(
+            lambda it: it.requirements.get(wk.LABEL_ARCH).has(arch)
+        )
+
+    def test_pod_os_windows(self):
+        node = launch_and_get_node(
+            pod=unschedulable_pod(
+                requests={"cpu": "100m"}, node_selector={wk.LABEL_OS: "windows"}
+            )
+        )
+        assert node_price(node) == cheapest_price(
+            lambda it: it.requirements.get(wk.LABEL_OS).has("windows")
+        )
+
+    def test_nodepool_capacity_type_on_demand(self):
+        pool = nodepool(
+            "workers",
+            requirements=[
+                {
+                    "key": wk.CAPACITY_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": [wk.CAPACITY_TYPE_ON_DEMAND],
+                }
+            ],
+        )
+        node = launch_and_get_node(pool=pool)
+        assert (
+            node.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY]
+            == wk.CAPACITY_TYPE_ON_DEMAND
+        )
+        # cheapest ON-DEMAND offering (spot is cheaper but filtered out)
+        prices = [
+            o.price
+            for it in CATALOG
+            for o in it.offerings
+            if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+        ]
+        assert node_price(node) == min(prices)
+
+    def test_pod_zone_and_capacity_type(self):
+        pod = unschedulable_pod(
+            requests={"cpu": "100m"},
+            node_selector={
+                wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2",
+                wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT,
+            },
+        )
+        node = launch_and_get_node(pod=pod)
+        assert node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] == "kwok-zone-2"
+        prices = [
+            o.price
+            for it in CATALOG
+            for o in it.offerings
+            if o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.zone == "kwok-zone-2"
+        ]
+        assert node_price(node) == min(prices)
+
+
+class TestNamespaceFilteredAffinity:
+    """topology_test.go:2853-2930 — affinity terms only see pods in the
+    term's namespaces (the pod's own namespace by default)."""
+
+    def _target_on_node(self, namespace):
+        node = registered_node(zone="kwok-zone-1", pool="default")
+        target = unschedulable_pod(labels={"app": "web"})
+        target.metadata.namespace = namespace
+        bind_pod(target, node)
+        return node, target
+
+    def _affine_pod(self):
+        return unschedulable_pod(
+            labels={"app": "db"},
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    required=[
+                        PodAffinityTerm(
+                            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "web"}),
+                        )
+                    ]
+                )
+            ),
+        )
+
+    def test_no_matching_pods_in_namespace(self):
+        # the target lives in another namespace: affinity finds nothing
+        node, target = self._target_on_node("other-namespace")
+        env = Env(state_nodes=[node], pods=[target])
+        results = env.schedule([self._affine_pod()])
+        assert len(results.pod_errors) == 1
+
+    def test_matching_pods_via_namespace_list(self):
+        node, target = self._target_on_node("other-namespace")
+        env = Env(state_nodes=[node], pods=[target])
+        pod = self._affine_pod()
+        pod.spec.affinity.pod_affinity.required[0].namespaces = ["other-namespace"]
+        results = env.schedule([pod])
+        assert not results.pod_errors
+        # the pod must land in the target's zone — on the existing zone-1
+        # node or a new zone-1 claim
+        placed_zones = set()
+        for en in results.existing_nodes:
+            if en.pods:
+                placed_zones.add(en.labels().get(wk.LABEL_TOPOLOGY_ZONE))
+        for nc in results.new_node_claims:
+            placed_zones.update(
+                nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list()
+            )
+        assert placed_zones == {"kwok-zone-1"}
+
+
+class TestDeviceTimeout:
+    def test_device_path_surfaces_timeout(self, monkeypatch):
+        """A zero budget times the native solve out; unprocessed pods carry
+        TimeoutError and the Results flag is set (scheduler.go ctx.Err)."""
+        from karpenter_tpu.ops import ffd
+        from karpenter_tpu.ops.catalog import CatalogEngine
+
+        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+        env = Env(engine=CatalogEngine(CATALOG))
+        pods = [unschedulable_pod(requests={"cpu": "100m"}) for _ in range(2000)]
+        state_nodes = env.cluster.state_nodes()
+        from karpenter_tpu.scheduler.scheduler import Scheduler
+        from karpenter_tpu.scheduler.topology import Topology
+
+        topology = Topology(
+            env.store, env.cluster, state_nodes, env.node_pools,
+            env.instance_types, pods,
+        )
+        scheduler = Scheduler(
+            env.store, env.node_pools, env.cluster, state_nodes, topology,
+            env.instance_types, [], env.recorder, env.clock,
+            engine=CatalogEngine(CATALOG),
+        )
+        results = scheduler.solve(pods, timeout=0.0)
+        assert results.timed_out
+        assert results.pod_errors
+        assert any(
+            isinstance(e, TimeoutError) for e in results.pod_errors.values()
+        )
