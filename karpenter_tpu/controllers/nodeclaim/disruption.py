@@ -20,6 +20,8 @@ from karpenter_tpu.apis.nodepool import NODEPOOL_HASH_VERSION, NodePool
 from karpenter_tpu.cloudprovider.types import CloudProvider, Offerings
 from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.scheduling.requirements import (
+    Operator,
+    Requirement,
     Requirements,
     requirements_from_dicts,
 )
@@ -75,12 +77,37 @@ class DisruptionController:
         return self.cloud_provider.is_drifted(claim)
 
     def _instance_type_not_found(self, pool: NodePool, claim: NodeClaim) -> str:
+        """Offerings are compared WITHOUT an availability filter — temporary
+        unavailability is not drift (drift.go:112-144)."""
         its = self.cloud_provider.get_instance_types(pool)
         name = claim.metadata.labels.get(wk.LABEL_INSTANCE_TYPE, "")
         it = next((i for i in its if i.name == name), None)
         if it is None:
             return INSTANCE_TYPE_NOT_FOUND
         reqs = Requirements.from_labels(claim.metadata.labels)
+        # a reserved claim can be demoted to on-demand after creation; accept
+        # either so a stale capacity-type label doesn't drift the claim
+        # (drift.go:131-139) — requirement drift (checked first in
+        # is_drifted) catches real nodepool mismatches
+        if (
+            claim.metadata.labels.get(wk.CAPACITY_TYPE_LABEL_KEY)
+            == wk.CAPACITY_TYPE_RESERVED
+        ):
+            reqs = Requirements(
+                *(
+                    r
+                    for r in reqs.values()
+                    if r.key
+                    not in (wk.CAPACITY_TYPE_LABEL_KEY, wk.RESERVATION_ID_LABEL_KEY)
+                )
+            )
+            reqs.add(
+                Requirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_ON_DEMAND],
+                )
+            )
         if not Offerings(it.offerings).has_compatible(reqs):
             return INSTANCE_TYPE_NOT_FOUND
         return ""

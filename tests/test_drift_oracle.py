@@ -1,0 +1,166 @@
+"""Drift-detection oracle: specs ported from the reference's drift suite
+(pkg/controllers/nodeclaim/disruption/drift_test.go:85-199 — names kept)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Taint
+from karpenter_tpu.apis.nodeclaim import CONDITION_DRIFTED, CONDITION_LAUNCHED
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.nodeclaim.disruption import DisruptionController
+from karpenter_tpu.controllers.nodepool_controllers import HashController
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import node_claim_pair, nodepool
+
+
+@pytest.fixture
+def env():
+    import copy
+
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = FakeCloudProvider()
+    # the kwok catalog is memoized process-wide; these specs MUTATE instance
+    # types (clearing offerings, flipping availability), so they get copies
+    provider.instance_types = copy.deepcopy(provider.instance_types)
+    return clock, store, provider, Recorder(clock=clock)
+
+
+def launched_claim(store, pool, name="dc-1", instance_type="s-4x-amd64-linux"):
+    node, claim = node_claim_pair(name, instance_type=instance_type)
+    claim.set_condition(CONDITION_LAUNCHED, "True")
+    claim.metadata.annotations.update(pool.metadata.annotations)
+    return store.create(claim)
+
+
+class TestStaleInstanceTypeDrift:
+    """drift_test.go:85-131."""
+
+    def test_drift_if_instance_type_label_missing(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        del claim.metadata.labels[wk.LABEL_INSTANCE_TYPE]
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_drift_if_instance_type_gone(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        provider.instance_types = [
+            it for it in provider.instance_types if it.name != "s-4x-amd64-linux"
+        ]
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_drift_if_offerings_gone(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        it = next(i for i in provider.instance_types if i.name == "s-4x-amd64-linux")
+        it.offerings.clear()
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_unavailable_offerings_are_not_drift(self, env):
+        # drift.go:112-114 — temporary unavailability must NOT drift
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        it = next(i for i in provider.instance_types if i.name == "s-4x-amd64-linux")
+        for offering in it.offerings:
+            offering.available = False
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_DRIFTED)
+
+    def test_reserved_claim_demoted_to_on_demand_not_drifted(self, env):
+        # drift.go:131-139 — a reserved claim whose label hasn't been updated
+        # after demotion matches on-demand offerings too
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        claim.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] = wk.CAPACITY_TYPE_RESERVED
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_DRIFTED)
+
+    def test_drift_if_offerings_incompatible(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        # claim launched in a zone its type no longer offers
+        claim = launched_claim(store, pool)
+        claim.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] = "kwok-zone-9"
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "InstanceTypeNotFound"
+
+
+class TestDriftPrecedence:
+    """drift_test.go:133-166 — static and requirement drift outrank the
+    cloud provider's own drift verdict."""
+
+    def test_static_drift_before_cloud_provider_drift(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        HashController(store).reconcile(pool)
+        claim = launched_claim(store, pool)
+        provider.drifted = "CloudDriftReason"
+        pool.spec.template.spec.taints = [Taint(key="new", value="x")]
+        HashController(store).reconcile(pool)
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "NodePoolDrifted"
+
+    def test_requirement_drift_before_cloud_provider_drift(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(
+            nodepool(
+                "default",
+                requirements=[
+                    {"key": wk.LABEL_ARCH, "operator": "In", "values": ["arm64"]}
+                ],
+            )
+        )
+        claim = launched_claim(store, pool)  # labels arch=amd64
+        provider.drifted = "CloudDriftReason"
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert claim.get_condition(CONDITION_DRIFTED).reason == "RequirementsDrifted"
+
+
+class TestDriftConditionLifecycle:
+    """drift_test.go:167-199."""
+
+    def test_condition_removed_when_not_launched(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        provider.drifted = "CloudDriftReason"
+        ctrl = DisruptionController(store, provider, clock)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_DRIFTED)
+        claim.set_condition(CONDITION_LAUNCHED, "False")
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_DRIFTED)
+
+    def test_no_drift_if_nodepool_missing(self, env):
+        clock, store, provider, recorder = env
+        pool = nodepool("default")  # never stored
+        _, claim = node_claim_pair("dc-9")
+        claim.set_condition(CONDITION_LAUNCHED, "True")
+        store.create(claim)
+        provider.drifted = "CloudDriftReason"
+        DisruptionController(store, provider, clock).reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_DRIFTED)
+
+    def test_condition_removed_when_no_longer_drifted(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = launched_claim(store, pool)
+        provider.drifted = "CloudDriftReason"
+        ctrl = DisruptionController(store, provider, clock)
+        ctrl.reconcile(claim)
+        assert claim.condition_is_true(CONDITION_DRIFTED)
+        provider.drifted = ""
+        ctrl.reconcile(claim)
+        assert not claim.condition_is_true(CONDITION_DRIFTED)

@@ -26,13 +26,13 @@ from test_scheduler import Env
 CATALOG = construct_instance_types()
 
 
-def cheapest_price(predicate):
+def cheapest_price(predicate, offering_predicate=lambda o: True):
     prices = [
         offering.price
         for it in CATALOG
         if predicate(it)
         for offering in it.offerings
-        if offering.available
+        if offering.available and offering_predicate(offering)
     ]
     return min(prices)
 
@@ -107,13 +107,10 @@ class TestCheapestInstanceSelection:
             == wk.CAPACITY_TYPE_ON_DEMAND
         )
         # cheapest ON-DEMAND offering (spot is cheaper but filtered out)
-        prices = [
-            o.price
-            for it in CATALOG
-            for o in it.offerings
-            if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
-        ]
-        assert node_price(node) == min(prices)
+        assert node_price(node) == cheapest_price(
+            lambda it: True,
+            lambda o: o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND,
+        )
 
     def test_pod_zone_and_capacity_type(self):
         pod = unschedulable_pod(
@@ -125,13 +122,11 @@ class TestCheapestInstanceSelection:
         )
         node = launch_and_get_node(pod=pod)
         assert node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] == "kwok-zone-2"
-        prices = [
-            o.price
-            for it in CATALOG
-            for o in it.offerings
-            if o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.zone == "kwok-zone-2"
-        ]
-        assert node_price(node) == min(prices)
+        assert node_price(node) == cheapest_price(
+            lambda it: True,
+            lambda o: o.capacity_type == wk.CAPACITY_TYPE_SPOT
+            and o.zone == "kwok-zone-2",
+        )
 
 
 class TestNamespaceFilteredAffinity:
@@ -188,29 +183,14 @@ class TestNamespaceFilteredAffinity:
 
 
 class TestDeviceTimeout:
-    def test_device_path_surfaces_timeout(self, monkeypatch):
+    def test_device_path_surfaces_timeout(self):
         """A zero budget times the native solve out; unprocessed pods carry
         TimeoutError and the Results flag is set (scheduler.go ctx.Err)."""
-        from karpenter_tpu.ops import ffd
         from karpenter_tpu.ops.catalog import CatalogEngine
 
-        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
         env = Env(engine=CatalogEngine(CATALOG))
         pods = [unschedulable_pod(requests={"cpu": "100m"}) for _ in range(2000)]
-        state_nodes = env.cluster.state_nodes()
-        from karpenter_tpu.scheduler.scheduler import Scheduler
-        from karpenter_tpu.scheduler.topology import Topology
-
-        topology = Topology(
-            env.store, env.cluster, state_nodes, env.node_pools,
-            env.instance_types, pods,
-        )
-        scheduler = Scheduler(
-            env.store, env.node_pools, env.cluster, state_nodes, topology,
-            env.instance_types, [], env.recorder, env.clock,
-            engine=CatalogEngine(CATALOG),
-        )
-        results = scheduler.solve(pods, timeout=0.0)
+        results = env.schedule(pods, timeout=0.0)
         assert results.timed_out
         assert results.pod_errors
         assert any(

@@ -63,7 +63,7 @@ class Env:
         self.daemonset_pods = list(daemonset_pods)
         self.scheduler_kwargs = scheduler_kwargs
 
-    def schedule(self, pods):
+    def schedule(self, pods, timeout=60.0):
         state_nodes = self.cluster.state_nodes()
         topology = Topology(
             self.store, self.cluster, state_nodes, self.node_pools,
@@ -75,7 +75,7 @@ class Env:
             self.instance_types, self.daemonset_pods, self.recorder, self.clock,
             **self.scheduler_kwargs,
         )
-        return scheduler.solve(pods)
+        return scheduler.solve(pods, timeout=timeout)
 
 
 class TestBasicScheduling:
