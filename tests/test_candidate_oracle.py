@@ -1,0 +1,203 @@
+"""Disruption candidacy and disruption-cost oracle: specs ported from the
+reference's disruption suite (pkg/controllers/disruption/suite_test.go:845-
+1647 — names kept)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.controllers.disruption.types import (
+    EVENTUAL_DISRUPTION_CLASS,
+    GRACEFUL_DISRUPTION_CLASS,
+    eviction_cost,
+    new_candidate,
+)
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.pdb import Limits
+
+from helpers import bind_pod, node_claim_pair, nodepool, unschedulable_pod
+
+
+class Harness:
+    def __init__(self):
+        self.clock = FakeClock()
+        self.store = Store(clock=self.clock)
+        self.provider = FakeCloudProvider()
+        self.cluster = Cluster(self.clock, self.store, self.provider)
+        self.informer = StateInformer(self.store, self.cluster)
+        self.recorder = Recorder(clock=self.clock)
+        self.pool = self.store.create(nodepool("default"))
+
+    def add_node(self, name="cand-1", pods=(), tgp=None, **kwargs):
+        node, claim = node_claim_pair(name, **kwargs)
+        if tgp is not None:
+            claim.spec.termination_grace_period = tgp
+        self.store.create(claim)
+        self.store.create(node)
+        for p in pods:
+            bind_pod(p, node)
+            self.store.create(p)
+        self.informer.flush()
+        return next(
+            n for n in self.cluster.state_nodes() if n.name() == name
+        )
+
+    def candidate(self, state_node, disruption_class=GRACEFUL_DISRUPTION_CLASS):
+        its = {it.name: it for it in self.provider.get_instance_types(self.pool)}
+        return new_candidate(
+            self.store,
+            self.recorder,
+            self.clock,
+            state_node,
+            Limits.from_pdbs(self.store.list("PodDisruptionBudget")),
+            {"default": self.pool},
+            {"default": its},
+            None,
+            disruption_class,
+        )
+
+
+def dnd_pod(**kwargs):
+    pod = unschedulable_pod(**kwargs)
+    pod.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+    return pod
+
+
+class TestDisruptionCost:
+    """suite_test.go:845-916."""
+
+    def test_standard_cost_for_plain_pod(self):
+        assert eviction_cost(unschedulable_pod()) == pytest.approx(1.0)
+
+    def test_higher_cost_for_positive_deletion_cost(self):
+        pod = unschedulable_pod()
+        pod.metadata.annotations["controller.kubernetes.io/pod-deletion-cost"] = "100"
+        assert eviction_cost(pod) > eviction_cost(unschedulable_pod())
+
+    def test_lower_cost_for_negative_deletion_cost(self):
+        pod = unschedulable_pod()
+        pod.metadata.annotations["controller.kubernetes.io/pod-deletion-cost"] = "-100"
+        assert eviction_cost(pod) < eviction_cost(unschedulable_pod())
+
+    def test_monotone_in_deletion_cost(self):
+        costs = []
+        for value in ("-100", "0", "100"):
+            pod = unschedulable_pod()
+            pod.metadata.annotations["controller.kubernetes.io/pod-deletion-cost"] = value
+            costs.append(eviction_cost(pod))
+        assert costs == sorted(costs)
+
+    def test_priority_raises_cost(self):
+        high = unschedulable_pod()
+        high.spec.priority = 100_000
+        low = unschedulable_pod()
+        low.spec.priority = -100_000
+        assert eviction_cost(high) > eviction_cost(unschedulable_pod())
+        assert eviction_cost(low) < eviction_cost(unschedulable_pod())
+
+
+class TestCandidateFiltering:
+    """suite_test.go:917-1647."""
+
+    def test_do_not_disrupt_pod_blocks_graceful(self):
+        h = Harness()
+        sn = h.add_node(pods=[dnd_pod()])
+        with pytest.raises(Exception, match="do-not-disrupt"):
+            h.candidate(sn)
+
+    def test_do_not_disrupt_with_tgp_allows_eventual(self):
+        # suite_test.go:1022 — a terminationGracePeriod permits EVENTUAL
+        # disruption (drift/expiration) despite blocking pods
+        h = Harness()
+        sn = h.add_node(pods=[dnd_pod()], tgp=300.0)
+        candidate = h.candidate(sn, EVENTUAL_DISRUPTION_CLASS)
+        assert candidate is not None
+
+    def test_do_not_disrupt_with_tgp_still_blocks_graceful(self):
+        # suite_test.go:1083
+        h = Harness()
+        sn = h.add_node(pods=[dnd_pod()], tgp=300.0)
+        with pytest.raises(Exception, match="do-not-disrupt"):
+            h.candidate(sn, GRACEFUL_DISRUPTION_CLASS)
+
+    def test_pdb_blocked_pod_blocks_graceful(self):
+        h = Harness()
+        pod = unschedulable_pod(labels={"app": "guarded"})
+        h.store.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb-1"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "guarded"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        sn = h.add_node(pods=[pod])
+        with pytest.raises(Exception, match="pdb"):
+            h.candidate(sn)
+
+    def test_pdb_blocked_with_tgp_allows_eventual(self):
+        # suite_test.go:1051
+        h = Harness()
+        pod = unschedulable_pod(labels={"app": "guarded"})
+        h.store.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb-1"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "guarded"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        sn = h.add_node(pods=[pod], tgp=300.0)
+        assert h.candidate(sn, EVENTUAL_DISRUPTION_CLASS) is not None
+
+    def test_do_not_disrupt_terminal_pods_ignored(self):
+        # suite_test.go:1241 — Succeeded/Failed pods can't block
+        h = Harness()
+        pod = dnd_pod()
+        pod.status.phase = "Succeeded"
+        sn = h.add_node(pods=[pod])
+        assert h.candidate(sn) is not None
+
+    def test_do_not_disrupt_on_node_blocks(self):
+        # suite_test.go:1279 — the annotation on the NODE blocks entirely
+        h = Harness()
+        sn = h.add_node()
+        sn.node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        with pytest.raises(Exception, match="do-not-disrupt|blocked"):
+            h.candidate(sn)
+
+    def test_daemonset_do_not_disrupt_blocks(self):
+        # suite_test.go:983 — daemonset-owned do-not-disrupt pods also block
+        h = Harness()
+        pod = dnd_pod()
+        pod.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name="ds", uid="u1")
+        ]
+        sn = h.add_node(pods=[pod])
+        with pytest.raises(Exception, match="do-not-disrupt"):
+            h.candidate(sn)
+
+    def test_node_only_representation_not_candidate(self):
+        # suite_test.go:1628 — no NodeClaim: unmanaged, not disruptable
+        h = Harness()
+        from helpers import registered_node
+
+        h.store.create(registered_node(name="bare-node"))
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "bare-node")
+        with pytest.raises(Exception):
+            h.candidate(sn)
