@@ -1,0 +1,149 @@
+"""Drain-ordering/TGP termination specs (reference node/termination
+suite_test.go + terminator.go:96-166) and events recorder specs
+(pkg/events/recorder.go:30-117)."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Taint, Toleration
+from karpenter_tpu.controllers.node.termination import EvictionQueue, Terminator
+from karpenter_tpu.events.recorder import DEDUPE_TTL, Event, Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import bind_pod, node_claim_pair, nodepool, unschedulable_pod
+
+
+class Harness:
+    def __init__(self):
+        self.clock = FakeClock()
+        self.store = Store(clock=self.clock)
+        self.recorder = Recorder(clock=self.clock)
+        self.queue = EvictionQueue(self.store, self.recorder, self.clock)
+        self.terminator = Terminator(self.clock, self.store, self.queue, self.recorder)
+
+    def node_with_pods(self, *pods, name="drain-1"):
+        node, claim = node_claim_pair(name)
+        self.store.create(claim)
+        self.store.create(node)
+        for p in pods:
+            bind_pod(p, node)
+            self.store.create(p)
+        return node
+
+
+class TestDrainOrdering:
+    """terminator.go:96-138 — critical pods leave LAST."""
+
+    def test_critical_pods_evicted_after_non_critical(self):
+        h = Harness()
+        app = unschedulable_pod(name="app-pod")
+        critical = unschedulable_pod(name="critical-pod")
+        critical.spec.priority_class_name = "system-cluster-critical"
+        node = h.node_with_pods(app, critical)
+        # first drain pass queues only the non-critical group
+        msg = h.terminator.drain(node, None)
+        assert msg is not None
+        assert h.queue.has(app)
+        assert not h.queue.has(critical)
+        h.queue.reconcile()  # evicts the app pod
+        assert h.store.try_get("Pod", "app-pod") is None
+        # next pass reaches the critical group
+        h.terminator.drain(node, None)
+        assert h.queue.has(critical)
+
+    def test_do_not_disrupt_pod_stalls_drain_without_eviction(self):
+        # scheduling.go:50-85 — do-not-disrupt pods are never evicted but the
+        # drain must still wait for them
+        h = Harness()
+        pod = unschedulable_pod(name="dnd-pod")
+        pod.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        node = h.node_with_pods(pod)
+        msg = h.terminator.drain(node, None)
+        assert msg is not None and "terminate" in msg
+        assert not h.queue.has(pod)
+
+    def test_pods_tolerating_disrupted_taint_not_drained(self):
+        h = Harness()
+        pod = unschedulable_pod(
+            name="tolerant-pod",
+            tolerations=[Toleration(key=wk.DISRUPTED_TAINT_KEY, operator="Exists")],
+        )
+        node = h.node_with_pods(pod)
+        assert h.terminator.drain(node, None) is None  # nothing to wait on
+
+    def test_terminal_pods_do_not_block_drain(self):
+        h = Harness()
+        pod = unschedulable_pod(name="done-pod")
+        pod.status.phase = "Succeeded"
+        node = h.node_with_pods(pod)
+        assert h.terminator.drain(node, None) is None
+
+
+class TestTerminationGracePeriod:
+    """terminator.go:140-166 — pods whose own grace period overruns the node
+    deadline are force-deleted."""
+
+    def test_overrunning_pod_force_deleted(self):
+        h = Harness()
+        slow = unschedulable_pod(name="slow-pod")
+        slow.spec.termination_grace_period_seconds = 600
+        fast = unschedulable_pod(name="fast-pod")
+        fast.spec.termination_grace_period_seconds = 5
+        node = h.node_with_pods(slow, fast)
+        deadline = h.clock.now() + 60.0
+        h.terminator.drain(node, deadline)
+        assert h.store.try_get("Pod", "slow-pod") is None  # forced out
+        assert h.store.try_get("Pod", "fast-pod") is not None
+        assert any(e.reason == "ForcedEviction" for e in h.recorder.events)
+
+    def test_no_deadline_no_forced_eviction(self):
+        h = Harness()
+        slow = unschedulable_pod(name="slow-pod-2")
+        slow.spec.termination_grace_period_seconds = 600
+        node = h.node_with_pods(slow)
+        h.terminator.drain(node, None)
+        assert h.store.try_get("Pod", "slow-pod-2") is not None
+
+
+class TestEventsRecorder:
+    """recorder.go:30-117."""
+
+    def _event(self, message="m1", reason="TestReason"):
+        pool = nodepool("events-pool")
+        return Event(pool, "Normal", reason, message)
+
+    def test_duplicates_deduped_within_ttl(self):
+        recorder = Recorder(clock=FakeClock())
+        recorder.publish(self._event())
+        recorder.publish(self._event())
+        assert len(recorder.events) == 1
+
+    def test_republished_after_ttl(self):
+        clock = FakeClock()
+        recorder = Recorder(clock=clock)
+        recorder.publish(self._event())
+        clock.step(DEDUPE_TTL + 1.0)
+        recorder.publish(self._event())
+        assert len(recorder.events) == 2
+
+    def test_different_messages_not_deduped(self):
+        recorder = Recorder(clock=FakeClock())
+        recorder.publish(self._event(message="m1"))
+        recorder.publish(self._event(message="m2"))
+        assert len(recorder.events) == 2
+
+    def test_rate_limited_reason_capped_at_burst(self):
+        recorder = Recorder(clock=FakeClock())
+        recorder.rate_limit("Limited", rate=0.0, burst=3)
+        for i in range(10):
+            recorder.publish(self._event(message=f"m{i}", reason="Limited"))
+        assert len(recorder.events) == 3
+
+    def test_dedupe_values_override_key(self):
+        recorder = Recorder(clock=FakeClock())
+        a = self._event(message="m1")
+        a.dedupe_values = ("group-a",)
+        b = self._event(message="completely different")
+        b.dedupe_values = ("group-a",)
+        recorder.publish(a)
+        recorder.publish(b)
+        assert len(recorder.events) == 1
