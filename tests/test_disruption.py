@@ -26,7 +26,7 @@ from helpers import bind_pod, node_claim_pair, nodepool, unschedulable_pod
 
 
 class Env:
-    def __init__(self):
+    def __init__(self, options=None):
         self.clock = FakeClock()
         self.store = Store(clock=self.clock)
         self.provider = FakeCloudProvider()
@@ -34,7 +34,8 @@ class Env:
         self.informer = StateInformer(self.store, self.cluster)
         self.recorder = Recorder(clock=self.clock)
         self.provisioner = Provisioner(
-            self.store, self.provider, self.cluster, self.recorder, self.clock, Options()
+            self.store, self.provider, self.cluster, self.recorder, self.clock,
+            options or Options(),
         )
         self.queue = DisruptionQueue(
             self.store, self.recorder, self.cluster, self.clock, self.provisioner
@@ -320,3 +321,72 @@ class TestBudgets:
         env.add_pair("b-sched")
         # budget inactive -> unrestricted -> emptiness proceeds
         assert env.reconcile() is True
+
+
+class TestSpotToSpot:
+    """consolidation.go:229-301 with the SpotToSpotConsolidation gate ON."""
+
+    def _gated_env(self):
+        from karpenter_tpu.operator.options import FeatureGates
+
+        return Env(
+            options=Options(
+                feature_gates=FeatureGates(spot_to_spot_consolidation=True)
+            )
+        )
+
+    def test_spot_to_spot_with_enough_cheaper_types(self):
+        env = self._gated_env()
+        env.store.create(nodepool("default"))
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        env.add_pair(
+            "spot-big",
+            pods=[pod],
+            instance_type="s-32x-amd64-linux",
+            capacity_type=wk.CAPACITY_TYPE_SPOT,
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert cmd.candidates[0].name() == "spot-big"
+        [replacement] = cmd.replacements
+        claim = replacement.node_claim  # scheduler NodeClaim
+        ct = claim.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        assert ct.has(wk.CAPACITY_TYPE_SPOT)
+        assert not ct.has(wk.CAPACITY_TYPE_ON_DEMAND)
+        # launch set truncated to the 15 cheapest so the spot node sticks
+        assert len(claim.instance_type_options) == 15
+
+    def test_spot_to_spot_blocked_below_minimum_types(self):
+        env = self._gated_env()
+        pool = nodepool(
+            "default",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "In",
+                    # candidate + only 3 cheaper alternatives: below the
+                    # 15-type minimum, so the command must not be issued
+                    "values": [
+                        "s-32x-amd64-linux",
+                        "s-16x-amd64-linux",
+                        "s-8x-amd64-linux",
+                        "s-4x-amd64-linux",
+                    ],
+                }
+            ],
+        )
+        env.store.create(pool)
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        env.add_pair(
+            "spot-thin",
+            pods=[pod],
+            instance_type="s-32x-amd64-linux",
+            capacity_type=wk.CAPACITY_TYPE_SPOT,
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        env.reconcile()
+        assert not any(
+            cmd.candidates and cmd.candidates[0].name() == "spot-thin"
+            for cmd in env.queue.get_commands()
+        )
