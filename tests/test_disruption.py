@@ -390,3 +390,8 @@ class TestSpotToSpot:
             cmd.candidates and cmd.candidates[0].name() == "spot-thin"
             for cmd in env.queue.get_commands()
         )
+        # pin the block to the 15-type minimum, not some earlier failure
+        assert any(
+            "SpotToSpotConsolidation requires 15" in e.message
+            for e in env.recorder.events
+        )
