@@ -260,12 +260,29 @@ class LifecycleController:
     # -- liveness (liveness.go:46-160) --------------------------------------
 
     def _liveness(self, claim: NodeClaim) -> None:
-        now = self.clock.now()
-        age = now - claim.metadata.creation_timestamp
-        if not claim.condition_is_true(CONDITION_LAUNCHED) and age > LAUNCH_TTL:
-            self._delete_claim(claim, "liveness")
+        """Timeouts run from the relevant condition's LAST TRANSITION, not
+        the creation timestamp (liveness.go:79-97): a launch retried after a
+        CreateError restarts the launch clock."""
+        if claim.condition_is_true(CONDITION_REGISTERED):
             return
-        if not claim.condition_is_true(CONDITION_REGISTERED) and age > REGISTRATION_TTL:
+        now = self.clock.now()
+        launched = claim.get_condition(CONDITION_LAUNCHED)
+        if launched is None or launched.status != "True":
+            base = (
+                launched.last_transition_time
+                if launched is not None
+                else claim.metadata.creation_timestamp
+            )
+            if now - base > LAUNCH_TTL:
+                self._delete_claim(claim, "liveness")
+            return
+        registered = claim.get_condition(CONDITION_REGISTERED)
+        base = (
+            registered.last_transition_time
+            if registered is not None
+            else claim.metadata.creation_timestamp
+        )
+        if now - base > REGISTRATION_TTL:
             pool = self.store.try_get(
                 "NodePool", claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")
             )

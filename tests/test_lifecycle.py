@@ -459,3 +459,71 @@ class TestConsistency:
         cond = claim.get_condition("ConsistentStateFound")
         assert cond is not None and cond.status == "False"
         assert "taint" in cond.message
+
+
+class TestLiveness:
+    """liveness_test.go — timeouts run from condition transitions."""
+
+    def _controller(self, env):
+        clock, store, provider, recorder = env
+        return LifecycleController(store, provider, recorder, clock)
+
+    def test_unlaunched_claim_deleted_after_launch_timeout(self, env):
+        clock, store, provider, recorder = env
+        store.create(nodepool("default"))
+        claim = make_claim(store)
+        claim.set_condition(CONDITION_LAUNCHED, "Unknown", now=clock.now())
+        ctrl = self._controller(env)
+        clock.step(299.0)
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is not None
+        clock.step(2.0)
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is None
+
+    def test_launch_retry_restarts_the_clock(self, env):
+        # liveness_test.go: "should use the status condition transition time
+        # for launch timeout, not the creation timestamp"
+        clock, store, provider, recorder = env
+        store.create(nodepool("default"))
+        claim = make_claim(store)
+        claim.set_condition(CONDITION_LAUNCHED, "Unknown", now=clock.now())
+        ctrl = self._controller(env)
+        clock.step(200.0)
+        # a retried launch re-sets the condition, restarting the clock
+        claim.set_condition(
+            CONDITION_LAUNCHED, "False", reason="LaunchFailed", now=clock.now()
+        )
+        clock.step(200.0)  # 400s since creation, 200s since transition
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is not None
+        clock.step(150.0)  # 350s since transition
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is None
+
+    def test_registered_claim_never_deleted(self, env):
+        # liveness_test.go: "shouldn't delete the nodeClaim when the node has
+        # registered past the registration timeout"
+        clock, store, provider, recorder = env
+        store.create(nodepool("default"))
+        claim = make_claim(store)
+        claim.set_condition(CONDITION_LAUNCHED, "True", now=clock.now())
+        claim.set_condition("Registered", "True", now=clock.now())
+        ctrl = self._controller(env)
+        clock.step(10_000.0)
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is not None
+
+    def test_registration_timeout_marks_pool_unhealthy(self, env):
+        clock, store, provider, recorder = env
+        pool = store.create(nodepool("default"))
+        claim = make_claim(store)
+        claim.set_condition(CONDITION_LAUNCHED, "True", now=clock.now())
+        claim.set_condition("Registered", "Unknown", now=clock.now())
+        ctrl = self._controller(env)
+        clock.step(901.0)
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is None
+        pool = store.get("NodePool", "default")
+        cond = pool.get_condition("NodeRegistrationHealthy")
+        assert cond is not None and cond.status == "False"
