@@ -260,9 +260,11 @@ class LifecycleController:
     # -- liveness (liveness.go:46-160) --------------------------------------
 
     def _liveness(self, claim: NodeClaim) -> None:
-        """Timeouts run from the relevant condition's LAST TRANSITION, not
-        the creation timestamp (liveness.go:79-97): a launch retried after a
-        CreateError restarts the launch clock."""
+        """Timeouts run from the relevant condition's last TRANSITION into
+        its current non-True state, not from the creation timestamp
+        (liveness.go:79-97): a claim whose launch reconcile first ran late
+        gets the full window from that first attempt. Repeated failures
+        keep the same status, so they do NOT extend the window."""
         if claim.condition_is_true(CONDITION_REGISTERED):
             return
         now = self.clock.now()

@@ -481,23 +481,35 @@ class TestLiveness:
         ctrl._liveness(claim)
         assert store.try_get("NodeClaim", claim.metadata.name) is None
 
-    def test_launch_retry_restarts_the_clock(self, env):
+    def test_launch_timeout_runs_from_condition_transition(self, env):
         # liveness_test.go: "should use the status condition transition time
-        # for launch timeout, not the creation timestamp"
+        # for launch timeout, not the creation timestamp" — a launch
+        # reconcile that first runs late gets the full window from there
+        clock, store, provider, recorder = env
+        store.create(nodepool("default"))
+        claim = make_claim(store)
+        ctrl = self._controller(env)
+        clock.step(200.0)  # the first (failing) launch attempt happens late
+        claim.set_condition(CONDITION_LAUNCHED, "Unknown", now=clock.now())
+        clock.step(200.0)  # 400s since creation, 200s since transition
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is not None
+        clock.step(150.0)  # 350s since transition
+        ctrl._liveness(claim)
+        assert store.try_get("NodeClaim", claim.metadata.name) is None
+
+    def test_repeated_failures_do_not_extend_the_window(self, env):
+        # Unknown -> Unknown re-writes keep the original transition time
         clock, store, provider, recorder = env
         store.create(nodepool("default"))
         claim = make_claim(store)
         claim.set_condition(CONDITION_LAUNCHED, "Unknown", now=clock.now())
         ctrl = self._controller(env)
-        clock.step(200.0)
-        # a retried launch re-sets the condition, restarting the clock
+        clock.step(250.0)
         claim.set_condition(
-            CONDITION_LAUNCHED, "False", reason="LaunchFailed", now=clock.now()
+            CONDITION_LAUNCHED, "Unknown", reason="LaunchFailed", now=clock.now()
         )
-        clock.step(200.0)  # 400s since creation, 200s since transition
-        ctrl._liveness(claim)
-        assert store.try_get("NodeClaim", claim.metadata.name) is not None
-        clock.step(150.0)  # 350s since transition
+        clock.step(100.0)  # 350s since the FIRST transition
         ctrl._liveness(claim)
         assert store.try_get("NodeClaim", claim.metadata.name) is None
 
@@ -508,7 +520,7 @@ class TestLiveness:
         store.create(nodepool("default"))
         claim = make_claim(store)
         claim.set_condition(CONDITION_LAUNCHED, "True", now=clock.now())
-        claim.set_condition("Registered", "True", now=clock.now())
+        claim.set_condition(CONDITION_REGISTERED, "True", now=clock.now())
         ctrl = self._controller(env)
         clock.step(10_000.0)
         ctrl._liveness(claim)
@@ -519,7 +531,7 @@ class TestLiveness:
         pool = store.create(nodepool("default"))
         claim = make_claim(store)
         claim.set_condition(CONDITION_LAUNCHED, "True", now=clock.now())
-        claim.set_condition("Registered", "Unknown", now=clock.now())
+        claim.set_condition(CONDITION_REGISTERED, "Unknown", now=clock.now())
         ctrl = self._controller(env)
         clock.step(901.0)
         ctrl._liveness(claim)
