@@ -1,0 +1,154 @@
+"""Provisioning-suite oracle specs (reference
+pkg/controllers/provisioning/suite_test.go — names kept, lines cited)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Container, ObjectMeta, Pod, PodSpec, pod_resource_requests
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from helpers import (
+    daemonset,
+    daemonset_pod,
+    make_provisioner_harness,
+    nodepool,
+    unschedulable_pod,
+)
+from test_scheduler import Env
+
+
+def run_batch(harness, pods):
+    clock, store, provider, cluster, informer, prov = harness
+    for p in pods:
+        prov.trigger(p.metadata.uid)
+    informer.flush()
+    clock.step(1.5)
+    return prov.reconcile()
+
+
+class TestNodeClaimCreation:
+    def test_nodepool_termination_grace_period_propagates(self):
+        # suite_test.go:267 — nodepool TGP lands on created claims
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        pool = nodepool("default")
+        pool.spec.template.spec.termination_grace_period = 123.0
+        store.create(pool)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        [claim] = store.list("NodeClaim")
+        assert claim.spec.termination_grace_period == 123.0
+
+    def test_no_termination_grace_period_by_default(self):
+        # suite_test.go:256
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(nodepool("default"))
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        [claim] = store.list("NodeClaim")
+        assert claim.spec.termination_grace_period is None
+
+    def test_deleting_nodepools_ignored(self):
+        # suite_test.go:280
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        pool = nodepool("default")
+        pool.metadata.finalizers.append("karpenter.sh/test")
+        store.create(pool)
+        store.delete(pool)  # finalizer present: deletion_timestamp set
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        assert store.list("NodeClaim") == []
+
+    def test_unschedulable_without_valid_nodepools(self):
+        # suite_test.go:291
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        results = run_batch(harness, [pod])
+        assert results is None or not store.list("NodeClaim")
+
+
+class TestLimits:
+    def test_partial_scheduling_when_limits_would_be_exceeded(self):
+        # suite_test.go:726 — capacity up to the limit provisions; the rest
+        # of the demand stays pending
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(nodepool("default", limits={"cpu": "20"}))
+        pods = [
+            store.create(unschedulable_pod(requests={"cpu": "10"})) for _ in range(5)
+        ]
+        run_batch(harness, pods)
+        claims = store.list("NodeClaim")
+        assert claims, "some capacity should provision"
+        # pessimistic tracking keeps launched capacity bounded near the
+        # limit; demand for all 5 pods (50 cpu) must NOT be fully provisioned
+        assert len(claims) < 5
+
+
+class TestSidecarResourceAccounting:
+    """suite_test.go:531-685 — max(containers+sidecars, init ceiling)."""
+
+    def _pod(self, containers, init_containers):
+        pod = Pod(
+            metadata=ObjectMeta(name="sc-pod"),
+            spec=PodSpec(
+                containers=[
+                    Container(requests=parse_resource_list(c)) for c in containers
+                ],
+                init_containers=[
+                    Container(
+                        requests=parse_resource_list(c),
+                        restart_policy=policy,
+                    )
+                    for c, policy in init_containers
+                ],
+            ),
+        )
+        return pod
+
+    def test_init_before_sidecar(self):
+        # init (3 cpu) runs before the sidecar exists: ceiling is
+        # max(init, app+sidecar) = max(3, 1+2) = 3
+        pod = self._pod(
+            containers=[{"cpu": "1"}],
+            init_containers=[({"cpu": "3"}, None), ({"cpu": "2"}, "Always")],
+        )
+        assert pod_resource_requests(pod)["cpu"] == pytest.approx(3.0)
+
+    def test_sidecar_before_small_init(self):
+        # sidecar (2) starts first; later init (1) runs alongside it:
+        # max(2+1 init phase, 1+2 app phase) = 3
+        pod = self._pod(
+            containers=[{"cpu": "1"}],
+            init_containers=[({"cpu": "2"}, "Always"), ({"cpu": "1"}, None)],
+        )
+        assert pod_resource_requests(pod)["cpu"] == pytest.approx(3.0)
+
+    def test_sidecar_before_large_init(self):
+        # later init (4) + running sidecar (2) dominates the app phase (1+2)
+        pod = self._pod(
+            containers=[{"cpu": "1"}],
+            init_containers=[({"cpu": "2"}, "Always"), ({"cpu": "4"}, None)],
+        )
+        assert pod_resource_requests(pod)["cpu"] == pytest.approx(6.0)
+
+
+class TestDaemonSetAccounting:
+    def test_daemonset_overhead_too_large_blocks(self):
+        # suite_test.go:906
+        ds = daemonset(requests={"cpu": "10000"})
+        env = Env(daemonset_pods=[daemonset_pod(ds)])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert len(results.pod_errors) == 1
+
+    def test_pods_without_requests_schedule(self):
+        # suite_test.go:1037
+        env = Env()
+        pod = unschedulable_pod()
+        pod.spec.containers[0].requests = {}
+        results = env.schedule([pod])
+        assert not results.pod_errors
