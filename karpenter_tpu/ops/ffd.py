@@ -30,11 +30,13 @@ reproducing the host loop's decisions bit-for-bit:
    narrowing groups) are evaluated host-side from the engine's cached row
    matrices — exact, no device round-trip on the sequential path.
 
-Eligibility is checked first (`eligible`): solves with topology machinery
-(spread/affinity groups, incl. inverse anti-affinity from cluster pods),
-reserved capacity, minValues, or PreferNoSchedule relaxation — and pods
-with pod (anti-)affinity, preferred/multi-term node affinity, host ports,
-or volumes — take the host path, which remains the semantics oracle.
+Eligibility is checked first (`eligible`): solves with reserved capacity,
+minValues, or PreferNoSchedule relaxation — and pods with pod
+(anti-)affinity, preferred/multi-term node affinity, host ports, or
+volumes — take the host path, which remains the semantics oracle.
+Topology-spread solves run the topo-aware driver (ops/ffd_topo.py); other
+topology machinery (pod-affinity groups, inverse anti-affinity from cluster
+pods) still declines to the host loop.
 """
 
 from __future__ import annotations
@@ -111,19 +113,14 @@ _SIG_CAP = 200_000
 
 def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     """True when the device path can reproduce host semantics for this solve
-    (solve-level gates; per-pod gates run once per GROUP during grouping)."""
+    (solve-level gates; per-pod gates run once per GROUP during grouping).
+    Topology-engaged solves are additionally gated by ffd_topo.supported()
+    inside solve_device — spread-only solves run the topo-aware driver."""
     if scheduler.engine is None:
         return False
     if len(pods) < DEVICE_MIN_PODS:
         return False
     if len(scheduler.existing_nodes) > DEVICE_MAX_EXISTING:
-        return False
-    # Topology machinery engaged — incl. inverse anti-affinity tracked from
-    # EXISTING cluster pods (topology.go:55-58), which constrains even plain
-    # pods — → host.
-    if getattr(scheduler.topology, "topology_groups", None):
-        return False
-    if getattr(scheduler.topology, "inverse_topology_groups", None):
         return False
     # The relaxation ladder may mutate pods when PreferNoSchedule taints are
     # tolerable (preferences.go:133-145) — shape groups would go stale.
@@ -653,6 +650,11 @@ class _DeviceSolve:
         self.timed_out = False
         self._native: Optional[_NativeDriver] = None
 
+    def abort(self) -> None:
+        """Undo external state mutations before a host fallback. The plain
+        solver mutates nothing outside itself until emit; the topo driver
+        overrides this to restore topology counts/ownership."""
+
     def _intern_fam(self, rows: frozenset, reqs: Requirements) -> int:
         """Intern a requirement row-set; `reqs` must be the hostname-free
         requirement set whose interned rows are exactly `rows`."""
@@ -914,6 +916,7 @@ class _DeviceSolve:
                 continue
             # join
             self.nptr[gi] = j
+            self._joined_node = nd
             nd.joined.append(pod)
             nd.remaining = res.subtract(nd.remaining, g.requests)
             narrowed = any(
@@ -991,6 +994,7 @@ class _DeviceSolve:
             c.members.append(pod)
             c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
             heapq.heapreplace(heap, (c.count, c.rank, ci))
+            self._joined = c
             return True
         return False
 
@@ -1064,7 +1068,9 @@ class _DeviceSolve:
             else:
                 compat_v, offer_v = self._joint_masks(rows, joint)
                 new_fam = self._intern_fam(rows, joint)
-                ent = (self._NARROW, new_fam, compat_v & offer_v)
+                # trailing joint: the merged pre-topology requirement set,
+                # reused by the topo driver (never mutated — callers copy)
+                ent = (self._NARROW, new_fam, compat_v & offer_v, joint)
         self.fam_join[(fam, gi)] = ent
         return ent
 
@@ -1182,12 +1188,17 @@ class _DeviceSolve:
         u_ids: np.ndarray,
         rem: np.ndarray,
         reusable: bool = False,
+        hostname: Optional[str] = None,
     ) -> None:
         """Register a freshly opened claim with the active driver (Python
         loop or native kernel); the opening pod is its first member.
         `reusable` marks candidate/u_ids arrays shared via open_cache (the
-        native driver caches their packed encodings only then)."""
-        hostname = f"device-placeholder-{next(_placeholder_counter):04d}"
+        native driver caches their packed encodings only then). The topo
+        driver supplies `hostname` (drawn from the host scheduler's counter
+        for sorted-domain-iteration parity); plain solves use the device
+        counter — placeholder strings are decision-inert without topology."""
+        if hostname is None:
+            hostname = f"device-placeholder-{next(_placeholder_counter):04d}"
         if self._native is not None:
             self._native.add_claim(
                 ti, fam, hostname, pod, gi, candidate, u_ids, rem, reusable
@@ -1395,15 +1406,29 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         DEVICE_FALLBACKS += 1
         _FALLBACKS_CTR.inc()
         return None
-    solve = _DeviceSolve(scheduler, pods)
+    topo = scheduler.topology
+    if getattr(topo, "topology_groups", None) or getattr(
+        topo, "inverse_topology_groups", None
+    ):
+        from karpenter_tpu.ops import ffd_topo
+
+        if not ffd_topo.supported(scheduler):
+            DEVICE_FALLBACKS += 1
+            _FALLBACKS_CTR.inc()
+            return None
+        solve: _DeviceSolve = ffd_topo._TopoSolve(scheduler, pods)
+    else:
+        solve = _DeviceSolve(scheduler, pods)
     try:
         solve.run(timeout)
         solve.emit()
     except _Fallback:
+        solve.abort()
         DEVICE_FALLBACKS += 1
         _FALLBACKS_CTR.inc()
         return None
     except Exception:
+        solve.abort()
         if STRICT:
             raise
         DEVICE_FALLBACKS += 1

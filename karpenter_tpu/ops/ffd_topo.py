@@ -1,0 +1,657 @@
+"""Topology-aware device fast path: grouped FFD for solves with topology
+machinery engaged.
+
+The plain device path (ops/ffd.py) declines any solve with topology groups
+because topology breaks the monotonicity its caches rely on: a claim that
+rejects a pod for skew today may accept it after counts change. This module
+extends the grouped simulation to topology-spread solves (reference
+scheduling/topology.go + topologygroup.go:205-286) while preserving EXACT
+host-decision parity:
+
+- Pods collapse into shape groups keyed by the topo-aware signature (spec
+  shape + namespace + labels + full constraint content — selectors match on
+  labels, so labels are part of identity here, unlike the plain path).
+- Groups that own topology groups are VOLATILE: their placements run the
+  full host gate sequence per candidate (taints → compat → topology
+  next-domain via the real `Topology.add_requirements` → instance-type
+  narrowing through the engine's cached row masks). No monotone caching —
+  skew rejections are not permanent.
+- Plain groups keep the fast monotone path (heaps, family transitions), plus
+  a record hook: the host records EVERY placement into any topology group
+  whose selector matches the pod (topology.go:252-276), so counts stay
+  exact even when only a minority of pods carry constraints.
+- Decision-parity traps handled explicitly:
+  * hostname placeholders: sorted-domain iteration makes placeholder STRINGS
+    decision-relevant (topologygroup.go:269-276 hostname min-count, sorted
+    scans), so topo solves draw hostnames from the host scheduler's counter
+    (scheduler.nodeclaim._hostname_counter) at the host's exact consumption
+    points — one per template attempt that passes the limits gate, matching
+    NodeClaim construction in _add_to_new_node_claim (scheduler.go:478-556).
+  * relaxation: the ladder (preferences.go) is driven exactly like the host
+    — deepcopy, relax one step, topology.update + pod-data refresh, retry —
+    with the relaxed copy migrating to its new shape group.
+  * rollback: topology counts are snapshotted at solve start and restored if
+    the solve aborts (fallback/strict), and relax-touched ownership is reset
+    via topology.update(original), so a host fallback never sees device-
+    mutated topology state.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Pod
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.ops.ffd import (
+    _EPS,
+    _DeviceSolve,
+    _Fallback,
+    _Group,
+    _raw_sig,
+)
+from karpenter_tpu.scheduler import nodeclaim as ncmod
+from karpenter_tpu.scheduler.topology import TYPE_SPREAD
+from karpenter_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    Operator,
+    Requirement,
+    Requirements,
+)
+from karpenter_tpu.scheduling.taints import Taints
+from karpenter_tpu.utils import resources as res
+
+_TOPO_SOLVES_CTR = global_registry.counter(
+    "karpenter_scheduler_device_topo_solves_total",
+    "topology-engaged scheduling solves served by the device fast path",
+)
+
+# process-global interning for topo-aware signatures, parallel to
+# ffd._SIG_IDS (separate space: the same spec shape means different things
+# once labels/constraints matter)
+_TSIG_IDS: dict[tuple, int] = {}
+_TSIG_CAP = 200_000
+_tsig_next = 0
+
+
+def _intern_tsig(pod: Pod) -> int:
+    """Interned topo-signature id for a pod, cached on the object."""
+    global _tsig_next
+    sig = getattr(pod, "_kt_tsig", None)
+    if sig is None:
+        raw = _topo_sig(pod)
+        sig = _TSIG_IDS.get(raw)
+        if sig is None:
+            if len(_TSIG_IDS) >= _TSIG_CAP:
+                _TSIG_IDS.clear()
+            sig = _tsig_next
+            _tsig_next += 1
+            _TSIG_IDS[raw] = sig
+        try:
+            pod._kt_tsig = sig
+        except Exception:  # noqa: BLE001 — slotted/frozen pod
+            pass
+    return sig
+
+
+def supported(scheduler) -> bool:
+    """Can this topology-engaged solve run on the device path?
+
+    Phase 1: topology-spread groups only. Pod (anti-)affinity groups and
+    inverse anti-affinity (from existing cluster pods, topology.go:55-58)
+    still take the host loop."""
+    topo = scheduler.topology
+    if getattr(topo, "inverse_topology_groups", None):
+        return False
+    for tg in topo.topology_groups.values():
+        if tg.type != TYPE_SPREAD:
+            return False
+    return True
+
+
+def _sel_sig(sel) -> Optional[tuple]:
+    if sel is None:
+        return None
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple(
+            (e["key"], e["operator"], tuple(e.get("values", ())))
+            for e in sel.match_expressions
+        ),
+    )
+
+
+def _topo_sig(pod: Pod) -> tuple:
+    """Shape signature for topology-engaged solves: the plain spec signature
+    plus namespace, labels (selector targets), and full constraint content."""
+    spec = pod.spec
+    md = pod.metadata
+    tsc = tuple(
+        (
+            t.topology_key,
+            t.max_skew,
+            t.when_unsatisfiable,
+            _sel_sig(t.label_selector),
+            t.min_domains,
+            t.node_affinity_policy,
+            t.node_taints_policy,
+            tuple(t.match_label_keys),
+        )
+        for t in spec.topology_spread_constraints
+    )
+    return (
+        _raw_sig(pod),
+        md.namespace,
+        tuple(sorted(md.labels.items())) if md.labels else (),
+        tsc,
+    )
+
+
+def _group_eligible_topo(pod: Pod) -> bool:
+    """Per-shape gates for topo mode: spread constraints are allowed; pod
+    (anti-)affinity, preferred/multi-term node affinity, ports and volumes
+    still decline (phase 2)."""
+    spec = pod.spec
+    aff = spec.affinity
+    if aff is not None:
+        if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
+            return False
+        na = aff.node_affinity
+        if na is not None and (na.preferred or len(na.required) > 1):
+            return False
+    if any(c.ports for c in spec.containers):
+        return False
+    if getattr(spec, "volumes", None):
+        return False
+    return True
+
+
+class _TopoSolve(_DeviceSolve):
+    """Grouped FFD with exact topology semantics (Python driver only — the
+    native kernel's steady-state caches assume monotone rejections, which
+    topology breaks, so topo solves run the instrumented Python loop)."""
+
+    def __init__(self, scheduler, pods: Sequence[Pod]):
+        super().__init__(scheduler, pods)
+        self.topology = scheduler.topology
+        self._sig_to_gi: dict[int, int] = {}
+        self.g_volatile: list[bool] = []
+        self.g_rec: list[list] = []  # groups whose selector matches the shape
+        self.g_relaxable: list[bool] = []
+        self._hostname_tgs = any(
+            tg.key == wk.LABEL_HOSTNAME for tg in self.topology.topology_groups.values()
+        )
+        self._saved_counts: list[tuple] = []
+        self._relax_restore: dict[str, Pod] = {}
+        self._aborted = False
+
+    # -- grouping -----------------------------------------------------------
+
+    def _group_pods(self) -> Optional[np.ndarray]:
+        pods = self.pods
+        sigs = np.empty(len(pods), dtype=np.int64)
+        for i, pod in enumerate(pods):
+            sigs[i] = _intern_tsig(pod)
+        _, first_idx, inverse, counts = np.unique(
+            sigs, return_index=True, return_inverse=True, return_counts=True
+        )
+        for k, fi in enumerate(first_idx):
+            pod = pods[int(fi)]
+            gi = self._build_group(pod)
+            if gi is None:
+                return None
+            self.groups[gi].n_pods = int(counts[k])
+            self._sig_to_gi[int(sigs[int(fi)])] = gi
+        return inverse.astype(np.int32)
+
+    def _build_group(self, pod: Pod) -> Optional[int]:
+        """Create the shape group for `pod` (its signature's representative);
+        returns the group index, or None when the shape is ineligible."""
+        s, dims = self.s, self.dims
+        if not _group_eligible_topo(pod):
+            return None
+        s.update_cached_pod_data(pod)
+        data = s.cached_pod_data[pod.metadata.uid]
+        if any(name not in dims for name in data.requests):
+            return None
+        group = _Group(data, dims)
+        if group.has_hostname:
+            return None
+        group.rowset = self._rows_sans_hostname(group.reqs)
+        gi = len(self.groups)
+        self.groups.append(group)
+        self.gheaps.append([])
+        self.gsynced.append(0)
+        self.nptr.append(0)
+        topo = self.topology
+        owned = [
+            tg for tg in topo.topology_groups.values() if tg.is_owned_by(pod.metadata.uid)
+        ]
+        self.g_volatile.append(bool(owned))
+        self.g_rec.append(
+            [tg for tg in topo.topology_groups.values() if tg.selects(pod)]
+        )
+        self.g_relaxable.append(self._shape_relaxable(pod))
+        return gi
+
+    def _shape_relaxable(self, pod: Pod) -> bool:
+        """Does the relaxation ladder (preferences.go:33-145) have anything
+        to remove for this shape? Mirrors Preferences.relax applicability."""
+        spec = pod.spec
+        aff = spec.affinity
+        if aff is not None and aff.node_affinity is not None:
+            na = aff.node_affinity
+            if na.preferred or len(na.required) > 1:
+                return True
+        if any(
+            t.when_unsatisfiable == "ScheduleAnyway"
+            for t in spec.topology_spread_constraints
+        ):
+            return True
+        return False
+
+    def _ensure_group(self, pod: Pod) -> Optional[int]:
+        """Group index for a relaxed copy, creating its shape group lazily.
+        cached_pod_data[uid] was already refreshed by the caller (mirroring
+        the host's update_cached_pod_data after relax)."""
+        sig = _intern_tsig(pod)
+        gi = self._sig_to_gi.get(sig)
+        if gi is None:
+            gi = self._build_group(pod)
+            if gi is None:
+                return None
+            self._sig_to_gi[sig] = gi
+        return gi
+
+    # -- topology state management ------------------------------------------
+
+    def _snapshot_topology(self) -> None:
+        topo = self.topology
+        self._saved_counts = [
+            (tg, dict(tg.domains), set(tg.empty_domains))
+            for tg in (
+                list(topo.topology_groups.values())
+                + list(topo.inverse_topology_groups.values())
+            )
+        ]
+
+    def abort(self) -> None:
+        """Restore topology to its pre-solve state so the host fallback runs
+        against uncorrupted counts and ownership."""
+        if self._aborted:
+            return
+        self._aborted = True
+        for tg, domains, empty in self._saved_counts:
+            tg.domains = domains
+            tg.empty_domains = empty
+        for orig in self._relax_restore.values():
+            self.topology.update(orig)
+            self.s.update_cached_pod_data(orig)
+        self._relax_restore.clear()
+
+    # -- record hooks (NodeClaim.add / ExistingNode.add tails) ---------------
+
+    def _needs_record(self, gi: int) -> bool:
+        return bool(self.g_rec[gi]) or self._hostname_tgs or self.g_volatile[gi]
+
+    def _record_claim(self, pod: Pod, gi: int, c, reqs: Requirements) -> None:
+        """register + record after a claim join (nodeclaim.go Add tail:
+        register(hostname), record with the final joint requirements)."""
+        topo = self.topology
+        if self._hostname_tgs:
+            topo.register(wk.LABEL_HOSTNAME, c.hostname)
+        topo.record(
+            pod,
+            self.s.nodeclaim_templates[c.ti].spec.taints,
+            reqs,
+            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+        )
+
+    def _claim_reqs(self, c) -> Requirements:
+        """The claim's full current requirement set, hostname row included —
+        what the host's NodeClaim.requirements holds."""
+        reqs = Requirements(*self.fam_reqs[c.fam].values())
+        reqs.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
+        return reqs
+
+    # -- volatile paths ------------------------------------------------------
+
+    def _try_nodes_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
+        """Existing-node scan for topology-owning shapes: full rescan in host
+        order every attempt (skew admission is not monotone), the real
+        Topology.add_requirements in the gate sequence
+        (existingnode.go:63-101)."""
+        topo = self.topology
+        for nd in self.nodes:
+            tol = nd.gtol.get(gi)
+            if tol is None:
+                tol = Taints(nd.en.cached_taints).tolerates_pod(pod) is None
+                nd.gtol[gi] = tol
+            if not tol:
+                continue
+            kc = nd.gcap.get(gi)
+            if kc is None or kc[0] != nd.usage_ver:
+                k = self._node_capacity(nd, g)
+                nd.gcap[gi] = (nd.usage_ver, k)
+            else:
+                k = kc[1]
+            if k <= 0:
+                continue
+            cc = nd.gcompat.get(gi)
+            if cc is None or cc[0] != nd.version:
+                ok = nd.reqs.compatible(g.reqs) is None
+                nd.gcompat[gi] = (nd.version, ok)
+            else:
+                ok = cc[1]
+            if not ok:
+                continue
+            joint = Requirements(*nd.reqs.values())
+            joint.add(*g.reqs.values())
+            try:
+                topo_reqs = topo.add_requirements(
+                    pod, nd.en.cached_taints, g.strict_reqs, joint
+                )
+            except ValueError:
+                continue
+            if joint.compatible(topo_reqs) is not None:
+                continue
+            joint.add(*topo_reqs.values())
+            nd.joined.append(pod)
+            nd.remaining = res.subtract(nd.remaining, g.requests)
+            nd.reqs = joint
+            nd.version += 1
+            nd.usage_ver += 1
+            topo.record(pod, nd.en.cached_taints, joint)
+            return True
+        return False
+
+    def _host_claim_order(self) -> list[int]:
+        """Host in-flight scan order: stable sort by pod count
+        (scheduler.go:457-459). (count, rank, index) reproduces the stable
+        sort exactly — among equal counts the most recently joined claim was
+        most recently below, hence sorted earlier (rank = -join_seq); fresh
+        opens keep append order (rank = +open_seq)."""
+        claims = self.claims
+        return sorted(
+            range(len(claims)), key=lambda ci: (claims[ci].count, claims[ci].rank, ci)
+        )
+
+    def _try_claims_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
+        topo = self.topology
+        templates = self.s.nodeclaim_templates
+        for ci in self._host_claim_order():
+            c = self.claims[ci]
+            tol = self.tg_tol.get((c.ti, gi))
+            if tol is None:
+                tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
+                self.tg_tol[(c.ti, gi)] = tol
+            if not tol:
+                continue
+            ent = self.fam_join.get((c.fam, gi))
+            if ent is None:
+                ent = self._build_fam_join(c.fam, gi)
+            if ent[0] == self._REJECT:
+                continue
+            # joint BEFORE topology = claim reqs + pod reqs, hostname row
+            # included (nodeclaim.go:285-291)
+            base = self.fam_reqs[c.fam] if ent[0] == self._SAME else ent[3]
+            joint = Requirements(*base.values())
+            joint.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
+            try:
+                topo_reqs = topo.add_requirements(
+                    pod,
+                    templates[c.ti].spec.taints,
+                    g.strict_reqs,
+                    joint,
+                    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+                )
+            except ValueError:
+                continue
+            if joint.compatible(topo_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+                continue
+            joint.add(*topo_reqs.values())
+            final_rows = self._rows_sans_hostname(joint)
+            if final_rows == self.fam_rows[c.fam]:
+                fitrows = (c.rem >= g.fit_floor).all(axis=1)
+                if not fitrows.any():
+                    continue
+            else:
+                compat_v, offer_v = self._joint_masks(final_rows, joint)
+                new_mask = c.type_mask & compat_v & offer_v
+                surv_u = np.zeros(self.U, dtype=bool)
+                surv_u[self.uid_of_type[new_mask]] = True
+                keep = surv_u[c.u_ids]
+                fitrows = keep & (c.rem >= g.fit_floor).all(axis=1)
+                if not fitrows.any():
+                    continue
+                c.type_mask = new_mask
+                c.rem = c.rem[keep]
+                c.u_ids = c.u_ids[keep]
+                canon = Requirements(
+                    *(r for r in joint if r.key != wk.LABEL_HOSTNAME)
+                )
+                c.fam = self._intern_fam(final_rows, canon)
+                fitrows = fitrows[keep]
+            # join (usage grows; rows that stop fitting die forever)
+            if fitrows.all():
+                c.rem = c.rem - g.req_f
+            else:
+                c.rem = c.rem[fitrows] - g.req_f
+                c.u_ids = c.u_ids[fitrows]
+            c.count += 1
+            self.seq += 1
+            c.rank = -self.seq
+            c.members.append(pod)
+            c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
+            self._record_claim(pod, gi, c, joint)
+            return True
+        return False
+
+    def _new_claim_topo(self, pod: Pod, g: _Group, gi: int) -> Optional[Exception]:
+        """New-claim opening with host-identical hostname-counter consumption
+        and topology narrowing (scheduler.go:478-556 + nodeclaim.go:114-163).
+        No memoized error short-circuit: the host re-runs the template loop
+        (and consumes placeholder hostnames) on every retry, and hostname
+        STRINGS are decision-relevant under sorted-domain iteration."""
+        s, topo = self.s, self.topology
+        errs: list[Exception] = []
+        for ti, nct in enumerate(s.nodeclaim_templates):
+            remaining = self.remaining_resources.get(nct.nodepool_name)
+            limits_mask = None
+            if remaining:
+                limits_mask = self._limits_mask(remaining)
+                if not (limits_mask & self.tmpl_mask[ti]).any():
+                    errs.append(
+                        ValueError(
+                            f"all available instance types exceed limits for "
+                            f"nodepool {nct.nodepool_name!r}"
+                        )
+                    )
+                    continue
+            # the host constructs the NodeClaim here, consuming a hostname
+            # placeholder even when can_add then fails
+            hostname = f"hostname-placeholder-{next(ncmod._hostname_counter):04d}"
+            tol = self.tg_tol.get((ti, gi))
+            if tol is None:
+                tol = Taints(nct.spec.taints).tolerates_pod(pod) is None
+                self.tg_tol[(ti, gi)] = tol
+            if not tol:
+                errs.append(
+                    ValueError(str(Taints(nct.spec.taints).tolerates_pod(pod)))
+                )
+                continue
+            tg = self._tg(ti, gi)
+            if tg is None:
+                errs.append(
+                    ValueError(
+                        "incompatible requirements, "
+                        + str(
+                            nct.requirements.compatible(
+                                g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                            )
+                        )
+                    )
+                )
+                continue
+            joint_tg, _rows = tg
+            joint = Requirements(*joint_tg.values())
+            joint.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [hostname]))
+            try:
+                topo_reqs = topo.add_requirements(
+                    pod,
+                    nct.spec.taints,
+                    g.strict_reqs,
+                    joint,
+                    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+                )
+            except ValueError as e:
+                errs.append(e)
+                continue
+            topo_err = joint.compatible(topo_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+            if topo_err is not None:
+                errs.append(ValueError(topo_err))
+                continue
+            joint.add(*topo_reqs.values())
+            final_rows = self._rows_sans_hostname(joint)
+            compat_v, offer_v = self._joint_masks(final_rows, joint)
+            base = self.tmpl_mask[ti]
+            if limits_mask is not None:
+                base = base & limits_mask
+            candidate = base & compat_v & offer_v
+            cand_u = np.unique(self.uid_of_type[candidate])
+            rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
+            fitrows = (rem0 >= -_EPS).all(axis=1)
+            if not fitrows.any():
+                errs.append(self._filter_error(base, compat_v, offer_v, ti, g))
+                continue
+            canon = Requirements(*(r for r in joint if r.key != wk.LABEL_HOSTNAME))
+            fam = self._intern_fam(final_rows, canon)
+            u_ids = cand_u[fitrows]
+            self._open_claim(
+                ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),
+                hostname=hostname,
+            )
+            c = self.claims[-1]
+            self._record_claim(pod, gi, c, joint)
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[u_ids] = True
+            self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
+            return None
+        if not errs:
+            errs.append(ValueError("no nodepool can host the pod"))
+        return (
+            errs[0]
+            if len(errs) == 1
+            else ValueError("; ".join(str(e) for e in errs))
+        )
+
+    # -- attempt / relax loop ------------------------------------------------
+
+    def _try_once(self, pod: Pod, gi: int) -> Optional[Exception]:
+        """One host `_add` pass: existing nodes → in-flight claims → new
+        claim (scheduler.go:436-449)."""
+        g = self.groups[gi]
+        volatile = self.g_volatile[gi]
+        if self.nodes:
+            if volatile:
+                placed = self._try_nodes_topo(pod, g, gi)
+            else:
+                placed = self._try_nodes(pod, g, gi)
+                if placed and self._needs_record(gi):
+                    nd = self._joined_node
+                    self.topology.record(pod, nd.en.cached_taints, nd.reqs)
+            if placed:
+                return None
+        if volatile:
+            placed = self._try_claims_topo(pod, g, gi)
+        else:
+            placed = self._try_claims(pod, g, gi)
+            if placed and self._needs_record(gi):
+                c = self._joined
+                self._record_claim(pod, gi, c, self._claim_reqs(c))
+        if placed:
+            return None
+        if not self.s.nodeclaim_templates:
+            return ValueError(
+                "nodepool requirements filtered out all available instance types"
+            )
+        return self._new_claim_topo(pod, g, gi)
+
+    def _attempt(self, pod: Pod, gi: int) -> Optional[Exception]:
+        """Host `_try_schedule`: attempt, then relax one preference at a time
+        on failure, topology.update + pod-data refresh between steps
+        (scheduler.go:351-371). Final failure restores the original pod's
+        ownership and cached data (scheduler.go:363-367 error tail)."""
+        s = self.s
+        p, pgi = pod, gi
+        relaxed_any = False
+        while True:
+            err = self._try_once(p, pgi)
+            if err is None:
+                return None
+            if not self.g_relaxable[pgi]:
+                if relaxed_any:
+                    self.topology.update(pod)
+                    s.update_cached_pod_data(pod)
+                    self._relax_restore.pop(pod.metadata.uid, None)
+                return err
+            rc = copy.deepcopy(p) if p is pod else p
+            if not s.preferences.relax(rc):
+                if relaxed_any:
+                    self.topology.update(pod)
+                    s.update_cached_pod_data(pod)
+                    self._relax_restore.pop(pod.metadata.uid, None)
+                return err
+            relaxed_any = True
+            self._relax_restore.setdefault(pod.metadata.uid, pod)
+            self.topology.update(rc)
+            s.update_cached_pod_data(rc)
+            ngi = self._ensure_group(rc)
+            if ngi is None:
+                raise _Fallback("relaxed shape ineligible")
+            p, pgi = rc, ngi
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self, timeout: Optional[float]) -> None:
+        gi_arr = self._group_pods()
+        if gi_arr is None:
+            raise _Fallback("ineligible pod shape")
+        self._prepare_templates()
+        order = self._order(gi_arr)
+        self._snapshot_topology()
+        qpods = [(self.pods[i], int(gi_arr[i])) for i in order]
+        head = 0
+        last_len: dict[str, int] = {}
+        pod_errors = self.pod_errors
+        start = time.perf_counter()
+        check = 0
+        while head < len(qpods):
+            pod, gi = qpods[head]
+            if last_len.get(pod.metadata.uid) == len(qpods) - head:
+                break
+            check += 1
+            if timeout is not None and not (check & 0x3F):
+                if time.perf_counter() - start > timeout:
+                    self.timed_out = True
+                    for p, _ in qpods[head:]:
+                        pod_errors.setdefault(
+                            p, TimeoutError("scheduling simulation timed out")
+                        )
+                    return
+            head += 1
+            err = self._attempt(pod, gi)
+            if err is None:
+                pod_errors.pop(pod, None)
+            else:
+                pod_errors[pod] = err
+                qpods.append((pod, gi))
+                last_len[pod.metadata.uid] = len(qpods) - head
+
+    def emit(self):
+        super().emit()
+        _TOPO_SOLVES_CTR.inc()

@@ -21,10 +21,13 @@ class Preferences:
 
     def relax(self, pod: Pod) -> bool:
         """Mutates the pod, removing one soft constraint. True if relaxed."""
-        # the device fast path caches a spec-shape signature on the object;
-        # any in-place spec mutation must invalidate it (ops/ffd._raw_sig)
+        # the device fast path caches spec-shape signatures on the object;
+        # any in-place spec mutation must invalidate them (ops/ffd._raw_sig,
+        # ops/ffd_topo._topo_sig)
         if hasattr(pod, "_kt_sig"):
             del pod._kt_sig
+        if hasattr(pod, "_kt_tsig"):
+            del pod._kt_tsig
         relaxations = [
             self.remove_required_node_affinity_term,
             self.remove_preferred_pod_affinity_term,

@@ -13,6 +13,7 @@ across runs requires identical metadata). Run the long fuzz directly:
     python tests/test_device_parity.py 1000
 """
 
+import itertools
 import random
 import sys
 
@@ -21,16 +22,26 @@ import pytest
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import (
     Affinity,
+    LabelSelector,
     NodeAffinity,
     NodeSelectorTerm,
     Taint,
     Toleration,
+    TopologySpreadConstraint,
 )
 from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
 from karpenter_tpu.ops import ffd
 from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.scheduler import nodeclaim as ncmod
 
-from helpers import daemonset, daemonset_pod, nodepool, registered_node, unschedulable_pod
+from helpers import (
+    bind_pod,
+    daemonset,
+    daemonset_pod,
+    nodepool,
+    registered_node,
+    unschedulable_pod,
+)
 from test_scheduler import Env
 
 CATALOG = construct_instance_types()
@@ -41,13 +52,27 @@ CPUS = ["250m", "500m", "1", "2", "3", "4", "7", "16"]
 MEMS = ["256Mi", "512Mi", "1Gi", "2Gi", "7Gi"]
 
 
-def _random_nodepools(rng: random.Random):
+APPS = ["app-0", "app-1", "app-2"]
+TIERS = ["gold", "silver", "bronze"]
+
+
+def _random_nodepools(rng: random.Random, topo: bool = False):
     pools = []
     for i in range(rng.randint(1, 3)):
         requirements = []
         if rng.random() < 0.4:
             requirements.append(
                 {"key": wk.LABEL_ARCH, "operator": "In", "values": [rng.choice(ARCHS)]}
+            )
+        if topo and rng.random() < 0.3:
+            # custom-key domain universe for "tier"-keyed spread
+            # (topology.go buildDomainGroups from nodepool requirements)
+            requirements.append(
+                {
+                    "key": "tier",
+                    "operator": "In",
+                    "values": rng.sample(TIERS, rng.randint(1, 3)),
+                }
             )
         if rng.random() < 0.3:
             requirements.append(
@@ -75,8 +100,65 @@ def _random_nodepools(rng: random.Random):
     return pools
 
 
-def _random_shape(rng: random.Random, si: int):
+def _random_selector(rng: random.Random):
+    roll = rng.random()
+    if roll < 0.15:
+        return None  # nil selector: matches nothing, but lists every pod in
+        # _count_domains (topology.go:466-471 TopologyListOptions mirror)
+    if roll < 0.75:
+        return LabelSelector(match_labels={"app": rng.choice(APPS)})
+    return LabelSelector(
+        match_expressions=[
+            {
+                "key": "app",
+                "operator": "In",
+                "values": rng.sample(APPS, rng.randint(1, 2)),
+            }
+        ]
+    )
+
+
+def _random_spread(rng: random.Random):
+    roll = rng.random()
+    if roll < 0.55:
+        key = wk.LABEL_TOPOLOGY_ZONE
+    elif roll < 0.7:
+        key = wk.LABEL_HOSTNAME
+    elif roll < 0.8:
+        key = wk.CAPACITY_TYPE_LABEL_KEY
+    elif roll < 0.9:
+        key = wk.LABEL_ARCH
+    else:
+        key = "tier"
+    tsc = TopologySpreadConstraint(
+        max_skew=rng.choice([1, 1, 1, 2, 3]),
+        topology_key=key,
+        when_unsatisfiable=rng.choice(
+            ["DoNotSchedule", "DoNotSchedule", "ScheduleAnyway"]
+        ),
+        label_selector=_random_selector(rng),
+    )
+    if rng.random() < 0.2:
+        tsc.min_domains = rng.randint(1, 4)
+    if rng.random() < 0.25:
+        tsc.node_affinity_policy = rng.choice(["Honor", "Ignore"])
+    if rng.random() < 0.2:
+        tsc.node_taints_policy = rng.choice(["Honor", "Ignore"])
+    if rng.random() < 0.15:
+        tsc.match_label_keys = ["app"]
+    return tsc
+
+
+def _random_shape(rng: random.Random, si: int, topo: bool = False):
     kwargs = {"requests": {"cpu": rng.choice(CPUS), "memory": rng.choice(MEMS)}}
+    if topo:
+        if rng.random() < 0.8:
+            kwargs["labels"] = {"app": rng.choice(APPS)}
+        n_tsc = rng.choice([0, 1, 1, 1, 2]) if rng.random() < 0.55 else 0
+        if n_tsc:
+            kwargs["topology_spread_constraints"] = [
+                _random_spread(rng) for _ in range(n_tsc)
+            ]
     selector = {}
     roll = rng.random()
     if roll < 0.3:
@@ -118,29 +200,45 @@ def _random_shape(rng: random.Random, si: int):
     return kwargs, spec_kwargs
 
 
-def build_case(seed: int):
-    """(node_pools, state_nodes, daemonset_pods, build_pods) for one case."""
-    rng = random.Random(seed)
-    pools = _random_nodepools(rng)
+def build_case(seed: int, topo: bool = False):
+    """(node_pools, state_nodes, bound_pods, daemonset_pods, build_pods)."""
+    rng = random.Random(seed if not topo else seed + 1_000_000)
+    pools = _random_nodepools(rng, topo)
     nodes = []
+    bound = []
     for i in range(rng.randint(0, 6)):
         pool = rng.choice(pools).metadata.name
-        nodes.append(
-            registered_node(
-                name=f"existing-{i}",
-                pool=pool,
-                instance_type="s-4x-amd64-linux",
-                zone=rng.choice(ZONES),
-                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
-                labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux"},
-            )
+        labels = {wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux"}
+        if topo and rng.random() < 0.3:
+            labels["tier"] = rng.choice(TIERS)
+        node = registered_node(
+            name=f"existing-{i}",
+            pool=pool,
+            instance_type="s-4x-amd64-linux",
+            zone=rng.choice(ZONES),
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            labels=labels,
         )
+        nodes.append(node)
+        if topo:
+            # live pods seed domain counts (topology.go countDomains)
+            for j in range(rng.randint(0, 2)):
+                bp = unschedulable_pod(
+                    name=f"bound-{i}-{j}",
+                    requests={"cpu": "100m"},
+                    labels={"app": rng.choice(APPS)} if rng.random() < 0.8 else {},
+                )
+                bp.metadata.uid = f"bound-uid-{i}-{j}"
+                bp.metadata.creation_timestamp = 0.0
+                bound.append(bind_pod(bp, node))
     ds_pods = []
     if rng.random() < 0.4:
         ds = daemonset(requests={"cpu": "100m", "memory": "64Mi"})
         ds_pods.append(daemonset_pod(ds))
     n_pods = rng.randint(ffd.DEVICE_MIN_PODS, 320)
-    shapes = [_random_shape(rng, si) for si in range(rng.randint(3, 24))]
+    shapes = [_random_shape(rng, si, topo) for si in range(rng.randint(3, 24))]
+    if topo and not any(s[0].get("topology_spread_constraints") for s in shapes):
+        shapes[0][0]["topology_spread_constraints"] = [_random_spread(rng)]
     picks = [rng.randrange(len(shapes)) for _ in range(n_pods)]
 
     def build_pods():
@@ -153,7 +251,7 @@ def build_case(seed: int):
             pods.append(p)
         return pods
 
-    return pools, nodes, ds_pods, build_pods
+    return pools, nodes, bound, ds_pods, build_pods
 
 
 def decisions(results):
@@ -184,9 +282,9 @@ def decisions(results):
     return claims, existing, errors
 
 
-def run_case(seed: int):
+def run_case(seed: int, topo: bool = False):
     """Returns (host_decisions, device_decisions, device_ran)."""
-    pools, nodes, ds_pods, build_pods = build_case(seed)
+    pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo)
 
     def env(engine):
         import copy
@@ -194,14 +292,19 @@ def run_case(seed: int):
         return Env(
             node_pools=copy.deepcopy(pools),
             state_nodes=copy.deepcopy(nodes),
+            pods=copy.deepcopy(bound),
             daemonset_pods=copy.deepcopy(ds_pods),
             engine=engine,
         )
 
+    # hostname placeholder strings are decision-relevant under topology
+    # (sorted-domain iteration) — both runs must draw the same sequence
+    ncmod._hostname_counter = itertools.count(1)
     host = decisions(env(None).schedule(build_pods()))
     solves0 = ffd.DEVICE_SOLVES
     old_strict = ffd.STRICT
     ffd.STRICT = True
+    ncmod._hostname_counter = itertools.count(1)
     try:
         dev = decisions(env(CatalogEngine(CATALOG)).schedule(build_pods()))
     finally:
@@ -215,6 +318,16 @@ class TestDeviceParity:
         host, dev, ran = run_case(seed)
         assert host == dev
         assert ran, "device path unexpectedly fell back to the host loop"
+
+    @pytest.mark.parametrize("seed", range(30))
+    def test_topology_spread_decision_parity(self, seed):
+        """Topology-engaged solves on the topo driver (ops/ffd_topo.py):
+        spread over zone/hostname/capacity-type/arch/custom keys, mixed
+        skews/policies/selectors, ScheduleAnyway relaxation, live-pod-seeded
+        counts — decisions must match the host loop exactly."""
+        host, dev, ran = run_case(seed, topo=True)
+        assert host == dev
+        assert ran, "topo device path unexpectedly fell back to the host loop"
 
     @pytest.mark.parametrize("seed", range(12))
     def test_python_loop_parity(self, seed, monkeypatch):
@@ -235,22 +348,33 @@ class TestDeviceParity:
         assert ran
 
 
-def main(n_cases: int) -> int:
+def main(n_cases: int, topo: bool = False) -> int:
     failures = 0
     fallbacks = 0
+    label = "topo" if topo else "plain"
     for seed in range(n_cases):
-        host, dev, ran = run_case(seed)
+        host, dev, ran = run_case(seed, topo)
         if host != dev:
             failures += 1
-            print(f"seed {seed}: DIVERGED")
+            print(f"{label} seed {seed}: DIVERGED")
         if not ran:
             fallbacks += 1
-            print(f"seed {seed}: fell back to host loop")
+            print(f"{label} seed {seed}: fell back to host loop")
         if seed % 100 == 99:
-            print(f"{seed + 1}/{n_cases} cases, {failures} divergences, {fallbacks} fallbacks")
-    print(f"DONE: {n_cases} cases, {failures} divergences, {fallbacks} fallbacks")
+            print(
+                f"{label} {seed + 1}/{n_cases} cases, {failures} divergences, "
+                f"{fallbacks} fallbacks"
+            )
+    print(f"DONE {label}: {n_cases} cases, {failures} divergences, {fallbacks} fallbacks")
     return 1 if (failures or fallbacks) else 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 1000))
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    mode = sys.argv[2] if len(sys.argv) > 2 else "both"
+    rc = 0
+    if mode in ("plain", "both"):
+        rc |= main(n)
+    if mode in ("topo", "both"):
+        rc |= main(n, topo=True)
+    sys.exit(rc)
