@@ -31,12 +31,10 @@ reproducing the host loop's decisions bit-for-bit:
    matrices — exact, no device round-trip on the sequential path.
 
 Eligibility is checked first (`eligible`): solves with reserved capacity,
-minValues, or PreferNoSchedule relaxation — and pods with pod
-(anti-)affinity, preferred/multi-term node affinity, host ports, or
+minValues, or PreferNoSchedule relaxation — and pods with host ports or
 volumes — take the host path, which remains the semantics oracle.
-Topology-spread solves run the topo-aware driver (ops/ffd_topo.py); other
-topology machinery (pod-affinity groups, inverse anti-affinity from cluster
-pods) still declines to the host loop.
+Topology-engaged solves (spread, pod (anti-)affinity, inverse anti-affinity
+from cluster pods) run the topo-aware driver (ops/ffd_topo.py).
 """
 
 from __future__ import annotations
@@ -152,12 +150,24 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     return True
 
 
+def _has_pod_affinity_terms(aff) -> bool:
+    """Termless PodAffinity/PodAntiAffinity objects are inert — they create
+    no topology groups and the relax ladder skips them."""
+    pa = aff.pod_affinity
+    if pa is not None and (pa.required or pa.preferred):
+        return True
+    panti = aff.pod_anti_affinity
+    if panti is not None and (panti.required or panti.preferred):
+        return True
+    return False
+
+
 def _group_eligible(pod: Pod) -> bool:
     """Per-shape gates, checked once per distinct pod shape."""
     spec = pod.spec
     aff = spec.affinity
     if aff is not None:
-        if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
+        if _has_pod_affinity_terms(aff):
             return False
         na = aff.node_affinity
         if na is not None and (na.preferred or len(na.required) > 1):
@@ -234,7 +244,9 @@ def _raw_sig(pod: Pod) -> tuple:
     aff_sig: tuple = ()
     gates = 1
     if aff is not None:
-        if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
+        # non-empty only: must mirror _group_eligible so a termed pod can
+        # never share a signature with an eligible termless one
+        if _has_pod_affinity_terms(aff):
             gates |= 2
         na = aff.node_affinity
         if na is not None:
@@ -347,6 +359,13 @@ class _Node:
 
 class _Fallback(Exception):
     """Internal: abort the device solve and use the host loop."""
+
+
+class _IneligibleShape(_Fallback):
+    """A pod shape the current driver declines. From the plain driver this
+    triggers a retry on the topo driver (whose relax ladder handles
+    preferred/multi-term node affinity); from the topo driver it falls
+    back to the host loop."""
 
 
 class _NativeDriver:
@@ -1276,7 +1295,7 @@ class _DeviceSolve:
     def run(self, timeout: Optional[float]) -> None:
         gi_arr = self._group_pods()
         if gi_arr is None:
-            raise _Fallback("ineligible pod shape")
+            raise _IneligibleShape("ineligible pod shape")
         self._prepare_templates()
         order = self._order(gi_arr)
         from karpenter_tpu.ops import native as nat
@@ -1406,31 +1425,44 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         DEVICE_FALLBACKS += 1
         _FALLBACKS_CTR.inc()
         return None
+    from karpenter_tpu.ops import ffd_topo
+
+    if not ffd_topo.supported(scheduler):
+        DEVICE_FALLBACKS += 1
+        _FALLBACKS_CTR.inc()
+        return None
     topo = scheduler.topology
     if getattr(topo, "topology_groups", None) or getattr(
         topo, "inverse_topology_groups", None
     ):
-        from karpenter_tpu.ops import ffd_topo
-
-        if not ffd_topo.supported(scheduler):
-            DEVICE_FALLBACKS += 1
-            _FALLBACKS_CTR.inc()
-            return None
-        solve: _DeviceSolve = ffd_topo._TopoSolve(scheduler, pods)
+        attempts = [ffd_topo._TopoSolve]
     else:
-        solve = _DeviceSolve(scheduler, pods)
-    try:
-        solve.run(timeout)
-        solve.emit()
-    except _Fallback:
-        solve.abort()
-        DEVICE_FALLBACKS += 1
-        _FALLBACKS_CTR.inc()
-        return None
-    except Exception:
-        solve.abort()
-        if STRICT:
-            raise
+        # plain driver first (native kernel); shapes it declines that only
+        # need the relax ladder (preferred/multi-term node affinity) retry
+        # on the topo driver, which relaxes exactly like the host
+        attempts = [_DeviceSolve, ffd_topo._TopoSolve]
+    done = False
+    for cls in attempts:
+        solve = cls(scheduler, pods)
+        try:
+            solve.run(timeout)
+            solve.emit()
+            done = True
+            break
+        except _IneligibleShape:
+            solve.abort()
+            if cls is _DeviceSolve:
+                continue
+            break
+        except _Fallback:
+            solve.abort()
+            break
+        except Exception:
+            solve.abort()
+            if STRICT:
+                raise
+            break
+    if not done:
         DEVICE_FALLBACKS += 1
         _FALLBACKS_CTR.inc()
         return None

@@ -4,9 +4,10 @@ machinery engaged.
 The plain device path (ops/ffd.py) declines any solve with topology groups
 because topology breaks the monotonicity its caches rely on: a claim that
 rejects a pod for skew today may accept it after counts change. This module
-extends the grouped simulation to topology-spread solves (reference
-scheduling/topology.go + topologygroup.go:205-286) while preserving EXACT
-host-decision parity:
+extends the grouped simulation to topology-engaged solves — spread, pod
+affinity/anti-affinity, and inverse anti-affinity from existing cluster
+pods (reference scheduling/topology.go + topologygroup.go:205-408) — while
+preserving EXACT host-decision parity:
 
 - Pods collapse into shape groups keyed by the topo-aware signature (spec
   shape + namespace + labels + full constraint content — selectors match on
@@ -52,10 +53,10 @@ from karpenter_tpu.ops.ffd import (
     _DeviceSolve,
     _Fallback,
     _Group,
+    _IneligibleShape,
     _raw_sig,
 )
 from karpenter_tpu.scheduler import nodeclaim as ncmod
-from karpenter_tpu.scheduler.topology import TYPE_SPREAD
 from karpenter_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
     Operator,
@@ -101,15 +102,11 @@ def _intern_tsig(pod: Pod) -> int:
 def supported(scheduler) -> bool:
     """Can this topology-engaged solve run on the device path?
 
-    Phase 1: topology-spread groups only. Pod (anti-)affinity groups and
-    inverse anti-affinity (from existing cluster pods, topology.go:55-58)
-    still take the host loop."""
-    topo = scheduler.topology
-    if getattr(topo, "inverse_topology_groups", None):
-        return False
-    for tg in topo.topology_groups.values():
-        if tg.type != TYPE_SPREAD:
-            return False
+    All group types are handled: spread, pod (anti-)affinity, and inverse
+    anti-affinity from existing cluster pods (topology.go:55-58) — groups
+    touching a shape make it volatile (full host gate sequence per
+    candidate); everything else keeps the fast monotone path. The hook
+    remains as the gate point for future unsupported constructs."""
     return True
 
 
@@ -125,9 +122,20 @@ def _sel_sig(sel) -> Optional[tuple]:
     )
 
 
+def _aff_term_sig(term) -> tuple:
+    return (
+        term.topology_key,
+        _sel_sig(term.label_selector),
+        tuple(term.namespaces),
+        _sel_sig(term.namespace_selector),
+    )
+
+
 def _topo_sig(pod: Pod) -> tuple:
     """Shape signature for topology-engaged solves: the plain spec signature
-    plus namespace, labels (selector targets), and full constraint content."""
+    plus namespace, labels (selector targets), and full constraint content
+    (spread, pod (anti-)affinity incl. preferred terms, preferred node
+    affinity — all decision-relevant once topology groups exist)."""
     spec = pod.spec
     md = pod.metadata
     tsc = tuple(
@@ -143,26 +151,56 @@ def _topo_sig(pod: Pod) -> tuple:
         )
         for t in spec.topology_spread_constraints
     )
+    pa_sig: tuple = ()
+    panti_sig: tuple = ()
+    pref_na_sig: tuple = ()
+    aff = spec.affinity
+    if aff is not None:
+        if aff.pod_affinity is not None:
+            pa_sig = (
+                tuple(_aff_term_sig(t) for t in aff.pod_affinity.required),
+                tuple(
+                    (w.weight, _aff_term_sig(w.pod_affinity_term))
+                    for w in aff.pod_affinity.preferred
+                ),
+            )
+        if aff.pod_anti_affinity is not None:
+            panti_sig = (
+                tuple(_aff_term_sig(t) for t in aff.pod_anti_affinity.required),
+                tuple(
+                    (w.weight, _aff_term_sig(w.pod_affinity_term))
+                    for w in aff.pod_anti_affinity.preferred
+                ),
+            )
+        na = aff.node_affinity
+        if na is not None and na.preferred:
+            pref_na_sig = tuple(
+                (
+                    w.weight,
+                    tuple(
+                        (e["key"], e["operator"], tuple(e.get("values", ())))
+                        for e in w.preference.match_expressions
+                    ),
+                )
+                for w in na.preferred
+            )
     return (
         _raw_sig(pod),
         md.namespace,
         tuple(sorted(md.labels.items())) if md.labels else (),
         tsc,
+        pa_sig,
+        panti_sig,
+        pref_na_sig,
     )
 
 
 def _group_eligible_topo(pod: Pod) -> bool:
-    """Per-shape gates for topo mode: spread constraints are allowed; pod
-    (anti-)affinity, preferred/multi-term node affinity, ports and volumes
-    still decline (phase 2)."""
+    """Per-shape gates for topo mode: topology constraints of every kind are
+    allowed (spread, pod (anti-)affinity, preferred/multi-term node affinity
+    — the relax ladder and volatile paths handle them); host ports and
+    volumes still decline."""
     spec = pod.spec
-    aff = spec.affinity
-    if aff is not None:
-        if aff.pod_affinity is not None or aff.pod_anti_affinity is not None:
-            return False
-        na = aff.node_affinity
-        if na is not None and (na.preferred or len(na.required) > 1):
-            return False
     if any(c.ports for c in spec.containers):
         return False
     if getattr(spec, "volumes", None):
@@ -183,7 +221,11 @@ class _TopoSolve(_DeviceSolve):
         self.g_rec: list[list] = []  # groups whose selector matches the shape
         self.g_relaxable: list[bool] = []
         self._hostname_tgs = any(
-            tg.key == wk.LABEL_HOSTNAME for tg in self.topology.topology_groups.values()
+            tg.key == wk.LABEL_HOSTNAME
+            for tg in (
+                list(self.topology.topology_groups.values())
+                + list(self.topology.inverse_topology_groups.values())
+            )
         )
         self._saved_counts: list[tuple] = []
         self._relax_restore: dict[str, Pod] = {}
@@ -228,10 +270,15 @@ class _TopoSolve(_DeviceSolve):
         self.gsynced.append(0)
         self.nptr.append(0)
         topo = self.topology
-        owned = [
-            tg for tg in topo.topology_groups.values() if tg.is_owned_by(pod.metadata.uid)
-        ]
-        self.g_volatile.append(bool(owned))
+        uid = pod.metadata.uid
+        owned = any(tg.is_owned_by(uid) for tg in topo.topology_groups.values())
+        # inverse groups match via counts() = selects() (their node filter is
+        # the permissive zero value, topologynodefilter.go:27-40) — a shape
+        # an existing pod's anti-affinity selector matches is volatile too
+        inv_matched = any(
+            tg.selects(pod) for tg in topo.inverse_topology_groups.values()
+        )
+        self.g_volatile.append(owned or inv_matched)
         self.g_rec.append(
             [tg for tg in topo.topology_groups.values() if tg.selects(pod)]
         )
@@ -243,9 +290,13 @@ class _TopoSolve(_DeviceSolve):
         to remove for this shape? Mirrors Preferences.relax applicability."""
         spec = pod.spec
         aff = spec.affinity
-        if aff is not None and aff.node_affinity is not None:
+        if aff is not None:
             na = aff.node_affinity
-            if na.preferred or len(na.required) > 1:
+            if na is not None and (na.preferred or len(na.required) > 1):
+                return True
+            if aff.pod_affinity is not None and aff.pod_affinity.preferred:
+                return True
+            if aff.pod_anti_affinity is not None and aff.pod_anti_affinity.preferred:
                 return True
         if any(
             t.when_unsatisfiable == "ScheduleAnyway"
@@ -296,7 +347,10 @@ class _TopoSolve(_DeviceSolve):
     # -- record hooks (NodeClaim.add / ExistingNode.add tails) ---------------
 
     def _needs_record(self, gi: int) -> bool:
-        return bool(self.g_rec[gi]) or self._hostname_tgs or self.g_volatile[gi]
+        # only reached on non-volatile branches; inverse-group OWNERS have
+        # required anti-affinity and thus own a regular group too → volatile,
+        # so inverse record bookkeeping never needs gating here
+        return bool(self.g_rec[gi]) or self._hostname_tgs
 
     def _record_claim(self, pod: Pod, gi: int, c, reqs: Requirements) -> None:
         """register + record after a claim join (nodeclaim.go Add tail:
@@ -620,7 +674,7 @@ class _TopoSolve(_DeviceSolve):
     def run(self, timeout: Optional[float]) -> None:
         gi_arr = self._group_pods()
         if gi_arr is None:
-            raise _Fallback("ineligible pod shape")
+            raise _IneligibleShape("ineligible pod shape")
         self._prepare_templates()
         order = self._order(gi_arr)
         self._snapshot_topology()

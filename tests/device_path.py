@@ -1,0 +1,53 @@
+"""Shared both-paths harness for oracle suites.
+
+Oracle modules run every spec against BOTH solver paths — the host per-pod
+loop and the device fast path (plain or topo-aware driver). A module opts in
+with:
+
+    from device_path import both_paths_fixture
+    from test_scheduler import Env as HostEnv
+
+    Env = HostEnv
+    path = both_paths_fixture(globals())
+
+The device leg swaps the module-global `Env` for `DeviceEnv`, which attaches
+the kwok CatalogEngine, pins DEVICE_MIN_PODS to 1, turns on STRICT (so
+simulation bugs raise instead of silently falling back), and asserts
+DEVICE_SOLVES advanced on every solve — a silent fallback fails loudly.
+"""
+
+import pytest
+
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.catalog import CatalogEngine
+
+from test_scheduler import Env as HostEnv
+
+CATALOG = construct_instance_types()
+
+
+class DeviceEnv(HostEnv):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("engine", CatalogEngine(CATALOG))
+        super().__init__(**kwargs)
+
+    def schedule(self, pods, timeout=60.0):
+        s0 = ffd.DEVICE_SOLVES
+        results = super().schedule(pods, timeout=timeout)
+        assert ffd.DEVICE_SOLVES > s0, "expected the device path to run"
+        return results
+
+
+def both_paths_fixture(module_globals: dict):
+    """Autouse fixture parametrizing a module over host/device paths."""
+
+    @pytest.fixture(params=["host", "device"], autouse=True)
+    def path(request, monkeypatch):
+        if request.param == "device":
+            monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+            monkeypatch.setattr(ffd, "STRICT", True)
+            monkeypatch.setitem(module_globals, "Env", DeviceEnv)
+        return request.param
+
+    return path

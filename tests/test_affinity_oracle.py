@@ -1,6 +1,8 @@
 """Pod affinity / anti-affinity oracle: specs ported from the reference's
 topology suite (topology_test.go:1939-2930 — names kept, lines cited).
-Host-loop territory: pod (anti-)affinity shapes decline the device path."""
+Every spec runs on BOTH solver paths: the host per-pod loop and the
+topo-aware device driver (ops/ffd_topo.py), which must make identical
+decisions — device runs assert DEVICE_SOLVES advanced on every solve."""
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import (
@@ -12,8 +14,12 @@ from karpenter_tpu.apis.core import (
     WeightedPodAffinityTerm,
 )
 
+from device_path import both_paths_fixture
 from helpers import bind_pod, nodepool, registered_node, unschedulable_pod
-from test_scheduler import Env
+from test_scheduler import Env as HostEnv
+
+Env = HostEnv
+path = both_paths_fixture(globals())
 
 WEB = {"app": "web"}
 DB = {"app": "db"}

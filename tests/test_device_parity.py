@@ -25,9 +25,14 @@ from karpenter_tpu.apis.core import (
     LabelSelector,
     NodeAffinity,
     NodeSelectorTerm,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PreferredSchedulingTerm,
     Taint,
     Toleration,
     TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
 )
 from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
 from karpenter_tpu.ops import ffd
@@ -149,16 +154,95 @@ def _random_spread(rng: random.Random):
     return tsc
 
 
+def _random_aff_term(rng: random.Random, own_app: str):
+    key = rng.choice(
+        [wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME]
+    )
+    # sometimes target the pod's own app (self-affinity / one-per-domain
+    # anti-affinity), sometimes another app in the batch
+    target = own_app if rng.random() < 0.6 else rng.choice(APPS)
+    return PodAffinityTerm(
+        topology_key=key,
+        label_selector=LabelSelector(match_labels={"app": target}),
+    )
+
+
+def _random_pod_affinity(rng: random.Random, own_app: str) -> Affinity:
+    aff = Affinity()
+    roll = rng.random()
+    if roll < 0.45:
+        terms = [_random_aff_term(rng, own_app)]
+        if rng.random() < 0.3:
+            aff.pod_affinity = PodAffinity(preferred=[
+                WeightedPodAffinityTerm(weight=rng.randint(1, 100), pod_affinity_term=t)
+                for t in terms
+            ])
+        else:
+            aff.pod_affinity = PodAffinity(required=terms)
+    else:
+        terms = [_random_aff_term(rng, own_app)]
+        if rng.random() < 0.3:
+            aff.pod_anti_affinity = PodAntiAffinity(preferred=[
+                WeightedPodAffinityTerm(weight=rng.randint(1, 100), pod_affinity_term=t)
+                for t in terms
+            ])
+        else:
+            aff.pod_anti_affinity = PodAntiAffinity(required=terms)
+    return aff
+
+
+def _random_node_affinity(rng: random.Random) -> Affinity:
+    """Preferred and/or multi-term required node affinity (relax-ladder
+    coverage: preferences.go:70-83, 55-61)."""
+    na = NodeAffinity()
+    if rng.random() < 0.6:
+        na.preferred = [
+            PreferredSchedulingTerm(
+                weight=rng.randint(1, 100),
+                preference=NodeSelectorTerm(
+                    match_expressions=[
+                        {
+                            "key": wk.LABEL_TOPOLOGY_ZONE,
+                            "operator": "In",
+                            "values": rng.sample(ZONES, rng.randint(1, 2)),
+                        }
+                    ]
+                ),
+            )
+            for _ in range(rng.randint(1, 2))
+        ]
+    if rng.random() < 0.4 or not na.preferred:
+        na.required = [
+            NodeSelectorTerm(
+                match_expressions=[
+                    {
+                        "key": wk.LABEL_TOPOLOGY_ZONE,
+                        "operator": "In",
+                        "values": rng.sample(ZONES, rng.randint(1, 3)),
+                    }
+                ]
+            )
+            for _ in range(rng.randint(1, 2))
+        ]
+    return Affinity(node_affinity=na)
+
+
 def _random_shape(rng: random.Random, si: int, topo: bool = False):
     kwargs = {"requests": {"cpu": rng.choice(CPUS), "memory": rng.choice(MEMS)}}
     if topo:
+        own_app = rng.choice(APPS)
         if rng.random() < 0.8:
-            kwargs["labels"] = {"app": rng.choice(APPS)}
-        n_tsc = rng.choice([0, 1, 1, 1, 2]) if rng.random() < 0.55 else 0
+            kwargs["labels"] = {"app": own_app}
+        n_tsc = rng.choice([0, 1, 1, 1, 2]) if rng.random() < 0.45 else 0
         if n_tsc:
             kwargs["topology_spread_constraints"] = [
                 _random_spread(rng) for _ in range(n_tsc)
             ]
+        aff_roll = rng.random()
+        if aff_roll < 0.18:
+            kwargs["affinity"] = _random_pod_affinity(rng, own_app)
+        elif aff_roll < 0.3:
+            kwargs["affinity"] = _random_node_affinity(rng)
     selector = {}
     roll = rng.random()
     if roll < 0.3:
@@ -178,7 +262,7 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
         spec_kwargs["tolerations"] = [
             Toleration(key="team", operator="Equal", value="infra", effect="NoSchedule")
         ]
-    if rng.random() < 0.15:
+    if rng.random() < 0.15 and "affinity" not in kwargs:
         op = rng.choice(["In", "NotIn"])
         spec_kwargs["affinity"] = Affinity(
             node_affinity=NodeAffinity(
@@ -221,12 +305,31 @@ def build_case(seed: int, topo: bool = False):
         )
         nodes.append(node)
         if topo:
-            # live pods seed domain counts (topology.go countDomains)
+            # live pods seed domain counts (topology.go countDomains); some
+            # carry required anti-affinity, creating INVERSE topology groups
+            # that constrain even plain batch pods (topology.go:55-58)
             for j in range(rng.randint(0, 2)):
+                bp_kwargs = {}
+                if rng.random() < 0.25:
+                    bp_kwargs["affinity"] = Affinity(
+                        pod_anti_affinity=PodAntiAffinity(
+                            required=[
+                                PodAffinityTerm(
+                                    topology_key=rng.choice(
+                                        [wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME]
+                                    ),
+                                    label_selector=LabelSelector(
+                                        match_labels={"app": rng.choice(APPS)}
+                                    ),
+                                )
+                            ]
+                        )
+                    )
                 bp = unschedulable_pod(
                     name=f"bound-{i}-{j}",
                     requests={"cpu": "100m"},
                     labels={"app": rng.choice(APPS)} if rng.random() < 0.8 else {},
+                    **bp_kwargs,
                 )
                 bp.metadata.uid = f"bound-uid-{i}-{j}"
                 bp.metadata.creation_timestamp = 0.0

@@ -6,9 +6,10 @@ reference line cited per test class), each run against BOTH solver paths:
 - device: the batched fast path (engine on, DEVICE_MIN_PODS patched to 1)
 
 Device runs assert DEVICE_SOLVES advanced; specs whose features the device
-path intentionally declines (preferred affinities/relaxation, topology,
-hostname selectors, host ports, volumes) assert the fallback EXPLICITLY, so
-eligibility regressions can't hide. Deleting-node rescheduling specs
+path intentionally declines (hostname selectors, host ports, volumes)
+assert the fallback EXPLICITLY, so eligibility regressions can't hide.
+Topology and preferred-affinity/relaxation specs run the topo-aware driver
+(ops/ffd_topo.py) and must match host decisions exactly. Deleting-node rescheduling specs
 (suite_test.go:3545-3699) live with the provisioner/e2e tests instead —
 they exercise provisioner machinery, not Scheduler.solve.
 """
@@ -368,7 +369,7 @@ class TestPreferences:
                 )
             )
         )
-        results = schedule(path, [pod], device_falls_back=True)
+        results = schedule(path, [pod])
         [nc] = results.new_node_claims
         assert set(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list()) == {
             "kwok-zone-2"
@@ -392,7 +393,7 @@ class TestPreferences:
                 )
             )
         )
-        results = schedule(path, [pod], node_pools=pools, device_falls_back=True)
+        results = schedule(path, [pod], node_pools=pools)
         assert not results.pod_errors
 
     def test_relax_to_lighter_weights_first(self, path):
@@ -411,7 +412,7 @@ class TestPreferences:
                 )
             )
         )
-        results = schedule(path, [pod], device_falls_back=True)
+        results = schedule(path, [pod])
         [nc] = results.new_node_claims
         assert set(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list()) == {
             "kwok-zone-3"
@@ -443,7 +444,7 @@ class TestPreferences:
                 )
             )
         )
-        results = schedule(path, [pod], device_falls_back=True)
+        results = schedule(path, [pod])
         assert not results.pod_errors
         [nc] = results.new_node_claims
         assert set(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list()) == {

@@ -5,43 +5,15 @@ loop and the topo-aware device driver (ops/ffd_topo.py), which must make
 identical decisions — device runs assert DEVICE_SOLVES advanced on every
 solve, so an eligibility regression (silent fallback) fails loudly."""
 
-import pytest
-
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import LabelSelector, TopologySpreadConstraint
-from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
-from karpenter_tpu.ops import ffd
-from karpenter_tpu.ops.catalog import CatalogEngine
 
+from device_path import both_paths_fixture
 from helpers import bind_pod, nodepool, registered_node, unschedulable_pod
 from test_scheduler import Env as HostEnv
 
-CATALOG = construct_instance_types()
-
-
-class DeviceEnv(HostEnv):
-    def __init__(self, **kwargs):
-        kwargs.setdefault("engine", CatalogEngine(CATALOG))
-        super().__init__(**kwargs)
-
-    def schedule(self, pods, timeout=60.0):
-        s0 = ffd.DEVICE_SOLVES
-        results = super().schedule(pods, timeout=timeout)
-        assert ffd.DEVICE_SOLVES > s0, "expected the topo device path to run"
-        return results
-
-
 Env = HostEnv
-
-
-@pytest.fixture(params=["host", "device"], autouse=True)
-def path(request, monkeypatch):
-    if request.param == "device":
-        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
-        monkeypatch.setattr(ffd, "STRICT", True)
-        monkeypatch.setitem(globals(), "Env", DeviceEnv)
-    return request.param
-
+path = both_paths_fixture(globals())
 
 APP = {"app": "web"}
 
