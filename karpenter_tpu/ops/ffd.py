@@ -1443,8 +1443,9 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         attempts = [_DeviceSolve, ffd_topo._TopoSolve]
     done = False
     for cls in attempts:
-        solve = cls(scheduler, pods)
+        solve = None
         try:
+            solve = cls(scheduler, pods)
             solve.run(timeout)
             solve.emit()
             done = True
@@ -1458,7 +1459,8 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
             solve.abort()
             break
         except Exception:
-            solve.abort()
+            if solve is not None:
+                solve.abort()
             if STRICT:
                 raise
             break

@@ -8,6 +8,8 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import (
     Affinity,
     LabelSelector,
+    NodeAffinity,
+    NodeSelectorTerm,
     PodAffinity,
     PodAffinityTerm,
     PodAntiAffinity,
@@ -225,5 +227,340 @@ class TestPodAntiAffinity:
             weight=50, pod_affinity_term=term(match=WEB)
         )
         pods = [pod_with(preferred_anti=[preferred]) for _ in range(6)]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# Deep affinity specs (topology_test.go:1983-2837): late-committal zones,
+# self-affinity seeding, inverse anti-affinity with existing nodes.
+# Multi-pass specs use the materialize/store_skew harness.
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.apis.core import TopologySpreadConstraint
+
+from test_topology_oracle import materialize, store_skew
+
+S2 = {"security": "s2"}
+
+
+def s2_tsc(key=wk.LABEL_HOSTNAME):
+    return TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=key,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=dict(S2)),
+    )
+
+
+class TestPodAffinityDeep:
+    def test_pod_affinity_arch(self):
+        # topology_test.go:1983 — same arch, different hosts (TSC)
+        env = Env()
+        p1 = pod_with(
+            labels=dict(S2),
+            requests={"cpu": "2"},
+            node_selector={wk.LABEL_ARCH: "arm64"},
+            topology_spread_constraints=[s2_tsc()],
+        )
+        p2 = pod_with(
+            labels=dict(S2),
+            requests={"cpu": "1"},
+            affinity=[term(key=wk.LABEL_ARCH, match=S2)],
+            topology_spread_constraints=[s2_tsc()],
+        )
+        results = env.schedule([p1, p2])
+        assert not results.pod_errors
+        claims = results.new_node_claims
+        assert len(claims) == 2
+        archs = [c.requirements.get(wk.LABEL_ARCH).values_list() for c in claims]
+        assert archs == [["arm64"], ["arm64"]]
+
+    def test_self_affinity_first_empty_domain_only_hostname(self):
+        # topology_test.go:2050 — self hostname affinity seeds exactly ONE
+        # domain; overflow pods fail rather than opening a second node
+        np = nodepool(
+            "default",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "In",
+                    "values": ["c-1x-amd64-linux"],
+                }
+            ],
+        )
+        env = Env(node_pools=[np])
+
+        def batch():
+            return [
+                pod_with(
+                    labels=dict(S2),
+                    requests={"cpu": "170m"},  # 5 fit on c-1x's 0.9 cpu
+                    affinity=[term(key=wk.LABEL_HOSTNAME, match=S2)],
+                )
+                for _ in range(10)
+            ]
+
+        first = env.schedule(batch())
+        assert len(first.new_node_claims) == 1
+        assert len(first.new_node_claims[0].pods) == 5
+        assert len(first.pod_errors) == 5
+        materialize(env, first, "p1")
+        second = env.schedule(batch())
+        assert len(second.pod_errors) == 10
+
+    def test_self_affinity_hostname_constrained_zones(self):
+        # topology_test.go:2092 — pod affinity ignores node-selector
+        # restrictions on counting: the zone-1 pod's hostname domain is the
+        # only candidate, unreachable from zones 2/3
+        env = Env()
+        first = env.schedule(
+            [
+                pod_with(
+                    labels=dict(S2),
+                    node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"},
+                    affinity=[term(key=wk.LABEL_HOSTNAME, match=S2)],
+                )
+            ]
+        )
+        assert not first.pod_errors
+        materialize(env, first, "p1")
+        pods = []
+        for _ in range(10):
+            p = pod_with(labels=dict(S2), affinity=[term(key=wk.LABEL_HOSTNAME, match=S2)])
+            p.spec.affinity.node_affinity = NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": wk.LABEL_TOPOLOGY_ZONE,
+                                "operator": "In",
+                                "values": ["kwok-zone-2", "kwok-zone-3"],
+                            }
+                        ]
+                    )
+                ]
+            )
+            pods.append(p)
+        second = env.schedule(pods)
+        assert len(second.pod_errors) == 10
+
+    def test_self_affinity_zone(self):
+        # topology_test.go:2136 — three self-affine pods share one claim
+        env = Env()
+        results = env.schedule(
+            [
+                pod_with(labels=dict(S2), affinity=[term(match=S2)])
+                for _ in range(3)
+            ]
+        )
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+
+    def test_self_affinity_zone_with_constraint(self):
+        # topology_test.go:2160 — self zone affinity + zone-3 restriction
+        env = Env()
+        pods = []
+        for _ in range(3):
+            p = pod_with(labels=dict(S2), affinity=[term(match=S2)])
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-3"}
+            pods.append(p)
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+        assert results.new_node_claims[0].requirements.get(
+            wk.LABEL_TOPOLOGY_ZONE
+        ).values_list() == ["kwok-zone-3"]
+
+
+class TestAntiAffinityDeep:
+    def test_anti_affinity_other_schedules_first(self):
+        # topology_test.go:2371 — the avoided pod schedules first into an
+        # uncommitted zone, so the anti pod can't schedule anywhere
+        env = Env()
+        avoided = pod_with(labels=dict(S2), requests={"cpu": "2"})
+        anti = pod_with(labels={}, anti=[term(match=S2)])
+        results = env.schedule([avoided, anti])
+        assert anti in results.pod_errors
+        assert avoided not in results.pod_errors
+
+    def test_anti_affinity_schroedinger(self):
+        # topology_test.go:2512 — an uncommitted anti pod blocks the batch;
+        # once its node exists the target schedules in a different zone
+        env = Env()
+        zone_anywhere = pod_with(labels={}, anti=[term(match=S2)], requests={"cpu": "2"})
+        aff = pod_with(labels=dict(S2))
+        first = env.schedule([zone_anywhere, aff])
+        assert aff in first.pod_errors
+        assert zone_anywhere not in first.pod_errors
+        materialize(env, first, "p1")
+        committed = {
+            env.store.try_get("Node", f"p1-{i}").metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+            for i in range(len(first.new_node_claims))
+        }
+        second = env.schedule([aff])
+        assert not second.pod_errors
+        aff_zones = set()
+        for nc in second.new_node_claims:
+            aff_zones.update(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list())
+        for en in second.existing_nodes:
+            if en.pods:
+                aff_zones.add(en.labels().get(wk.LABEL_TOPOLOGY_ZONE))
+        assert aff_zones, "aff pod did not land"
+        assert not (aff_zones & committed)
+
+    def test_anti_affinity_inverse_with_existing_nodes(self):
+        # topology_test.go:2543 — existing pods with zone anti-affinity in
+        # every zone repel a plain matching pod entirely
+        env = Env()
+        zone_pods = []
+        for z in ("kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"):
+            p = pod_with(labels={}, anti=[term(match=S2)], requests={"cpu": "2"})
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: z}
+            zone_pods.append(p)
+        first = env.schedule(zone_pods)
+        assert not first.pod_errors
+        materialize(env, first, "p1")
+        second = env.schedule([pod_with(labels=dict(S2))])
+        assert len(second.pod_errors) == 1
+
+    def test_preferred_anti_affinity_inverse_with_existing_nodes(self):
+        # topology_test.go:2593 — preferred inverse anti-affinity does not
+        # repel once committed (only required terms are tracked inversely)
+        env = Env()
+        zone_pods = []
+        for z in ("kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"):
+            p = pod_with(
+                labels={},
+                preferred_anti=[
+                    WeightedPodAffinityTerm(weight=10, pod_affinity_term=term(match=S2))
+                ],
+                requests={"cpu": "2"},
+            )
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: z}
+            zone_pods.append(p)
+        first = env.schedule(zone_pods)
+        assert not first.pod_errors
+        materialize(env, first, "p1")
+        second = env.schedule([pod_with(labels=dict(S2))])
+        assert not second.pod_errors
+
+    def test_affinity_preference_violated_with_conflicting_required_tsc(self):
+        # topology_test.go:2643 — hostname spread wins over a pod-affinity
+        # preference; everything schedules across three hosts
+        env = Env()
+        aff_target = pod_with(labels=dict(S2))
+        spread_pods = [
+            pod_with(
+                labels=dict(WEB),
+                preferred=[
+                    WeightedPodAffinityTerm(
+                        weight=50, pod_affinity_term=term(key=wk.LABEL_HOSTNAME, match=S2)
+                    )
+                ],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_HOSTNAME,
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels=dict(WEB)),
+                    )
+                ],
+            )
+            for _ in range(3)
+        ]
+        results = env.schedule(spread_pods + [aff_target])
+        assert not results.pod_errors
+        web_hosts = set()
+        for nc in results.new_node_claims:
+            if any(p.metadata.labels.get("app") == "web" for p in nc.pods):
+                web_hosts.add(nc.hostname)
+                assert sum(p.metadata.labels.get("app") == "web" for p in nc.pods) == 1
+        assert len(web_hosts) == 3
+
+    def test_anti_affinity_zone_topology_batches(self):
+        # topology_test.go:2678 — late committal: one pod lands per batch
+        # until every zone is occupied, then none
+        env = Env()
+
+        def batch():
+            return [
+                pod_with(labels=dict(S2), anti=[term(match=S2)]) for _ in range(3)
+            ]
+
+        for i, expected in enumerate([[1], [1, 1], [1, 1, 1], [1, 1, 1, 1]]):
+            results = env.schedule(batch())
+            scheduled = sum(len(nc.pods) for nc in results.new_node_claims) + sum(
+                len(en.pods) for en in results.existing_nodes
+            )
+            assert scheduled == 1, (i, scheduled)
+            materialize(env, results, f"p{i}")
+            assert store_skew(env, match=S2) == expected
+        results = env.schedule(batch())
+        assert len(results.pod_errors) == 3
+
+
+class TestAffinityTargets:
+    def test_affinity_to_non_existent_pod(self):
+        # topology_test.go:2723
+        env = Env()
+        results = env.schedule(
+            [pod_with(labels={}, affinity=[term(match=S2)]) for _ in range(10)]
+        )
+        assert len(results.pod_errors) == 10
+
+    def test_affinity_unconstrained_target_two_batches(self):
+        # topology_test.go:2740 — the target's zone commits on node
+        # creation; followers join it in the second batch
+        env = Env()
+        target = pod_with(labels=dict(S2))
+        followers = [
+            pod_with(labels={}, affinity=[term(match=S2)]) for _ in range(10)
+        ]
+        first = env.schedule([target] + followers)
+        assert len(first.pod_errors) == 10
+        materialize(env, first, "p1")
+        target_zone = [
+            env.store.try_get("Node", "p1-0").metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+        ]
+        second = env.schedule([pod_with(labels={}, affinity=[term(match=S2)]) for _ in range(10)])
+        assert not second.pod_errors
+        zones = set()
+        for nc in second.new_node_claims:
+            zones.update(nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list())
+        for en in second.existing_nodes:
+            if en.pods:
+                zones.add(en.labels().get(wk.LABEL_TOPOLOGY_ZONE))
+        assert zones <= set(target_zone), (zones, target_zone)
+
+    def test_affinity_constrained_target_single_batch(self):
+        # topology_test.go:2773 — a zone-pinned target lets followers
+        # co-schedule in one batch
+        env = Env()
+        target = pod_with(labels=dict(S2))
+        target.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"}
+        followers = [
+            pod_with(labels={}, affinity=[term(match=S2)]) for _ in range(10)
+        ]
+        results = env.schedule([target] + followers)
+        assert not results.pod_errors
+        for nc in results.new_node_claims:
+            assert nc.requirements.get(wk.LABEL_TOPOLOGY_ZONE).values_list() == [
+                "kwok-zone-1"
+            ]
+
+    def test_multiple_dependent_affinities(self):
+        # topology_test.go:2802 — db -> web -> cache -> ui hostname chain
+        env = Env()
+        db = {"type": "db", "spread": "spread"}
+        web = {"type": "web", "spread": "spread"}
+        cache = {"type": "cache", "spread": "spread"}
+        ui = {"type": "ui", "spread": "spread"}
+        pods = [
+            pod_with(labels=db),
+            pod_with(labels=web, affinity=[term(key=wk.LABEL_HOSTNAME, match=db)]),
+            pod_with(labels=cache, affinity=[term(key=wk.LABEL_HOSTNAME, match=web)]),
+            pod_with(labels=ui, affinity=[term(key=wk.LABEL_HOSTNAME, match=cache)]),
+        ]
         results = env.schedule(pods)
         assert not results.pod_errors

@@ -5,8 +5,18 @@ loop and the topo-aware device driver (ops/ffd_topo.py), which must make
 identical decisions — device runs assert DEVICE_SOLVES advanced on every
 solve, so an eligibility regression (silent fallback) fails loudly."""
 
+import copy as _copy
+
 from karpenter_tpu.apis import labels as wk
-from karpenter_tpu.apis.core import LabelSelector, TopologySpreadConstraint
+from karpenter_tpu.apis.core import (
+    Affinity,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.utils import pod as podutil
 
 from device_path import both_paths_fixture
 from helpers import bind_pod, nodepool, registered_node, unschedulable_pod
@@ -348,3 +358,725 @@ class TestInterdependentSelectors:
         ]
         results = env.schedule(pods)
         assert not results.pod_errors
+
+
+# ---------------------------------------------------------------------------
+# Multi-pass harness: ExpectProvisioned / ExpectApplied / ExpectSkew analogs
+# (test/expectations/expectations.go:617-642; kwok node fabrication)
+# ---------------------------------------------------------------------------
+
+def materialize(env, results, prefix):
+    """ExpectProvisioned analog: fabricate a registered Node per new claim
+    the way the kwok provider does at launch — cheapest compatible instance
+    type, then cheapest available compatible offering; single-valued claim/
+    type/offering requirements stamped as labels (provider.py:69-120) — but
+    sized exactly to the claim's accumulated requests (the reference's
+    rr-sized fake nodes are full once their pods land), and bind the claim's
+    pods in the store so later passes count them as live cluster pods."""
+    from karpenter_tpu.apis import labels as _wk
+
+    for i, nc in enumerate(results.new_node_claims):
+        it = min(nc.instance_type_options, key=lambda t: min(o.price for o in t.offerings))
+        offering = next(
+            o
+            for o in sorted(it.offerings, key=lambda o: o.price)
+            if o.available
+            and nc.requirements.is_compatible(
+                o.requirements, allow_undefined=_wk.WELL_KNOWN_LABELS
+            )
+        )
+        labels = {}
+        for source in (nc.requirements, it.requirements, offering.requirements):
+            for r in source:
+                if not r.complement and len(r.values) == 1 and r.key != wk.LABEL_HOSTNAME:
+                    labels[r.key] = next(iter(r.values))
+        labels[wk.LABEL_TOPOLOGY_ZONE] = offering.zone
+        labels[wk.CAPACITY_TYPE_LABEL_KEY] = offering.capacity_type
+        node = registered_node(
+            name=f"{prefix}-{i}",
+            pool=nc.nodepool_name,
+            instance_type=it.name,
+            zone=offering.zone,
+            labels=labels,
+        )
+        cap = dict(nc.requests)
+        cap.setdefault("pods", float(len(nc.pods)))
+        node.status.capacity = cap
+        node.status.allocatable = dict(cap)
+        env.store.create(node)
+        for p in nc.pods:
+            bound = _copy.deepcopy(p)
+            bind_pod(bound, node)
+            env.store.create(bound)
+    for en in results.existing_nodes:
+        node = env.store.try_get("Node", en.name())
+        for p in en.pods:
+            bound = _copy.deepcopy(p)
+            bind_pod(bound, node)
+            env.store.create(bound)
+    env.informer.flush()
+
+
+def reapply(env, np):
+    """ExpectApplied analog for a mutated NodePool: bump its version so the
+    memoized domain-group scan (topology.py build_domain_groups) re-runs."""
+    env.store.update(np)
+
+
+def store_skew(env, key=wk.LABEL_TOPOLOGY_ZONE, match=None, namespace="default"):
+    """ExpectSkew analog over the store (expectations.go:617-642): selector-
+    matched pods in the namespace (TopologyListOptions is namespace-scoped),
+    non-ignored, counted by their node's topology label (node NAME for
+    hostname)."""
+    match = APP if match is None else match
+    counts: dict[str, int] = {}
+    for p in env.store.list("Pod", namespace=namespace):
+        if any(p.metadata.labels.get(k) != v for k, v in match.items()):
+            continue
+        if not podutil.is_scheduled(p) or podutil.is_terminal(p) or podutil.is_terminating(p):
+            continue
+        node = env.store.try_get("Node", p.spec.node_name)
+        if node is None:
+            continue
+        if key == wk.LABEL_HOSTNAME:
+            counts[node.metadata.name] = counts.get(node.metadata.name, 0) + 1
+        else:
+            domain = node.metadata.labels.get(key)
+            if domain is not None:
+                counts[domain] = counts.get(domain, 0) + 1
+    return sorted(counts.values())
+
+
+def zone_req(*zones):
+    return {
+        "key": wk.LABEL_TOPOLOGY_ZONE,
+        "operator": "In",
+        "values": list(zones),
+    }
+
+
+class TestNodePoolZonalSubsets:
+    def test_subset_with_requirements(self):
+        # topology_test.go:144
+        env = Env(node_pools=[nodepool("default", requirements=[zone_req("kwok-zone-1", "kwok-zone-2")])])
+        results = env.schedule([web_pod([spread()]) for _ in range(4)])
+        assert not results.pod_errors
+        assert skew_multiset(results) == [2, 2]
+        assert all(
+            set(z) <= {"kwok-zone-1", "kwok-zone-2"} for z in zone_counts(results)
+        )
+
+    def test_subset_with_labels(self):
+        # topology_test.go:160 — a template zone LABEL narrows the universe
+        # to that single zone
+        env = Env(node_pools=[nodepool("default", labels={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"})])
+        results = env.schedule([web_pod([spread()]) for _ in range(4)])
+        assert not results.pod_errors
+        assert skew_multiset(results) == [4]
+
+    def test_subset_with_requirements_and_labels(self):
+        # topology_test.go:175
+        env = Env(
+            node_pools=[
+                nodepool(
+                    "default",
+                    requirements=[zone_req("kwok-zone-1", "kwok-zone-2")],
+                    labels={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"},
+                )
+            ]
+        )
+        results = env.schedule([web_pod([spread()]) for _ in range(4)])
+        assert not results.pod_errors
+        assert skew_multiset(results) == [4]
+
+    def test_subset_with_labels_across_nodepools(self):
+        # topology_test.go:191 — two pools each pinned by label; the universe
+        # is the union of the pinned zones
+        env = Env(
+            node_pools=[
+                nodepool(
+                    "default",
+                    requirements=[zone_req("kwok-zone-1", "kwok-zone-2")],
+                    labels={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"},
+                ),
+                nodepool("pool-b", labels={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"}),
+            ]
+        )
+        results = env.schedule([web_pod([spread()]) for _ in range(4)])
+        assert not results.pod_errors
+        assert skew_multiset(results) == [2, 2]
+
+
+class TestMultiPassSkew:
+    def test_zonal_constraints_existing_pod(self):
+        # topology_test.go:219 — an existing out-of-universe pod holds the
+        # min count; the narrowed pool takes maxSkew above it per zone
+        np = nodepool("default")
+        env = Env(node_pools=[np])
+        p0 = unschedulable_pod(
+            requests={"cpu": "1.1"},
+            labels=dict(APP),
+            node_selector={wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-3"},
+        )
+        first = env.schedule([p0])
+        assert not first.pod_errors
+        materialize(env, first, "pass1")
+        np.spec.template.spec.requirements = [zone_req("kwok-zone-1", "kwok-zone-2")]
+        reapply(env, np)
+        second = env.schedule(
+            [web_pod([spread()], requests={"cpu": "1.1"}) for _ in range(6)]
+        )
+        assert len(second.pod_errors) == 2
+        materialize(env, second, "pass2")
+        assert store_skew(env) == [1, 2, 2]
+
+    def test_only_schedule_to_minimum_domains_if_violating_skew(self):
+        # topology_test.go:295 — deleting pods creates skew; new pods recover
+        # it by landing in the min-count domains only (3 zones as upstream)
+        np = nodepool("default", requirements=[zone_req("kwok-zone-1", "kwok-zone-2", "kwok-zone-3")])
+        env = Env(node_pools=[np])
+        first = env.schedule(
+            [web_pod([spread()], requests={"cpu": "1.1"}) for _ in range(9)]
+        )
+        assert not first.pod_errors
+        assert skew_multiset(first) == [3, 3, 3]
+        materialize(env, first, "pass1")
+        for p in env.store.list("Pod"):
+            node = env.store.try_get("Node", p.spec.node_name)
+            if node and node.metadata.labels.get(wk.LABEL_TOPOLOGY_ZONE) != "kwok-zone-1":
+                env.store.delete("Pod", p.metadata.name, p.metadata.namespace)
+        env.informer.flush()
+        assert store_skew(env) == [3]
+        second = env.schedule(
+            [web_pod([spread()], requests={"cpu": "1.1"}) for _ in range(3)]
+        )
+        assert not second.pod_errors
+        materialize(env, second, "pass2")
+        assert store_skew(env) == [1, 2, 3]
+
+    def test_do_not_schedule_respects_prior_pass_counts(self):
+        # topology_test.go:334 — a pod forced into zone-1, then the pool
+        # narrowed to zones 2/3: two per zone, the rest unschedulable
+        np = nodepool("default", requirements=[zone_req("kwok-zone-1")])
+        env = Env(node_pools=[np])
+        first = env.schedule([web_pod([spread()], requests={"cpu": "1.1"})])
+        assert not first.pod_errors
+        materialize(env, first, "pass1")
+        np.spec.template.spec.requirements = [zone_req("kwok-zone-2", "kwok-zone-3")]
+        reapply(env, np)
+        second = env.schedule(
+            [web_pod([spread()], requests={"cpu": "1.1"}) for _ in range(10)]
+        )
+        assert len(second.pod_errors) == 6
+        materialize(env, second, "pass2")
+        assert store_skew(env) == [1, 2, 2]
+
+    def test_do_not_schedule_discovers_domains_from_unconstrained_pod(self):
+        # topology_test.go:367 — the first pod carries NO constraint; its
+        # zone still seeds the skew count for later constrained pods
+        np = nodepool("default", requirements=[zone_req("kwok-zone-1")])
+        env = Env(node_pools=[np])
+        first = env.schedule(
+            [unschedulable_pod(requests={"cpu": "1.1"}, labels=dict(APP))]
+        )
+        assert not first.pod_errors
+        materialize(env, first, "pass1")
+        np.spec.template.spec.requirements = [zone_req("kwok-zone-2", "kwok-zone-3")]
+        reapply(env, np)
+        second = env.schedule(
+            [web_pod([spread()], requests={"cpu": "1.1"}) for _ in range(10)]
+        )
+        assert len(second.pod_errors) == 6
+        materialize(env, second, "pass2")
+        assert store_skew(env) == [1, 2, 2]
+
+    def test_capacity_type_do_not_schedule_multi_pass(self):
+        # topology_test.go:668 — spot pod first, then on-demand-only pool:
+        # on-demand takes min+skew = 2, the rest fail
+        np = nodepool(
+            "default",
+            requirements=[
+                {
+                    "key": wk.CAPACITY_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": [wk.CAPACITY_TYPE_SPOT],
+                }
+            ],
+        )
+        env = Env(node_pools=[np])
+        ct_spread = spread(key=wk.CAPACITY_TYPE_LABEL_KEY)
+        first = env.schedule([web_pod([ct_spread], requests={"cpu": "1.1"})])
+        assert not first.pod_errors
+        materialize(env, first, "pass1")
+        np.spec.template.spec.requirements = [
+            {
+                "key": wk.CAPACITY_TYPE_LABEL_KEY,
+                "operator": "In",
+                "values": [wk.CAPACITY_TYPE_ON_DEMAND],
+            }
+        ]
+        reapply(env, np)
+        second = env.schedule(
+            [
+                web_pod([spread(key=wk.CAPACITY_TYPE_LABEL_KEY)], requests={"cpu": "1.1"})
+                for _ in range(5)
+            ]
+        )
+        assert len(second.pod_errors) == 3
+        materialize(env, second, "pass2")
+        assert store_skew(env, key=wk.CAPACITY_TYPE_LABEL_KEY) == [1, 2]
+
+
+class TestTopologyCountingFilters:
+    def test_only_counts_running_scheduled_matching_pods(self):
+        # topology_test.go:399 — pending, terminal, terminating, unlabeled,
+        # wrong-namespace, and domainless-node pods are all ignored
+        np = nodepool("default", requirements=[zone_req("kwok-zone-1", "kwok-zone-2", "kwok-zone-3")])
+        n1 = registered_node(name="n1", zone="kwok-zone-1")
+        n2 = registered_node(name="n2", zone="kwok-zone-2")
+        n3 = registered_node(name="n3", zone="kwok-zone-1")
+        del n3.metadata.labels[wk.LABEL_TOPOLOGY_ZONE]  # missing domain
+        seeds = []
+
+        def seed(name, labels=None, node=None, phase=None, deleting=False, namespace="default"):
+            p = unschedulable_pod(name=name, requests={"cpu": "10m"}, labels=labels or {})
+            p.metadata.namespace = namespace
+            if node is not None:
+                bind_pod(p, node)
+            if phase:
+                p.status.phase = phase
+            if deleting:
+                p.metadata.deletion_timestamp = 10.0
+            seeds.append(p)
+
+        seed("ignored-unlabeled", labels={}, node=n1)
+        seed("ignored-pending", labels=dict(APP))  # not bound
+        seed("ignored-no-domain", labels=dict(APP), node=n3)
+        seed("ignored-wrong-ns", labels=dict(APP), node=n1, namespace="other")
+        seed("ignored-terminating", labels=dict(APP), node=n1, deleting=True)
+        seed("ignored-failed", labels=dict(APP), node=n1, phase="Failed")
+        seed("ignored-succeeded", labels=dict(APP), node=n1, phase="Succeeded")
+        seed("counted-1", labels=dict(APP), node=n1)
+        seed("counted-2", labels=dict(APP), node=n1)
+        seed("counted-3", labels=dict(APP), node=n2)
+        env = Env(node_pools=[np], state_nodes=[n1, n2, n3], pods=seeds)
+        results = env.schedule([web_pod([spread()]) for _ in range(2)])
+        assert not results.pod_errors
+        materialize(env, results, "pass1")
+        assert store_skew(env) == [1, 2, 2]
+
+
+class TestMinDomainsExpanded:
+    def test_min_domains_greater_than_minimum(self):
+        # topology_test.go:509 — minDomains=2 over 3 zones, 11 pods
+        env = Env(
+            node_pools=[
+                nodepool("default", requirements=[zone_req("kwok-zone-1", "kwok-zone-2", "kwok-zone-3")])
+            ]
+        )
+        results = env.schedule(
+            [web_pod([spread(min_domains=2)]) for _ in range(11)]
+        )
+        assert not results.pod_errors
+        assert skew_multiset(results) == [3, 4, 4]
+
+
+class TestHostnameBalancing:
+    def test_balance_pods_across_nodes(self):
+        # topology_test.go:532
+        env = Env()
+        results = env.schedule(
+            [web_pod([spread(key=wk.LABEL_HOSTNAME)]) for _ in range(4)]
+        )
+        assert not results.pod_errors
+        assert sorted(len(nc.pods) for nc in results.new_node_claims) == [1, 1, 1, 1]
+
+    def test_balance_same_hostname_up_to_max_skew(self):
+        # topology_test.go:545 — maxSkew 4 lets all four share one node
+        env = Env()
+        results = env.schedule(
+            [web_pod([spread(key=wk.LABEL_HOSTNAME, max_skew=4)]) for _ in range(4)]
+        )
+        assert not results.pod_errors
+        assert sorted(len(nc.pods) for nc in results.new_node_claims) == [4]
+
+    def test_balance_multiple_deployments_hostname(self):
+        # topology_test.go:558 (issue #1425) — two deployments spread over
+        # hostname land on the minimum two nodes
+        env = Env()
+        pods = []
+        for app in ("app1", "app2"):
+            for _ in range(2):
+                pods.append(
+                    web_pod(
+                        [spread(key=wk.LABEL_HOSTNAME, selector=LabelSelector(match_labels={"app": app}))],
+                        labels={"app": app},
+                    )
+                )
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 2
+
+    def test_balance_multiple_deployments_hostname_varying_arch(self):
+        # topology_test.go:594 — same, but arch split forces four nodes
+        env = Env()
+        pods = []
+        for app, arch in (("app1", "amd64"), ("app2", "arm64")):
+            for _ in range(2):
+                pods.append(
+                    unschedulable_pod(
+                        requests={"cpu": "100m"},
+                        labels={"app": app},
+                        node_selector={wk.LABEL_ARCH: arch},
+                        topology_spread_constraints=[
+                            spread(
+                                key=wk.LABEL_HOSTNAME,
+                                selector=LabelSelector(match_labels={"app": app}),
+                            )
+                        ],
+                    )
+                )
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 4
+
+
+def store_max_skew(env, key=wk.LABEL_TOPOLOGY_ZONE, match=None):
+    """ExpectMaxSkew analog (suite_test.go:4603-4640): max-min over counted
+    domains."""
+    counts = store_skew(env, key=key, match=match)
+    return (counts[-1] - counts[0]) if counts else 0
+
+
+class TestNodeInclusionPolicies:
+    """topology_test.go:1193-1674 — NodeTaintsPolicy / NodeAffinityPolicy
+    control which EXISTING nodes seed the domain universe."""
+
+    def _tainted_node(self, name, label_value, extra_labels=None):
+        from karpenter_tpu.apis.core import Taint
+
+        return registered_node(
+            name=name,
+            capacity={"cpu": "100m", "memory": "1Gi", "pods": "110"},
+            labels={"fake-label": label_value, **(extra_labels or {})},
+            taints=[Taint(key="taintname", value="taintvalue", effect="NoSchedule")],
+        )
+
+    def test_node_taints_policy_ignore(self):
+        # topology_test.go:1193 — tainted nodes still seed domains; only the
+        # pool's own domain is schedulable so a single pod lands
+        np = nodepool("default", labels={"fake-label": "baz"})
+        nodes = [self._tainted_node("tn1", "foo"), self._tainted_node("tn2", "bar")]
+        env = Env(node_pools=[np], state_nodes=nodes)
+        results = env.schedule(
+            [
+                web_pod(
+                    [spread(key="fake-label", node_taints_policy="Ignore")],
+                    requests={"cpu": "1"},
+                )
+                for _ in range(5)
+            ]
+        )
+        assert len(results.pod_errors) == 4
+        materialize(env, results, "p1")
+        assert store_skew(env, key="fake-label") == [1]
+
+    def test_node_taints_policy_honor(self):
+        # topology_test.go:1264 — intolerable tainted nodes are excluded
+        # from domain discovery; the single remaining domain takes all pods
+        np = nodepool("default", labels={"fake-label": "baz"})
+        nodes = [self._tainted_node("tn1", "foo"), self._tainted_node("tn2", "bar")]
+        env = Env(node_pools=[np], state_nodes=nodes)
+        results = env.schedule(
+            [
+                web_pod(
+                    [spread(key="fake-label", node_taints_policy="Honor")],
+                    requests={"cpu": "1"},
+                )
+                for _ in range(5)
+            ]
+        )
+        assert not results.pod_errors
+        materialize(env, results, "p1")
+        assert store_skew(env, key="fake-label") == [5]
+
+    def _affinity_node(self, name, label_value):
+        return registered_node(
+            name=name,
+            capacity={"cpu": "100m", "memory": "1Gi", "pods": "110"},
+            labels={"fake-label": label_value, "selector": "mismatch"},
+        )
+
+    def test_node_affinity_policy_ignore(self):
+        # topology_test.go:1542 — nodes the pod's selector can't reach still
+        # seed domains, so skew blocks all but one pod
+        np = nodepool("default", labels={"fake-label": "baz", "selector": "value"})
+        nodes = [self._affinity_node("an1", "foo"), self._affinity_node("an2", "bar")]
+        env = Env(node_pools=[np], state_nodes=nodes)
+        results = env.schedule(
+            [
+                unschedulable_pod(
+                    requests={"cpu": "1"},
+                    labels=dict(APP),
+                    node_selector={"selector": "value"},
+                    topology_spread_constraints=[
+                        spread(key="fake-label", node_affinity_policy="Ignore")
+                    ],
+                )
+                for _ in range(5)
+            ]
+        )
+        assert len(results.pod_errors) == 4
+        materialize(env, results, "p1")
+        assert store_skew(env, key="fake-label") == [1]
+
+    def test_node_affinity_policy_honor(self):
+        # topology_test.go:1609 — default Honor: unreachable nodes don't
+        # seed domains; all pods land in the single reachable domain
+        np = nodepool("default", labels={"fake-label": "baz", "selector": "value"})
+        nodes = [self._affinity_node("an1", "foo"), self._affinity_node("an2", "bar")]
+        env = Env(node_pools=[np], state_nodes=nodes)
+        results = env.schedule(
+            [
+                unschedulable_pod(
+                    requests={"cpu": "1"},
+                    labels=dict(APP),
+                    node_selector={"selector": "value"},
+                    topology_spread_constraints=[
+                        spread(key="fake-label", node_affinity_policy="Honor")
+                    ],
+                )
+                for _ in range(5)
+            ]
+        )
+        assert not results.pod_errors
+        materialize(env, results, "p1")
+        assert store_skew(env, key="fake-label") == [5]
+
+
+class TestSpreadOptionLimiting:
+    """topology_test.go:1753-1937 — node selectors/affinity narrow each
+    pod's own domain choices without removing discovered domains."""
+
+    def test_limit_spread_by_node_selector(self):
+        # topology_test.go:1753 — zone pinned per pod: each pod's only valid
+        # domain is its own zone, so both batches pack freely
+        env = Env()
+        pods = [
+            web_pod([spread()], labels=dict(APP))
+            for _ in range(5)
+        ]
+        for p in pods:
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-1"}
+        pods2 = [
+            web_pod([spread()], labels=dict(APP))
+            for _ in range(10)
+        ]
+        for p in pods2:
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "kwok-zone-2"}
+        results = env.schedule(pods + pods2)
+        assert not results.pod_errors
+        assert skew_multiset(results) == [5, 10]
+
+    def test_limit_spread_by_node_requirements(self):
+        # topology_test.go:1779 — both zones allowed per pod: spread evenly
+        env = Env()
+        pods = []
+        for _ in range(10):
+            p = web_pod([spread()])
+            p.spec.affinity = _zone_affinity("kwok-zone-1", "kwok-zone-2")
+            pods.append(p)
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert skew_multiset(results) == [5, 5]
+
+    def test_limit_spread_by_required_node_affinity_multi_pass(self):
+        # topology_test.go:1801 — a later pod allowed into an empty zone
+        # lands there even though it exceeds the old max, improving skew
+        np = nodepool(
+            "default",
+            requirements=[zone_req("kwok-zone-1", "kwok-zone-2", "kwok-zone-3")],
+        )
+        env = Env(node_pools=[np])
+        pods = []
+        for _ in range(6):
+            p = web_pod([spread()])
+            p.spec.affinity = _zone_affinity("kwok-zone-1", "kwok-zone-2")
+            pods.append(p)
+        first = env.schedule(pods)
+        assert not first.pod_errors
+        materialize(env, first, "p1")
+        assert store_skew(env) == [3, 3]
+        p = web_pod([spread()])
+        p.spec.affinity = _zone_affinity("kwok-zone-2", "kwok-zone-3")
+        second = env.schedule([p])
+        assert not second.pod_errors
+        materialize(env, second, "p2")
+        assert store_skew(env) == [1, 3, 3]
+        third = env.schedule([web_pod([spread()]) for _ in range(5)])
+        assert not third.pod_errors
+        materialize(env, third, "p3")
+        assert store_skew(env) == [4, 4, 4]
+
+    def test_preferred_node_affinity_does_not_limit_spread(self):
+        # topology_test.go:1845 — preference relaxes away; spread balances
+        # over the full universe (pool pinned to 3 zones as upstream)
+        np = nodepool(
+            "default",
+            requirements=[zone_req("kwok-zone-1", "kwok-zone-2", "kwok-zone-3")],
+        )
+        env = Env(node_pools=[np])
+        pods = []
+        for _ in range(6):
+            p = web_pod([spread()])
+            p.spec.affinity = Affinity(
+                node_affinity=NodeAffinity(
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=[
+                                    zone_req("kwok-zone-1", "kwok-zone-2")
+                                ]
+                            ),
+                        )
+                    ]
+                )
+            )
+            pods.append(p)
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert skew_multiset(results) == [2, 2, 2]
+
+    def test_limit_spread_by_capacity_type_selector_schedule_anyway(self):
+        # topology_test.go:1870
+        env = Env()
+        pods = []
+        for ct, n in ((wk.CAPACITY_TYPE_SPOT, 5), (wk.CAPACITY_TYPE_ON_DEMAND, 5)):
+            for _ in range(n):
+                p = web_pod([spread(key=wk.CAPACITY_TYPE_LABEL_KEY, when="ScheduleAnyway")])
+                p.spec.node_selector = {wk.CAPACITY_TYPE_LABEL_KEY: ct}
+                pods.append(p)
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        assert skew_multiset(results, key=wk.CAPACITY_TYPE_LABEL_KEY) == [5, 5]
+
+    def test_limit_spread_by_capacity_type_affinity_multi_pass(self):
+        # topology_test.go:1894 — spot-only first, then opening to both
+        # capacity types lets the empty one catch up
+        env = Env()
+        pods = []
+        for _ in range(3):
+            p = web_pod([spread(key=wk.CAPACITY_TYPE_LABEL_KEY)])
+            p.spec.node_selector = {wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT}
+            pods.append(p)
+        first = env.schedule(pods)
+        assert not first.pod_errors
+        materialize(env, first, "p1")
+        assert store_skew(env, key=wk.CAPACITY_TYPE_LABEL_KEY) == [3]
+        p = web_pod([spread(key=wk.CAPACITY_TYPE_LABEL_KEY)])
+        p.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": wk.CAPACITY_TYPE_LABEL_KEY,
+                                "operator": "In",
+                                "values": [wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_SPOT],
+                            }
+                        ]
+                    )
+                ]
+            )
+        )
+        second = env.schedule([p])
+        assert not second.pod_errors
+        materialize(env, second, "p2")
+        assert store_skew(env, key=wk.CAPACITY_TYPE_LABEL_KEY) == [1, 3]
+        third = env.schedule(
+            [web_pod([spread(key=wk.CAPACITY_TYPE_LABEL_KEY)]) for _ in range(5)]
+        )
+        assert not third.pod_errors
+        materialize(env, third, "p3")
+        assert store_skew(env, key=wk.CAPACITY_TYPE_LABEL_KEY) == [4, 5]
+
+
+def _zone_affinity(*zones):
+    return Affinity(
+        node_affinity=NodeAffinity(
+            required=[NodeSelectorTerm(match_expressions=[zone_req(*zones)])]
+        )
+    )
+
+
+
+class TestCombinedConstraints:
+    def test_zone_spread_with_hostname_schedule_anyway_and_disabled_pool(self):
+        # topology_test.go:1044 — a zero-limit pool disables its zone; the
+        # hostname ScheduleAnyway spread puts one pod per node
+        np_a = nodepool(
+            "default", requirements=[zone_req("kwok-zone-1", "kwok-zone-2")]
+        )
+        np_b = nodepool(
+            "pool-b", requirements=[zone_req("kwok-zone-3")], limits={"cpu": "0"}
+        )
+        env = Env(node_pools=[np_a, np_b])
+        results = env.schedule(
+            [
+                web_pod(
+                    [spread(), spread(key=wk.LABEL_HOSTNAME, when="ScheduleAnyway")]
+                )
+                for _ in range(10)
+            ]
+        )
+        materialize(env, results, "p1")
+        assert store_skew(env) == [1, 1]
+        assert store_skew(env, key=wk.LABEL_HOSTNAME) == [1, 1]
+
+    def test_capacity_type_and_hostname_spread_multi_pass(self):
+        # topology_test.go:1087 — ct maxSkew 1 + hostname maxSkew 3 held
+        # simultaneously across four passes
+        env = Env()
+
+        def batch(n):
+            return [
+                web_pod(
+                    [
+                        spread(key=wk.CAPACITY_TYPE_LABEL_KEY),
+                        spread(key=wk.LABEL_HOSTNAME, max_skew=3),
+                    ]
+                )
+                for _ in range(n)
+            ]
+
+        expected = [(2, [1, 1]), (3, [2, 3]), (5, [5, 5]), (11, [10, 11])]
+        for i, (n, ct_skew) in enumerate(expected):
+            results = env.schedule(batch(n))
+            assert not results.pod_errors
+            materialize(env, results, f"p{i}")
+            assert store_skew(env, key=wk.CAPACITY_TYPE_LABEL_KEY) == ct_skew
+            assert store_max_skew(env, key=wk.LABEL_HOSTNAME) <= 3
+
+    def test_all_three_constraints_held_simultaneously(self):
+        # topology_test.go:1715 — ct skew<=1, zone skew<=2, hostname skew<=3
+        # maintained over growing batches
+        env = Env()
+        for i in range(1, 11):
+            results = env.schedule(
+                [
+                    web_pod(
+                        [
+                            spread(key=wk.CAPACITY_TYPE_LABEL_KEY),
+                            spread(max_skew=2),
+                            spread(key=wk.LABEL_HOSTNAME, max_skew=3),
+                        ]
+                    )
+                    for _ in range(i)
+                ]
+            )
+            assert not results.pod_errors, (i, results.pod_errors)
+            materialize(env, results, f"p{i}")
+            assert store_max_skew(env, key=wk.CAPACITY_TYPE_LABEL_KEY) <= 1
+            assert store_max_skew(env) <= 2
+            assert store_max_skew(env, key=wk.LABEL_HOSTNAME) <= 3
