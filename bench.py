@@ -213,6 +213,77 @@ def consolidation_bench(rounds: int = 3) -> float:
     return float(np.median(times[1:]))  # first round pays compile/caches
 
 
+def topology_bench(engine, n: int = 20000) -> float:
+    """One topology-engaged solve: n pods across 4 deployments, each zone-
+    spread with maxSkew 1 (the topo driver, ops/ffd_topo.py). The host loop
+    runs this shape ~30x slower; reported as a secondary figure."""
+    from karpenter_tpu.apis.core import (
+        Condition,
+        Container,
+        LabelSelector,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        TopologySpreadConstraint,
+    )
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.events.recorder import Recorder
+    from karpenter_tpu.ops import ffd
+    from karpenter_tpu.runtime.store import Store
+    from karpenter_tpu.scheduler.scheduler import Scheduler
+    from karpenter_tpu.scheduler.topology import Topology
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informer import StateInformer
+    from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    pods = []
+    for i in range(n):
+        app = f"app-{i % 4}"
+        p = Pod(
+            metadata=ObjectMeta(name=f"tp-{i:05d}", labels={"app": app}),
+            spec=PodSpec(
+                containers=[
+                    Container(requests=parse_resource_list({"cpu": "1", "memory": "1Gi"}))
+                ],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels={"app": app}),
+                    )
+                ],
+            ),
+        )
+        p.metadata.uid = f"tp-uid-{i:05d}"
+        p.metadata.creation_timestamp = 0.0
+        p.status.conditions.append(
+            Condition(type="PodScheduled", status="False", reason="Unschedulable")
+        )
+        pods.append(p)
+    clock = FakeClock()
+    store = Store(clock=clock)
+    cluster = Cluster(clock, store, cloud_provider=None)
+    StateInformer(store, cluster).flush()
+    node_pool = NodePool(metadata=ObjectMeta(name="default"))
+    node_pool.set_condition("Ready", "True")
+    store.create(node_pool)
+    instance_types = {"default": engine.instance_types}
+    solves0 = ffd.DEVICE_SOLVES
+    start = time.perf_counter()
+    topology = Topology(store, cluster, [], [node_pool], instance_types, pods)
+    scheduler = Scheduler(
+        store, [node_pool], cluster, [], topology, instance_types, [],
+        Recorder(clock=clock), clock, engine=engine,
+    )
+    results = scheduler.solve(pods)
+    elapsed = (time.perf_counter() - start) * 1000.0
+    assert not results.pod_errors and ffd.DEVICE_SOLVES > solves0
+    return elapsed
+
+
 def main() -> None:
     from karpenter_tpu.apis.nodepool import NodePool
     from karpenter_tpu.apis.core import ObjectMeta
@@ -279,6 +350,7 @@ def main() -> None:
 
     p50 = float(np.percentile(times, 50))
     consolidation_ms = consolidation_bench()
+    topo_ms = topology_bench(engine)
     print(
         json.dumps(
             {
@@ -288,7 +360,9 @@ def main() -> None:
                     f"-> {claims} claims, {errors} errors; cold pass "
                     f"{cold_ms:.0f}ms; decisions host-oracle-identical; "
                     f"multi-node consolidation @1000 candidates: "
-                    f"{consolidation_ms:.0f}ms/compute (ref cap 60s)"
+                    f"{consolidation_ms:.0f}ms/compute (ref cap 60s); "
+                    f"topology-spread solve @20k pods (topo driver): "
+                    f"{topo_ms:.0f}ms (host loop ~30x slower)"
                 ),
                 "value": round(p50, 2),
                 "unit": "ms",

@@ -674,6 +674,13 @@ class _DeviceSolve:
         solver mutates nothing outside itself until emit; the topo driver
         overrides this to restore topology counts/ownership."""
 
+    def _order_hook_add(self, ci: int) -> None:
+        """Claim-order observer: a claim was opened (index ci). The topo
+        driver maintains an incremental host-scan order; a no-op here."""
+
+    def _order_hook_move(self, ci: int, old_key: tuple, new_key: tuple) -> None:
+        """Claim-order observer: claim ci's (count, rank, ci) key changed."""
+
     def _intern_fam(self, rows: frozenset, reqs: Requirements) -> int:
         """Intern a requirement row-set; `reqs` must be the hostname-free
         requirement set whose interned rows are exactly `rows`."""
@@ -1014,6 +1021,7 @@ class _DeviceSolve:
             c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
             heapq.heapreplace(heap, (c.count, c.rank, ci))
             self._joined = c
+            self._order_hook_move(ci, (count, rank, ci), (c.count, c.rank, ci))
             return True
         return False
 
@@ -1230,6 +1238,7 @@ class _DeviceSolve:
         c.group_counts[gi] = 1
         c.gknown.add(gi)
         self.claims.append(c)
+        self._order_hook_add(len(self.claims) - 1)
 
     def _limits_mask(self, remaining: dict) -> np.ndarray:
         """Types whose CAPACITY fits inside the nodepool's remaining limits

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import copy
 import time
+from bisect import bisect_left
 from typing import Optional, Sequence
 
 import numpy as np
@@ -208,6 +209,36 @@ def _group_eligible_topo(pod: Pod) -> bool:
     return True
 
 
+class _ScanOrder:
+    """The host's in-flight claim scan order, maintained incrementally.
+
+    The host stable-sorts claims by pod count before every scan
+    (scheduler.go:457-459); (count, rank, ci) reproduces that order exactly
+    (see _host_claim_order). Keys are unique (ci tiebreak), so each join is
+    one bisect-delete + bisect-insert instead of a full re-sort per attempt."""
+
+    __slots__ = ("keys", "cis")
+
+    def __init__(self):
+        self.keys: list[tuple] = []
+        self.cis: list[int] = []
+
+    def add(self, ci: int, key: tuple) -> None:
+        i = bisect_left(self.keys, key)
+        self.keys.insert(i, key)
+        self.cis.insert(i, ci)
+
+    def move(self, ci: int, old_key: tuple, new_key: tuple) -> None:
+        i = bisect_left(self.keys, old_key)
+        del self.keys[i]
+        del self.cis[i]
+        self.add(ci, new_key)
+
+
+# sentinel domain in join/record plans: resolve to the claim's hostname
+_HOSTNAME_DOMAIN = object()
+
+
 class _TopoSolve(_DeviceSolve):
     """Grouped FFD with exact topology semantics (Python driver only — the
     native kernel's steady-state caches assume monotone rejections, which
@@ -219,17 +250,37 @@ class _TopoSolve(_DeviceSolve):
         self._sig_to_gi: dict[int, int] = {}
         self.g_volatile: list[bool] = []
         self.g_rec: list[list] = []  # groups whose selector matches the shape
+        self.g_matched: list[list] = []  # owned + inverse-selected, host order
+        self.g_inv_owned: list[list] = []  # inverse groups the shape owns
         self.g_relaxable: list[bool] = []
-        self._hostname_tgs = any(
-            tg.key == wk.LABEL_HOSTNAME
+        self._hn_tgs = [
+            tg
             for tg in (
                 list(self.topology.topology_groups.values())
                 + list(self.topology.inverse_topology_groups.values())
             )
-        )
+            if tg.key == wk.LABEL_HOSTNAME
+        ]
+        self._hostname_tgs = bool(self._hn_tgs)
         self._saved_counts: list[tuple] = []
         self._relax_restore: dict[str, Pod] = {}
         self._aborted = False
+        self._scan = _ScanOrder()
+        # steady-state fast-join plans per (fam, gi): None = slow path
+        self._join_plans: dict[tuple[int, int], Optional[list]] = {}
+        # record plans per (gi, ti, fam)
+        self._rec_plans: dict[tuple[int, int, int], tuple] = {}
+        # per-claim hostname Requirement (by claim index)
+        self._hn_req: dict[int, Requirement] = {}
+
+    # -- incremental host scan order ----------------------------------------
+
+    def _order_hook_add(self, ci: int) -> None:
+        c = self.claims[ci]
+        self._scan.add(ci, (c.count, c.rank, ci))
+
+    def _order_hook_move(self, ci: int, old_key: tuple, new_key: tuple) -> None:
+        self._scan.move(ci, old_key, new_key)
 
     # -- grouping -----------------------------------------------------------
 
@@ -271,16 +322,22 @@ class _TopoSolve(_DeviceSolve):
         self.nptr.append(0)
         topo = self.topology
         uid = pod.metadata.uid
-        owned = any(tg.is_owned_by(uid) for tg in topo.topology_groups.values())
+        owned = [tg for tg in topo.topology_groups.values() if tg.is_owned_by(uid)]
         # inverse groups match via counts() = selects() (their node filter is
         # the permissive zero value, topologynodefilter.go:27-40) — a shape
         # an existing pod's anti-affinity selector matches is volatile too
-        inv_matched = any(
-            tg.selects(pod) for tg in topo.inverse_topology_groups.values()
-        )
-        self.g_volatile.append(owned or inv_matched)
+        inv_matched = [
+            tg for tg in topo.inverse_topology_groups.values() if tg.selects(pod)
+        ]
+        self.g_volatile.append(bool(owned or inv_matched))
+        # host matching order: owned groups in dict order, then matching
+        # inverse groups (topology.py _matching_topologies)
+        self.g_matched.append(owned + inv_matched)
         self.g_rec.append(
             [tg for tg in topo.topology_groups.values() if tg.selects(pod)]
+        )
+        self.g_inv_owned.append(
+            [tg for tg in topo.inverse_topology_groups.values() if tg.is_owned_by(uid)]
         )
         self.g_relaxable.append(self._shape_relaxable(pod))
         return gi
@@ -352,25 +409,69 @@ class _TopoSolve(_DeviceSolve):
         # so inverse record bookkeeping never needs gating here
         return bool(self.g_rec[gi]) or self._hostname_tgs
 
-    def _record_claim(self, pod: Pod, gi: int, c, reqs: Requirements) -> None:
-        """register + record after a claim join (nodeclaim.go Add tail:
-        register(hostname), record with the final joint requirements)."""
-        topo = self.topology
-        if self._hostname_tgs:
-            topo.register(wk.LABEL_HOSTNAME, c.hostname)
-        topo.record(
-            pod,
-            self.s.nodeclaim_templates[c.ti].spec.taints,
-            reqs,
-            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
-        )
+    # -- record plans (NodeClaim.add tail, nodeclaim.go:324-346) -------------
+    #
+    # The host registers the claim hostname and records into every group
+    # whose selector matches the pod and whose node filter admits the claim.
+    # For claims all inputs are (shape, template, family)-determined: selects
+    # is per shape (g_rec), the node filter per (group, taints, family), and
+    # the recorded domain per family row (or the claim's hostname). The plan
+    # compiles that once; applying it is a handful of dict increments.
 
-    def _claim_reqs(self, c) -> Requirements:
-        """The claim's full current requirement set, hostname row included —
-        what the host's NodeClaim.requirements holds."""
-        reqs = Requirements(*self.fam_reqs[c.fam].values())
-        reqs.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
-        return reqs
+    def _build_rec_plan(self, gi: int, ti: int, fam: int) -> tuple:
+        from karpenter_tpu.scheduler.topology import TYPE_ANTI_AFFINITY
+
+        reqs = self.fam_reqs[fam]
+        taints = self.s.nodeclaim_templates[ti].spec.taints
+        entries: list[tuple] = []
+        for tg in self.g_rec[gi]:
+            if not tg.node_filter.matches(
+                taints, reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+            ):
+                continue
+            if tg.key == wk.LABEL_HOSTNAME:
+                # the claim's hostname row is always single-valued
+                entries.append((tg, _HOSTNAME_DOMAIN))
+                continue
+            row = reqs.get(tg.key) if reqs.has(tg.key) else None
+            if tg.type == TYPE_ANTI_AFFINITY:
+                vals = tuple(row.values_list()) if row is not None else ()
+                if vals:
+                    entries.append((tg, vals))
+            elif row is not None and not row.complement and len(row.values) == 1:
+                entries.append((tg, next(iter(row.values))))
+        inv: list[tuple] = []
+        for tg in self.g_inv_owned[gi]:
+            if tg.key == wk.LABEL_HOSTNAME:
+                inv.append((tg, _HOSTNAME_DOMAIN))
+                continue
+            row = reqs.get(tg.key) if reqs.has(tg.key) else None
+            vals = tuple(row.values_list()) if row is not None else ()
+            if vals:
+                inv.append((tg, vals))
+        plan = (entries, inv)
+        self._rec_plans[(gi, ti, fam)] = plan
+        return plan
+
+    def _apply_record_plan(self, gi: int, c) -> None:
+        for tg in self._hn_tgs:
+            tg.register(c.hostname)
+        plan = self._rec_plans.get((gi, c.ti, c.fam))
+        if plan is None:
+            plan = self._build_rec_plan(gi, c.ti, c.fam)
+        entries, inv = plan
+        for tg, dom in entries:
+            if dom is _HOSTNAME_DOMAIN:
+                tg.record(c.hostname)
+            elif type(dom) is tuple:
+                tg.record(*dom)
+            else:
+                tg.record(dom)
+        for tg, vals in inv:
+            if vals is _HOSTNAME_DOMAIN:
+                tg.record(c.hostname)
+            else:
+                tg.record(*vals)
 
     # -- volatile paths ------------------------------------------------------
 
@@ -423,33 +524,110 @@ class _TopoSolve(_DeviceSolve):
             return True
         return False
 
-    def _host_claim_order(self) -> list[int]:
-        """Host in-flight scan order: stable sort by pod count
-        (scheduler.go:457-459). (count, rank, index) reproduces the stable
-        sort exactly — among equal counts the most recently joined claim was
-        most recently below, hence sorted earlier (rank = -join_seq); fresh
-        opens keep append order (rank = +open_seq)."""
-        claims = self.claims
-        return sorted(
-            range(len(claims)), key=lambda ci: (claims[ci].count, claims[ci].rank, ci)
-        )
+    # -- steady-state fast joins --------------------------------------------
+    #
+    # When a group's rows are subsumed by the claim family (_SAME) and every
+    # matched topology group's key has a single-valued family row (or is the
+    # hostname), the full host evaluation collapses: tg.get() with the very
+    # same Requirement objects decides admission, and admission implies the
+    # joint is unchanged (chosen ∋ v ⇒ {v} ∩ chosen = {v}), so no
+    # Requirements are built at all. Rejection is exact too: chosen missing
+    # v is precisely the host's compatibility error (or the empty-domain
+    # raise). Anything else takes the slow path below, which mirrors
+    # nodeclaim.go:114-163 verbatim.
+
+    def _build_join_plan(self, fam: int, gi: int) -> Optional[list]:
+        reqs = self.fam_reqs[fam]
+        g = self.groups[gi]
+        plan: Optional[list] = []
+        for tg in self.g_matched[gi]:
+            pod_dom = g.strict_reqs.get(tg.key)
+            if tg.key == wk.LABEL_HOSTNAME:
+                plan.append((tg, pod_dom, _HOSTNAME_DOMAIN, None))
+                continue
+            row = reqs.get(tg.key) if reqs.has(tg.key) else None
+            if row is None or row.complement or len(row.values) != 1:
+                plan = None
+                break
+            plan.append((tg, pod_dom, next(iter(row.values)), row))
+        self._join_plans[(fam, gi)] = plan
+        return plan
+
+    def _hostname_req(self, ci: int, c) -> Requirement:
+        hn = self._hn_req.get(ci)
+        if hn is None:
+            hn = Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname])
+            self._hn_req[ci] = hn
+        return hn
+
+    def _commit_join(self, c, ci: int, pod: Pod, g: _Group, gi: int, fitrows) -> None:
+        """Join tail shared by fast and slow paths: usage grows, rows that
+        stop fitting die forever, scan order updated."""
+        if fitrows.all():
+            c.rem = c.rem - g.req_f
+        else:
+            c.rem = c.rem[fitrows] - g.req_f
+            c.u_ids = c.u_ids[fitrows]
+        old_key = (c.count, c.rank, ci)
+        c.count += 1
+        self.seq += 1
+        c.rank = -self.seq
+        c.members.append(pod)
+        c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
+        self._scan.move(ci, old_key, (c.count, c.rank, ci))
 
     def _try_claims_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
         topo = self.topology
         templates = self.s.nodeclaim_templates
-        for ci in self._host_claim_order():
-            c = self.claims[ci]
-            tol = self.tg_tol.get((c.ti, gi))
+        claims = self.claims
+        cis = self._scan.cis
+        join_plans = self._join_plans
+        tg_tol = self.tg_tol
+        fam_join = self.fam_join
+        _MISS = self._MISSING
+        i = 0
+        n = len(cis)
+        while i < n:
+            ci = cis[i]
+            i += 1
+            c = claims[ci]
+            tol = tg_tol.get((c.ti, gi))
             if tol is None:
                 tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
-                self.tg_tol[(c.ti, gi)] = tol
+                tg_tol[(c.ti, gi)] = tol
             if not tol:
                 continue
-            ent = self.fam_join.get((c.fam, gi))
+            ent = fam_join.get((c.fam, gi))
             if ent is None:
                 ent = self._build_fam_join(c.fam, gi)
             if ent[0] == self._REJECT:
                 continue
+            if ent[0] == self._SAME:
+                plan = join_plans.get((c.fam, gi), _MISS)
+                if plan is _MISS:
+                    plan = self._build_join_plan(c.fam, gi)
+                if plan is not None:
+                    ok = True
+                    for tg, pod_dom, expected, node_row in plan:
+                        if expected is _HOSTNAME_DOMAIN:
+                            hn = self._hn_req.get(ci)
+                            if hn is None:
+                                hn = self._hostname_req(ci, c)
+                            if not tg.get(pod, pod_dom, hn).has(c.hostname):
+                                ok = False
+                                break
+                        elif not tg.get(pod, pod_dom, node_row).has(expected):
+                            ok = False
+                            break
+                    if not ok:
+                        continue
+                    fitrows = (c.rem >= g.fit_floor).all(axis=1)
+                    if not fitrows.any():
+                        continue
+                    self._commit_join(c, ci, pod, g, gi, fitrows)
+                    self._apply_record_plan(gi, c)
+                    return True
+            # slow path: full host gate sequence with real Requirements.
             # joint BEFORE topology = claim reqs + pod reqs, hostname row
             # included (nodeclaim.go:285-291)
             base = self.fam_reqs[c.fam] if ent[0] == self._SAME else ent[3]
@@ -490,18 +668,8 @@ class _TopoSolve(_DeviceSolve):
                 )
                 c.fam = self._intern_fam(final_rows, canon)
                 fitrows = fitrows[keep]
-            # join (usage grows; rows that stop fitting die forever)
-            if fitrows.all():
-                c.rem = c.rem - g.req_f
-            else:
-                c.rem = c.rem[fitrows] - g.req_f
-                c.u_ids = c.u_ids[fitrows]
-            c.count += 1
-            self.seq += 1
-            c.rank = -self.seq
-            c.members.append(pod)
-            c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
-            self._record_claim(pod, gi, c, joint)
+            self._commit_join(c, ci, pod, g, gi, fitrows)
+            self._apply_record_plan(gi, c)
             return True
         return False
 
@@ -589,8 +757,7 @@ class _TopoSolve(_DeviceSolve):
                 ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),
                 hostname=hostname,
             )
-            c = self.claims[-1]
-            self._record_claim(pod, gi, c, joint)
+            self._apply_record_plan(gi, self.claims[-1])
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[u_ids] = True
             self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
@@ -625,8 +792,7 @@ class _TopoSolve(_DeviceSolve):
         else:
             placed = self._try_claims(pod, g, gi)
             if placed and self._needs_record(gi):
-                c = self._joined
-                self._record_claim(pod, gi, c, self._claim_reqs(c))
+                self._apply_record_plan(gi, self._joined)
         if placed:
             return None
         if not self.s.nodeclaim_templates:

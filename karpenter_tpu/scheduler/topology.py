@@ -172,6 +172,7 @@ class TopologyGroup:
         self.owners: set[str] = set()
         self.domains: dict[str, int] = {}
         self.empty_domains: set[str] = set()
+        self._domain_reqs: dict[str, Requirement] = {}
         domain_group.for_each_domain(pod, self.node_filter.taint_policy, self._seed)
 
     def _seed(self, domain: str) -> None:
@@ -251,6 +252,15 @@ class TopologyGroup:
             return self._next_domain_affinity(pod, pod_domains, node_domains)
         return self._next_domain_anti_affinity(pod_domains, node_domains)
 
+    def _single_domain(self, domain: str) -> Requirement:
+        """Cached `key In [domain]` result rows — the hot return of spread
+        selection; callers never mutate returned requirements."""
+        req = self._domain_reqs.get(domain)
+        if req is None:
+            req = Requirement(self.key, Operator.IN, [domain])
+            self._domain_reqs[domain] = req
+        return req
+
     def _next_domain_spread(
         self, pod: Pod, pod_domains: Requirement, node_domains: Requirement
     ) -> Requirement:
@@ -265,7 +275,7 @@ class TopologyGroup:
             if self_selecting:
                 count += 1
             if count <= self.max_skew:
-                return Requirement(self.key, Operator.IN, [hostname])
+                return self._single_domain(hostname)
             return Requirement(self.key, Operator.DOES_NOT_EXIST)
 
         best_domain = None
@@ -283,19 +293,30 @@ class TopologyGroup:
                 best_count = count
         if best_domain is None:
             return Requirement(self.key, Operator.DOES_NOT_EXIST)
-        return Requirement(self.key, Operator.IN, [best_domain])
+        return self._single_domain(best_domain)
 
     def _domain_min_count(self, domains: Requirement) -> int:
         # Hostname spread can always create a fresh empty domain
         # (topologygroup.go:269-273).
         if self.key == wk.LABEL_HOSTNAME:
             return 0
-        min_count = MAX_SKEW_UNBOUNDED
-        supported = 0
-        for domain, count in self.domains.items():
-            if domains.has(domain):
-                supported += 1
-                min_count = min(min_count, count)
+        # unconstrained pod domains (Exists): every domain is supported
+        if (
+            domains.complement
+            and not domains.values
+            and domains.greater_than is None
+            and domains.less_than is None
+        ):
+            supported = len(self.domains)
+            min_count = min(self.domains.values()) if supported else MAX_SKEW_UNBOUNDED
+        else:
+            min_count = MAX_SKEW_UNBOUNDED
+            supported = 0
+            for domain, count in self.domains.items():
+                if domains.has(domain):
+                    supported += 1
+                    if count < min_count:
+                        min_count = count
         if self.min_domains is not None and supported < self.min_domains:
             min_count = 0
         return min_count
@@ -382,6 +403,96 @@ class TopologyGroup:
         return f"TopologyGroup({self.type}, key={self.key}, domains={self.domains})"
 
 
+def _sel_key(sel: Optional[LabelSelector]) -> Optional[tuple]:
+    if sel is None:
+        return None
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple(
+            (e["key"], e["operator"], tuple(e.get("values", ())))
+            for e in sel.match_expressions
+        ),
+    )
+
+
+def _aff_term_key(term) -> tuple:
+    return (
+        term.topology_key,
+        _sel_key(term.label_selector),
+        tuple(term.namespaces),
+        _sel_key(term.namespace_selector),
+    )
+
+
+def _pod_shape_key(p: Pod) -> tuple:
+    """Value key over every pod field that shapes its topology groups:
+    namespace + labels (matchLabelKeys, selects), node selector / required
+    node affinity / tolerations (the spread node filter), and the spread +
+    pod (anti-)affinity constraint content."""
+    spec = p.spec
+    aff = spec.affinity
+    na_sig: tuple = ()
+    pa_sig: tuple = ()
+    panti_sig: tuple = ()
+    if aff is not None:
+        if aff.node_affinity is not None:
+            na_sig = tuple(
+                tuple(
+                    (e["key"], e["operator"], tuple(e.get("values", ())))
+                    for e in t.match_expressions
+                )
+                for t in aff.node_affinity.required
+            )
+        if aff.pod_affinity is not None:
+            pa_sig = (
+                tuple(_aff_term_key(t) for t in aff.pod_affinity.required),
+                tuple(
+                    (w.weight, _aff_term_key(w.pod_affinity_term))
+                    for w in aff.pod_affinity.preferred
+                ),
+            )
+        if aff.pod_anti_affinity is not None:
+            panti_sig = (
+                tuple(_aff_term_key(t) for t in aff.pod_anti_affinity.required),
+                tuple(
+                    (w.weight, _aff_term_key(w.pod_affinity_term))
+                    for w in aff.pod_anti_affinity.preferred
+                ),
+            )
+    # group construction reads only the labels named in matchLabelKeys
+    # (topology.go:437-448); hashing the full label map would defeat the
+    # memo for workloads with per-pod-unique labels
+    mlk_labels = tuple(
+        sorted(
+            (k, p.metadata.labels.get(k))
+            for t in spec.topology_spread_constraints
+            for k in t.match_label_keys
+        )
+    )
+    return (
+        p.metadata.namespace,
+        mlk_labels,
+        tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
+        tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
+        tuple(
+            (
+                t.topology_key,
+                t.max_skew,
+                t.when_unsatisfiable,
+                _sel_key(t.label_selector),
+                t.min_domains,
+                t.node_affinity_policy,
+                t.node_taints_policy,
+                tuple(t.match_label_keys),
+            )
+            for t in spec.topology_spread_constraints
+        ),
+        na_sig,
+        pa_sig,
+        panti_sig,
+    )
+
+
 _domain_groups_cache: dict[tuple, dict] = {}
 _DOMAIN_CACHE_CAP = 16
 
@@ -463,6 +574,12 @@ class Topology:
         self.domain_groups = build_domain_groups(node_pools, instance_types)
         self.topology_groups: dict[tuple, TopologyGroup] = {}
         self.inverse_topology_groups: dict[tuple, TopologyGroup] = {}
+        # group-construction memo: pods with value-identical constraint
+        # content resolve to the same (deduped) groups; keyed over every
+        # input _new_for_topologies/_new_for_affinities reads (namespace,
+        # labels via matchLabelKeys/selects, selector/affinity/tolerations
+        # via the spread node filter, and the constraint terms themselves)
+        self._shape_groups: dict[tuple, list[TopologyGroup]] = {}
         # Pods being scheduled are excluded from live-cluster counting — the
         # simulation itself records them (topology.go:78-80).
         self.excluded_pods: set[str] = {p.metadata.uid for p in pods}
@@ -489,15 +606,21 @@ class Topology:
         ):
             self._update_inverse_anti_affinity(p, None)
 
-        groups = self._new_for_topologies(p) + self._new_for_affinities(p)
-        for tg in groups:
-            key = tg.hash_key()
-            existing = self.topology_groups.get(key)
-            if existing is None:
-                self._count_domains(tg)
-                self.topology_groups[key] = tg
-            else:
-                tg = existing
+        memo_key = _pod_shape_key(p)
+        owned = self._shape_groups.get(memo_key)
+        if owned is None:
+            owned = []
+            for tg in self._new_for_topologies(p) + self._new_for_affinities(p):
+                key = tg.hash_key()
+                existing = self.topology_groups.get(key)
+                if existing is None:
+                    self._count_domains(tg)
+                    self.topology_groups[key] = tg
+                else:
+                    tg = existing
+                owned.append(tg)
+            self._shape_groups[memo_key] = owned
+        for tg in owned:
             tg.add_owner(p.metadata.uid)
 
     def _new_for_topologies(self, p: Pod) -> list[TopologyGroup]:
@@ -509,15 +632,17 @@ class Topology:
             ):
                 continue
             # A nil selector stays nil (matches nothing, like labels.Nothing())
-            # unless matchLabelKeys adds expressions (topology.go:437-448).
-            selector = copy.deepcopy(tsc.label_selector)
+            # unless matchLabelKeys adds expressions (topology.go:437-448);
+            # the copy is only needed when expressions are appended — groups
+            # never mutate their selector, so sharing is safe otherwise
+            selector = tsc.label_selector
             extra = [
                 {"key": key, "operator": "In", "values": [p.metadata.labels[key]]}
                 for key in tsc.match_label_keys
                 if key in p.metadata.labels
             ]
             if extra:
-                selector = selector or LabelSelector()
+                selector = copy.deepcopy(selector) or LabelSelector()
                 selector.match_expressions.extend(extra)
             out.append(
                 TopologyGroup(
