@@ -253,6 +253,10 @@ class _TopoSolve(_DeviceSolve):
         self.g_matched: list[list] = []  # owned + inverse-selected, host order
         self.g_inv_owned: list[list] = []  # inverse groups the shape owns
         self.g_relaxable: list[bool] = []
+        self.g_rep: list[Pod] = []  # shape representative (for meta refresh)
+        self._known_tg_count = len(self.topology.topology_groups) + len(
+            self.topology.inverse_topology_groups
+        )
         self._hn_tgs = [
             tg
             for tg in (
@@ -263,6 +267,7 @@ class _TopoSolve(_DeviceSolve):
         ]
         self._hostname_tgs = bool(self._hn_tgs)
         self._saved_counts: list[tuple] = []
+        self._saved_group_dicts: Optional[tuple] = None
         self._relax_restore: dict[str, Pod] = {}
         self._aborted = False
         self._scan = _ScanOrder()
@@ -320,9 +325,16 @@ class _TopoSolve(_DeviceSolve):
         self.gheaps.append([])
         self.gsynced.append(0)
         self.nptr.append(0)
+        self.g_rep.append(pod)
+        self.g_relaxable.append(self._shape_relaxable(pod))
+        self._append_group_meta(pod)
+        return gi
+
+    def _append_group_meta(self, pod: Pod) -> None:
+        """Per-shape topology metadata (also recomputed by
+        _maybe_refresh_groups when relaxation creates new groups mid-solve)."""
         topo = self.topology
-        uid = pod.metadata.uid
-        owned = [tg for tg in topo.topology_groups.values() if tg.is_owned_by(uid)]
+        owned = self._shape_owned(pod)
         # inverse groups match via counts() = selects() (their node filter is
         # the permissive zero value, topologynodefilter.go:27-40) — a shape
         # an existing pod's anti-affinity selector matches is volatile too
@@ -337,10 +349,63 @@ class _TopoSolve(_DeviceSolve):
             [tg for tg in topo.topology_groups.values() if tg.selects(pod)]
         )
         self.g_inv_owned.append(
-            [tg for tg in topo.inverse_topology_groups.values() if tg.is_owned_by(uid)]
+            [
+                tg
+                for tg in topo.inverse_topology_groups.values()
+                if tg.is_owned_by(pod.metadata.uid)
+            ]
         )
-        self.g_relaxable.append(self._shape_relaxable(pod))
-        return gi
+
+    def _shape_owned(self, pod: Pod) -> list:
+        """Groups a pod of this shape owns, derived from the topology
+        engine's shape memo (value identity) rather than per-uid ownership —
+        per-uid state is transiently wrong for the pod currently mid-relax.
+        Returned in topology_groups dict order (the host's matching order)."""
+        from karpenter_tpu.scheduler.topology import _pod_shape_key
+
+        topo = self.topology
+        memo = topo._shape_groups.get(_pod_shape_key(pod))
+        if memo is None:
+            # shape never passed through update() — pods without topology
+            # constraints own nothing
+            if pod.spec.topology_spread_constraints or pod.spec.affinity is not None:
+                uid = pod.metadata.uid
+                return [
+                    tg for tg in topo.topology_groups.values() if tg.is_owned_by(uid)
+                ]
+            return []
+        owned_ids = set(map(id, memo))
+        return [tg for tg in topo.topology_groups.values() if id(tg) in owned_ids]
+
+    def _maybe_refresh_groups(self) -> None:
+        """Relaxation's topology.update can CREATE topology groups mid-solve
+        (a relaxed shape's node-filter hash differs): the host records
+        subsequent placements into them, so every per-shape list and compiled
+        plan must be rebuilt to include them."""
+        topo = self.topology
+        n = len(topo.topology_groups) + len(topo.inverse_topology_groups)
+        if n == self._known_tg_count:
+            return
+        self._known_tg_count = n
+        self._hn_tgs = [
+            tg
+            for tg in (
+                list(topo.topology_groups.values())
+                + list(topo.inverse_topology_groups.values())
+            )
+            if tg.key == wk.LABEL_HOSTNAME
+        ]
+        self._hostname_tgs = bool(self._hn_tgs)
+        self.g_volatile.clear()
+        self.g_matched.clear()
+        self.g_rec.clear()
+        self.g_inv_owned.clear()
+        for rep in self.g_rep:
+            self._append_group_meta(rep)
+        self._rec_plans.clear()
+        self._join_plans.clear()
+        # (no snapshot extension needed: abort() restores the pre-solve group
+        # DICTS, discarding mid-solve-created groups entirely)
 
     def _shape_relaxable(self, pod: Pod) -> bool:
         """Does the relaxation ladder (preferences.go:33-145) have anything
@@ -386,18 +451,31 @@ class _TopoSolve(_DeviceSolve):
                 + list(topo.inverse_topology_groups.values())
             )
         ]
+        # relaxation can CREATE groups mid-solve; a fallback must also remove
+        # them (a pure host run would re-create them with fresh counts)
+        self._saved_group_dicts = (
+            dict(topo.topology_groups),
+            dict(topo.inverse_topology_groups),
+            dict(topo._shape_groups),
+        )
 
     def abort(self) -> None:
         """Restore topology to its pre-solve state so the host fallback runs
-        against uncorrupted counts and ownership."""
+        against uncorrupted counts, ownership, and group sets."""
         if self._aborted:
             return
         self._aborted = True
+        topo = self.topology
+        if self._saved_group_dicts is not None:
+            groups, inverse, shapes = self._saved_group_dicts
+            topo.topology_groups = dict(groups)
+            topo.inverse_topology_groups = dict(inverse)
+            topo._shape_groups = dict(shapes)
         for tg, domains, empty in self._saved_counts:
             tg.domains = domains
             tg.empty_domains = empty
         for orig in self._relax_restore.values():
-            self.topology.update(orig)
+            topo.update(orig)
             self.s.update_cached_pod_data(orig)
         self._relax_restore.clear()
 
@@ -610,9 +688,7 @@ class _TopoSolve(_DeviceSolve):
                     ok = True
                     for tg, pod_dom, expected, node_row in plan:
                         if expected is _HOSTNAME_DOMAIN:
-                            hn = self._hn_req.get(ci)
-                            if hn is None:
-                                hn = self._hostname_req(ci, c)
+                            hn = self._hostname_req(ci, c)
                             if not tg.get(pod, pod_dom, hn).has(c.hostname):
                                 ok = False
                                 break
@@ -829,6 +905,7 @@ class _TopoSolve(_DeviceSolve):
             relaxed_any = True
             self._relax_restore.setdefault(pod.metadata.uid, pod)
             self.topology.update(rc)
+            self._maybe_refresh_groups()
             s.update_cached_pod_data(rc)
             ngi = self._ensure_group(rc)
             if ngi is None:

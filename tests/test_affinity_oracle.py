@@ -564,3 +564,79 @@ class TestAffinityTargets:
         ]
         results = env.schedule(pods)
         assert not results.pod_errors
+
+
+class TestAffinityNamespaceFiltering:
+    """topology_test.go:2853-2971 — affinity targets are namespace-scoped:
+    same namespace by default, opt-in via namespace lists and selectors."""
+
+    def _spread_batch(self):
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.LABEL_HOSTNAME,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels=dict(WEB)),
+        )
+        return [
+            pod_with(labels=dict(WEB), topology_spread_constraints=[tsc])
+            for _ in range(10)
+        ]
+
+    def _ns_env(self, ns_name, ns_labels=None):
+        from karpenter_tpu.apis.core import Namespace, ObjectMeta
+
+        env = Env()
+        env.store.create(
+            Namespace(metadata=ObjectMeta(name=ns_name, labels=ns_labels or {}))
+        )
+        return env
+
+    def test_namespace_no_match(self):
+        # topology_test.go:2853 — target in another namespace isn't visible
+        env = self._ns_env("other-ns-no-match")
+        target = pod_with(labels=dict(S2))
+        target.metadata.namespace = "other-ns-no-match"
+        follower = pod_with(labels={}, affinity=[term(key=wk.LABEL_HOSTNAME, match=S2)])
+        results = env.schedule(self._spread_batch() + [target, follower])
+        assert follower in results.pod_errors
+        assert target not in results.pod_errors
+
+    def test_namespace_list_matches(self):
+        # topology_test.go:2891 — explicit namespace list makes the target
+        # visible; both land on the same hostname
+        env = self._ns_env("other-ns-list")
+        target = pod_with(labels=dict(S2))
+        target.metadata.namespace = "other-ns-list"
+        t = term(key=wk.LABEL_HOSTNAME, match=S2)
+        t.namespaces = ["other-ns-list"]
+        follower = pod_with(labels={}, affinity=[t])
+        results = env.schedule(self._spread_batch() + [target, follower])
+        assert not results.pod_errors
+        names = {target.metadata.name, follower.metadata.name}
+        shared = [
+            nc
+            for nc in results.new_node_claims
+            if names & {p.metadata.name for p in nc.pods}
+        ]
+        assert len(shared) == 1
+        assert names <= {p.metadata.name for p in shared[0].pods}
+
+    def test_empty_namespace_selector_matches_all(self):
+        # topology_test.go:2930 — an empty namespaceSelector selects every
+        # namespace
+        env = self._ns_env("empty-ns-selector", {"foo": "bar"})
+        target = pod_with(labels=dict(S2))
+        target.metadata.namespace = "empty-ns-selector"
+        t = term(key=wk.LABEL_HOSTNAME, match=S2)
+        t.namespace_selector = LabelSelector()
+        follower = pod_with(labels={}, affinity=[t])
+        results = env.schedule(self._spread_batch() + [target, follower])
+        assert not results.pod_errors
+        names = {target.metadata.name, follower.metadata.name}
+        shared = [
+            nc
+            for nc in results.new_node_claims
+            if names & {p.metadata.name for p in nc.pods}
+        ]
+        assert len(shared) == 1
+        assert names <= {p.metadata.name for p in shared[0].pods}

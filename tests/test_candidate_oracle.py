@@ -201,3 +201,140 @@ class TestCandidateFiltering:
         sn = next(n for n in h.cluster.state_nodes() if n.name() == "bare-node")
         with pytest.raises(Exception):
             h.candidate(sn)
+
+
+class TestCandidacyGates:
+    """suite_test.go:1647-1869 — the remaining candidacy exclusions."""
+
+    def test_nodeclaim_only_representation_not_candidate(self):
+        # suite_test.go:1647 — a claim whose Node never appeared
+        h = Harness()
+        _, claim = node_claim_pair("claimonly")
+        h.store.create(claim)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.node is None)
+        with pytest.raises(ValueError):
+            h.candidate(sn)
+
+    def test_nominated_not_candidate(self):
+        # suite_test.go:1666 — a recently nominated node is protected
+        h = Harness()
+        sn = h.add_node("nom-1")
+        h.cluster.nominate_node_for_pod(sn.provider_id())
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "nom-1")
+        with pytest.raises(ValueError):
+            h.candidate(sn)
+
+    def test_deleting_not_candidate(self):
+        # suite_test.go:1687
+        h = Harness()
+        sn = h.add_node("del-1")
+        claim = h.store.get("NodeClaim", "del-1-claim")
+        claim.metadata.finalizers.append("karpenter.sh/test-finalizer")
+        h.store.update(claim)
+        h.store.delete(claim)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "del-1")
+        with pytest.raises(ValueError):
+            h.candidate(sn)
+
+    def test_marked_for_deletion_not_candidate(self):
+        # suite_test.go:1709
+        h = Harness()
+        sn = h.add_node("marked-1")
+        h.cluster.mark_for_deletion(sn.provider_id())
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "marked-1")
+        with pytest.raises(ValueError):
+            h.candidate(sn)
+
+    def test_uninitialized_not_candidate(self):
+        # suite_test.go:1730
+        h = Harness()
+        sn = h.add_node("uninit-1")
+        node = h.store.get("Node", "uninit-1")
+        node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] = "false"
+        h.store.update(node)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "uninit-1")
+        with pytest.raises(ValueError):
+            h.candidate(sn)
+
+    def test_no_nodepool_label_not_candidate(self):
+        # suite_test.go:1750
+        h = Harness()
+        sn = h.add_node("nolabel-1")
+        node = h.store.get("Node", "nolabel-1")
+        del node.metadata.labels[wk.NODEPOOL_LABEL_KEY]
+        h.store.update(node)
+        claim = h.store.get("NodeClaim", "nolabel-1-claim")
+        del claim.metadata.labels[wk.NODEPOOL_LABEL_KEY]
+        h.store.update(claim)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "nolabel-1")
+        with pytest.raises(ValueError):
+            h.candidate(sn)
+
+    def test_nonexistent_nodepool_not_candidate(self):
+        # suite_test.go:1769
+        h = Harness()
+        sn = h.add_node("ghostpool-1", pool="ghost")
+        with pytest.raises(ValueError, match="not found"):
+            h.candidate(sn)
+
+    def test_missing_capacity_type_label_still_candidate(self):
+        # suite_test.go:1794
+        h = Harness()
+        sn = h.add_node("noct-1")
+        node = h.store.get("Node", "noct-1")
+        node.metadata.labels.pop(wk.CAPACITY_TYPE_LABEL_KEY, None)
+        h.store.update(node)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "noct-1")
+        assert h.candidate(sn) is not None
+
+    def test_missing_zone_label_still_candidate(self):
+        # suite_test.go:1811
+        h = Harness()
+        sn = h.add_node("nozone-1")
+        node = h.store.get("Node", "nozone-1")
+        node.metadata.labels.pop(wk.LABEL_TOPOLOGY_ZONE, None)
+        h.store.update(node)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "nozone-1")
+        assert h.candidate(sn) is not None
+
+    def test_missing_instance_type_label_still_candidate(self):
+        # suite_test.go:1828
+        h = Harness()
+        sn = h.add_node("noit-1")
+        node = h.store.get("Node", "noit-1")
+        node.metadata.labels.pop(wk.LABEL_INSTANCE_TYPE, None)
+        h.store.update(node)
+        h.informer.flush()
+        sn = next(n for n in h.cluster.state_nodes() if n.name() == "noit-1")
+        cand = h.candidate(sn)
+        assert cand is not None and cand.instance_type is None
+
+    def test_unresolvable_instance_type_still_candidate(self):
+        # suite_test.go:1845 — an instance type absent from the provider
+        h = Harness()
+        sn = h.add_node("weirdit-1", instance_type="retired-type")
+        cand = h.candidate(sn)
+        assert cand is not None and cand.instance_type is None
+
+    def test_in_queue_not_candidate(self):
+        # suite_test.go:1866 — actively processed candidates are excluded
+        h = Harness()
+        sn = h.add_node("queued-1")
+
+        class FakeQueue:
+            def has_any(self, *pids):
+                return True
+
+        its = {it.name: it for it in h.provider.get_instance_types(h.pool)}
+        with pytest.raises(ValueError, match="already being disrupted"):
+            new_candidate(
+                h.store, h.recorder, h.clock, sn,
+                Limits.from_pdbs([]), {"default": h.pool}, {"default": its},
+                FakeQueue(), GRACEFUL_DISRUPTION_CLASS,
+            )

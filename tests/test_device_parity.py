@@ -422,6 +422,15 @@ class TestDeviceParity:
         assert host == dev
         assert ran, "device path unexpectedly fell back to the host loop"
 
+    def test_relaxation_creates_topology_group_mid_solve(self):
+        """Regression (soak seed 469): relaxing a multi-term node-affinity
+        pod creates a NEW topology group mid-solve (its node-filter hash
+        differs); the device must record subsequent placements into it, or
+        final error messages embed stale domain counts."""
+        host, dev, ran = run_case(469, topo=True)
+        assert host == dev
+        assert ran
+
     @pytest.mark.parametrize("seed", range(30))
     def test_topology_spread_decision_parity(self, seed):
         """Topology-engaged solves on the topo driver (ops/ffd_topo.py):

@@ -36,3 +36,132 @@ class TestBudgets:
         ]
         assert np_.allowed_disruptions("Empty", 10, 0.0) == 5
         assert np_.allowed_disruptions("Drifted", 10, 0.0) == 2
+
+
+class TestDisruptionBudgetCounting:
+    """suite_test.go:699-845 — which nodes count toward the disruption
+    budget denominator and the in-flight disruption count."""
+
+    REASONS = ("Empty", "Underutilized", "Drifted")
+
+    def _harness(self, budget="100%", n=10):
+        from karpenter_tpu.apis.nodepool import Budget
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.events.recorder import Recorder
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.state.cluster import Cluster
+        from karpenter_tpu.state.informer import StateInformer
+        from karpenter_tpu.utils.clock import FakeClock
+
+        from helpers import node_claim_pair, nodepool
+
+        class H:
+            pass
+
+        h = H()
+        h.clock = FakeClock()
+        h.store = Store(clock=h.clock)
+        h.provider = FakeCloudProvider()
+        h.cluster = Cluster(h.clock, h.store, h.provider)
+        h.informer = StateInformer(h.store, h.cluster)
+        h.recorder = Recorder(clock=h.clock)
+        pool = nodepool("default")
+        pool.spec.disruption.budgets = [Budget(nodes=budget)]
+        h.store.create(pool)
+        h.pairs = []
+        for i in range(n):
+            node, claim = node_claim_pair(f"n-{i}")
+            h.store.create(claim)
+            h.store.create(node)
+            h.pairs.append((node, claim))
+        h.informer.flush()
+        return h
+
+    def _mapping(self, h, reason):
+        from karpenter_tpu.controllers.disruption.helpers import (
+            build_disruption_budget_mapping,
+        )
+
+        return build_disruption_budget_mapping(
+            h.store, h.cluster, h.clock, h.recorder, reason
+        )
+
+    def test_unmanaged_nodes_not_counted(self):
+        # suite_test.go:699
+        from helpers import registered_node
+
+        h = self._harness()
+        bare = registered_node(name="unmanaged")
+        del bare.metadata.labels["karpenter.sh/nodepool"]
+        h.store.create(bare)
+        h.informer.flush()
+        for reason in self.REASONS:
+            assert self._mapping(h, reason)["default"] == 10
+
+    def test_uninitialized_nodes_not_counted(self):
+        # suite_test.go:712
+        from karpenter_tpu.apis import labels as wk
+
+        from helpers import node_claim_pair
+
+        h = self._harness()
+        node, claim = node_claim_pair("uninit")
+        node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] = "false"
+        h.store.create(claim)
+        h.store.create(node)
+        h.informer.flush()
+        for reason in self.REASONS:
+            assert self._mapping(h, reason)["default"] == 10
+
+    def test_terminating_nodes_not_counted(self):
+        # suite_test.go:743
+        from karpenter_tpu.apis.nodeclaim import CONDITION_INSTANCE_TERMINATING
+
+        from helpers import node_claim_pair
+
+        h = self._harness()
+        node, claim = node_claim_pair("term")
+        claim.set_condition(CONDITION_INSTANCE_TERMINATING, "True")
+        h.store.create(claim)
+        h.store.create(node)
+        h.informer.flush()
+        for reason in self.REASONS:
+            assert self._mapping(h, reason)["default"] == 10
+
+    def test_never_negative(self):
+        # suite_test.go:775 — 10% of 10 allows 1, but 10 are already
+        # disrupting: clamp at zero
+        h = self._harness(budget="10%")
+        h.cluster.mark_for_deletion(
+            *(f"kwok://{node.metadata.name}" for node, _ in h.pairs)
+        )
+        for reason in self.REASONS:
+            assert self._mapping(h, reason)["default"] == 0
+
+    def test_deleting_and_marked_counted_as_disrupting(self):
+        # suite_test.go:796 — one deleted pair + one MarkedForDeletion: 8
+        h = self._harness()
+        node0, claim0 = h.pairs[0]
+        claim0.metadata.finalizers.append("karpenter.sh/test-finalizer")
+        h.store.update(claim0)
+        h.store.delete(claim0)
+        h.informer.flush()
+        node1, _ = h.pairs[1]
+        h.cluster.mark_for_deletion(f"kwok://{node1.metadata.name}")
+        for reason in self.REASONS:
+            assert self._mapping(h, reason)["default"] == 8
+
+    def test_not_ready_counted_as_disrupting(self):
+        # suite_test.go:820 — two NotReady nodes: 8
+        from karpenter_tpu.apis.core import Condition
+
+        h = self._harness()
+        for node, _ in h.pairs[:2]:
+            node.status.conditions = [
+                c for c in node.status.conditions if c.type != "Ready"
+            ]
+            node.status.conditions.append(Condition(type="Ready", status="False"))
+            h.store.update(node)
+        h.informer.flush()
+        for reason in self.REASONS:
+            assert self._mapping(h, reason)["default"] == 8
