@@ -31,10 +31,10 @@ reproducing the host loop's decisions bit-for-bit:
    matrices — exact, no device round-trip on the sequential path.
 
 Eligibility is checked first (`eligible`): solves with reserved capacity,
-minValues, or PreferNoSchedule relaxation — and pods with host ports or
-volumes — take the host path, which remains the semantics oracle.
-Topology-engaged solves (spread, pod (anti-)affinity, inverse anti-affinity
-from cluster pods) run the topo-aware driver (ops/ffd_topo.py).
+minValues, or PreferNoSchedule relaxation — and pods with volumes — take
+the host path, which remains the semantics oracle. Topology-engaged solves
+(spread, pod (anti-)affinity, inverse anti-affinity from cluster pods) and
+host-port shapes run the topo-aware driver (ops/ffd_topo.py).
 """
 
 from __future__ import annotations
@@ -174,7 +174,7 @@ def _group_eligible(pod: Pod) -> bool:
             return False
     if spec.topology_spread_constraints:
         return False
-    if any(c.ports for c in spec.containers):
+    if any(c.ports for c in list(spec.containers) + list(spec.init_containers)):
         return False
     if getattr(spec, "volumes", None):
         return False
@@ -668,6 +668,9 @@ class _DeviceSolve:
         self.pod_errors: dict[Pod, Exception] = {}
         self.timed_out = False
         self._native: Optional[_NativeDriver] = None
+        # per-claim-index HostPortUsage; populated only by the topo driver
+        # when host ports are in play (plain solves gate ports shapes out)
+        self._claim_hp: dict[int, HostPortUsage] = {}
 
     def abort(self) -> None:
         """Undo external state mutations before a host fallback. The plain
@@ -1385,8 +1388,9 @@ class _DeviceSolve:
         empty_hostports = {
             nct: not s.daemon_hostports[nct] for nct in s.nodeclaim_templates
         }
-        for c in self.claims:
+        for ci, c in enumerate(self.claims):
             nct = s.nodeclaim_templates[c.ti]
+            tracked_hp = self._claim_hp.get(ci)
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[c.u_ids] = True
             final_types = c.type_mask & surv_u[self.uid_of_type]
@@ -1407,7 +1411,9 @@ class _DeviceSolve:
                 nct,
                 s.topology,
                 s.daemon_overhead[nct],
-                HostPortUsage()
+                tracked_hp
+                if tracked_hp is not None
+                else HostPortUsage()
                 if empty_hostports[nct]
                 else _copy.deepcopy(s.daemon_hostports[nct]),
                 options,

@@ -185,6 +185,12 @@ def _topo_sig(pod: Pod) -> tuple:
                 )
                 for w in na.preferred
             )
+    ports_sig = tuple(
+        (p.host_port, p.host_ip, p.protocol)
+        for c in list(spec.containers) + list(spec.init_containers)
+        for p in c.ports
+        if p.host_port != 0
+    )
     return (
         _raw_sig(pod),
         md.namespace,
@@ -193,18 +199,16 @@ def _topo_sig(pod: Pod) -> tuple:
         pa_sig,
         panti_sig,
         pref_na_sig,
+        ports_sig,
     )
 
 
 def _group_eligible_topo(pod: Pod) -> bool:
     """Per-shape gates for topo mode: topology constraints of every kind are
     allowed (spread, pod (anti-)affinity, preferred/multi-term node affinity
-    — the relax ladder and volatile paths handle them); host ports and
-    volumes still decline."""
-    spec = pod.spec
-    if any(c.ports for c in spec.containers):
-        return False
-    if getattr(spec, "volumes", None):
+    — the relax ladder and volatile paths handle them), as are host ports
+    (conflict-tracked on the volatile paths); volumes still decline."""
+    if getattr(pod.spec, "volumes", None):
         return False
     return True
 
@@ -254,6 +258,8 @@ class _TopoSolve(_DeviceSolve):
         self.g_inv_owned: list[list] = []  # inverse groups the shape owns
         self.g_relaxable: list[bool] = []
         self.g_rep: list[Pod] = []  # shape representative (for meta refresh)
+        self.g_ports: list[list] = []  # host ports per shape (usually empty)
+        self._any_ports = False  # _claim_hp (base class) tracked when True
         self._known_tg_count = len(self.topology.topology_groups) + len(
             self.topology.inverse_topology_groups
         )
@@ -268,6 +274,7 @@ class _TopoSolve(_DeviceSolve):
         self._hostname_tgs = bool(self._hn_tgs)
         self._saved_counts: list[tuple] = []
         self._saved_group_dicts: Optional[tuple] = None
+        self._saved_node_hp: list[tuple] = []
         self._relax_restore: dict[str, Pod] = {}
         self._aborted = False
         self._scan = _ScanOrder()
@@ -327,21 +334,28 @@ class _TopoSolve(_DeviceSolve):
         self.nptr.append(0)
         self.g_rep.append(pod)
         self.g_relaxable.append(self._shape_relaxable(pod))
-        self._append_group_meta(pod)
+        from karpenter_tpu.scheduling.hostportusage import get_host_ports
+
+        ports = get_host_ports(pod)
+        self.g_ports.append(ports)
+        if ports:
+            self._any_ports = True
+        self._append_group_meta(pod, ports)
         return gi
 
-    def _append_group_meta(self, pod: Pod) -> None:
+    def _append_group_meta(self, pod: Pod, ports: list) -> None:
         """Per-shape topology metadata (also recomputed by
         _maybe_refresh_groups when relaxation creates new groups mid-solve)."""
         topo = self.topology
         owned = self._shape_owned(pod)
         # inverse groups match via counts() = selects() (their node filter is
         # the permissive zero value, topologynodefilter.go:27-40) — a shape
-        # an existing pod's anti-affinity selector matches is volatile too
+        # an existing pod's anti-affinity selector matches is volatile too;
+        # host-port shapes are volatile too (conflict admission accumulates)
         inv_matched = [
             tg for tg in topo.inverse_topology_groups.values() if tg.selects(pod)
         ]
-        self.g_volatile.append(bool(owned or inv_matched))
+        self.g_volatile.append(bool(owned or inv_matched or ports))
         # host matching order: owned groups in dict order, then matching
         # inverse groups (topology.py _matching_topologies)
         self.g_matched.append(owned + inv_matched)
@@ -400,8 +414,8 @@ class _TopoSolve(_DeviceSolve):
         self.g_matched.clear()
         self.g_rec.clear()
         self.g_inv_owned.clear()
-        for rep in self.g_rep:
-            self._append_group_meta(rep)
+        for rep, ports in zip(self.g_rep, self.g_ports):
+            self._append_group_meta(rep, ports)
         self._rec_plans.clear()
         self._join_plans.clear()
         # (no snapshot extension needed: abort() restores the pre-solve group
@@ -458,6 +472,13 @@ class _TopoSolve(_DeviceSolve):
             dict(topo.inverse_topology_groups),
             dict(topo._shape_groups),
         )
+        # port joins on existing nodes mutate the SHARED state_node usage;
+        # a fallback must not leave phantom port entries behind
+        if self._any_ports:
+            self._saved_node_hp = [
+                (nd.en.state_node, nd.en.state_node.hostport_usage.copy())
+                for nd in self.nodes
+            ]
 
     def abort(self) -> None:
         """Restore topology to its pre-solve state so the host fallback runs
@@ -474,6 +495,8 @@ class _TopoSolve(_DeviceSolve):
         for tg, domains, empty in self._saved_counts:
             tg.domains = domains
             tg.empty_domains = empty
+        for sn, usage in self._saved_node_hp:
+            sn.hostport_usage = usage
         for orig in self._relax_restore.values():
             topo.update(orig)
             self.s.update_cached_pod_data(orig)
@@ -559,12 +582,15 @@ class _TopoSolve(_DeviceSolve):
         Topology.add_requirements in the gate sequence
         (existingnode.go:63-101)."""
         topo = self.topology
+        gp = self.g_ports[gi]
         for nd in self.nodes:
             tol = nd.gtol.get(gi)
             if tol is None:
                 tol = Taints(nd.en.cached_taints).tolerates_pod(pod) is None
                 nd.gtol[gi] = tol
             if not tol:
+                continue
+            if gp and nd.en.state_node.hostport_usage.conflicts(pod, gp) is not None:
                 continue
             kc = nd.gcap.get(gi)
             if kc is None or kc[0] != nd.usage_ver:
@@ -599,6 +625,8 @@ class _TopoSolve(_DeviceSolve):
             nd.version += 1
             nd.usage_ver += 1
             topo.record(pod, nd.en.cached_taints, joint)
+            if gp:
+                nd.en.state_node.hostport_usage.add(pod, gp)
             return True
         return False
 
@@ -665,6 +693,7 @@ class _TopoSolve(_DeviceSolve):
         _MISS = self._MISSING
         i = 0
         n = len(cis)
+        gp = self.g_ports[gi]
         while i < n:
             ci = cis[i]
             i += 1
@@ -674,6 +703,10 @@ class _TopoSolve(_DeviceSolve):
                 tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
                 tg_tol[(c.ti, gi)] = tol
             if not tol:
+                continue
+            # host ports (nodeclaim.go:280-283): conflicts against the
+            # claim's accumulated usage reject this candidate
+            if gp and self._claim_hp[ci].conflicts(pod, gp) is not None:
                 continue
             ent = fam_join.get((c.fam, gi))
             if ent is None:
@@ -702,6 +735,8 @@ class _TopoSolve(_DeviceSolve):
                         continue
                     self._commit_join(c, ci, pod, g, gi, fitrows)
                     self._apply_record_plan(gi, c)
+                    if gp:
+                        self._claim_hp[ci].add(pod, gp)
                     return True
             # slow path: full host gate sequence with real Requirements.
             # joint BEFORE topology = claim reqs + pod reqs, hostname row
@@ -746,6 +781,8 @@ class _TopoSolve(_DeviceSolve):
                 fitrows = fitrows[keep]
             self._commit_join(c, ci, pod, g, gi, fitrows)
             self._apply_record_plan(gi, c)
+            if gp:
+                self._claim_hp[ci].add(pod, gp)
             return True
         return False
 
@@ -756,6 +793,7 @@ class _TopoSolve(_DeviceSolve):
         (and consumes placeholder hostnames) on every retry, and hostname
         STRINGS are decision-relevant under sorted-domain iteration."""
         s, topo = self.s, self.topology
+        gp = self.g_ports[gi]
         errs: list[Exception] = []
         for ti, nct in enumerate(s.nodeclaim_templates):
             remaining = self.remaining_resources.get(nct.nodepool_name)
@@ -782,6 +820,13 @@ class _TopoSolve(_DeviceSolve):
                     ValueError(str(Taints(nct.spec.taints).tolerates_pod(pod)))
                 )
                 continue
+            if gp:
+                conflict = s.daemon_hostports[nct].conflicts(pod, gp)
+                if conflict is not None:
+                    errs.append(
+                        ValueError(f"checking host port usage, {conflict}")
+                    )
+                    continue
             tg = self._tg(ti, gi)
             if tg is None:
                 errs.append(
@@ -833,6 +878,11 @@ class _TopoSolve(_DeviceSolve):
                 ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),
                 hostname=hostname,
             )
+            if self._any_ports:
+                hp = s.daemon_hostports[nct].copy()
+                if gp:
+                    hp.add(pod, gp)
+                self._claim_hp[len(self.claims) - 1] = hp
             self._apply_record_plan(gi, self.claims[-1])
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[u_ids] = True

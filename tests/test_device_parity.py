@@ -243,6 +243,17 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
             kwargs["affinity"] = _random_pod_affinity(rng, own_app)
         elif aff_roll < 0.3:
             kwargs["affinity"] = _random_node_affinity(rng)
+        if rng.random() < 0.12:
+            # host ports: same-port shapes conflict (wildcard IP), distinct
+            # IPs coexist — claims accumulate usage on the topo driver
+            from karpenter_tpu.apis.core import ContainerPort
+
+            kwargs["host_port"] = ContainerPort(
+                container_port=80,
+                host_port=rng.choice([8080, 8080, 9090, 7070]),
+                host_ip=rng.choice(["", "", "10.0.0.1"]),
+                protocol=rng.choice(["TCP", "TCP", "UDP"]),
+            )
     selector = {}
     roll = rng.random()
     if roll < 0.3:
@@ -348,7 +359,12 @@ def build_case(seed: int, topo: bool = False):
         pods = []
         for i, si in enumerate(picks):
             kwargs, spec_kwargs = shapes[si]
+            port = kwargs.get("host_port")
+            if port is not None:
+                kwargs = {k: v for k, v in kwargs.items() if k != "host_port"}
             p = unschedulable_pod(name=f"p-{i:05d}", **kwargs, **spec_kwargs)
+            if port is not None:
+                p.spec.containers[0].ports = [port]
             p.metadata.uid = f"uid-{i:05d}"
             p.metadata.creation_timestamp = float(i % 7)  # exercise uid ties
             pods.append(p)

@@ -32,6 +32,7 @@ from karpenter_tpu.ops.catalog import CatalogEngine
 from karpenter_tpu.utils.resources import parse_resource_list
 
 from helpers import (
+    bind_pod,
     daemonset,
     daemonset_pod,
     node_claim_pair,
@@ -728,3 +729,104 @@ class TestSchedulerMetrics:
 
         schedule("host", [unschedulable_pod(requests={"cpu": "9999"})])
         assert _UNSCHEDULABLE_GAUGE.value() == 1.0
+
+
+class TestHostPortsBothPaths:
+    """Host-port conflict semantics on BOTH paths (hostportusage.go:35-120;
+    ports shapes run the topo driver's volatile paths)."""
+
+    def _port_pod(self, port=8080, ip="", protocol="TCP", **kwargs):
+        from karpenter_tpu.apis.core import ContainerPort
+
+        p = unschedulable_pod(requests={"cpu": "100m"}, **kwargs)
+        p.spec.containers[0].ports = [
+            ContainerPort(container_port=80, host_port=port, host_ip=ip, protocol=protocol)
+        ]
+        return p
+
+    def test_same_host_port_forces_separate_claims(self, path):
+        results = schedule(path, [self._port_pod() for _ in range(3)])
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 3
+
+    def test_distinct_ips_share_a_claim(self, path):
+        pods = [
+            self._port_pod(ip="10.0.0.1"),
+            self._port_pod(ip="10.0.0.2"),
+        ]
+        results = schedule(path, pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+
+    def test_wildcard_conflicts_with_specific_ip(self, path):
+        pods = [self._port_pod(ip=""), self._port_pod(ip="10.0.0.1")]
+        results = schedule(path, pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 2
+
+    def test_different_protocols_share_a_claim(self, path):
+        pods = [self._port_pod(protocol="TCP"), self._port_pod(protocol="UDP")]
+        results = schedule(path, pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+
+    def test_port_pod_avoids_conflicting_existing_node(self, path):
+        # an existing node already running the port forces a new claim
+        node = registered_node(name="port-node", pool="default")
+        occupant = self._port_pod(name="occupant")
+        bind_pod(occupant, node)
+        env = make_env(path, state_nodes=[node], pods=[occupant])
+        results = schedule(path, [self._port_pod(name="newcomer")], env=env)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
+        assert all(not en.pods for en in results.existing_nodes)
+
+    def test_init_container_host_ports_conflict(self, path):
+        # host ports on INIT containers must route to the topo driver too
+        # (the eligibility gate covers both container lists)
+        from karpenter_tpu.apis.core import Container, ContainerPort
+
+        pods = []
+        for i in range(3):
+            p = unschedulable_pod(name=f"initport-{i}", requests={"cpu": "100m"})
+            p.spec.init_containers = [
+                Container(
+                    requests={},
+                    ports=[ContainerPort(container_port=80, host_port=8080)],
+                )
+            ]
+            pods.append(p)
+        results = schedule(path, pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 3
+
+    def test_abort_restores_existing_node_port_usage(self):
+        # a mid-solve fallback must not leave phantom port entries on the
+        # SHARED state-node usage the host fallback then reads
+        from karpenter_tpu.ops import ffd_topo
+
+        node = registered_node(name="pn1", pool="default")
+        env = make_env("device", state_nodes=[node])
+        pods = [self._port_pod(name=f"pp-{i}") for i in range(2)]
+        for i, p in enumerate(pods):
+            p.metadata.uid = f"pp-uid-{i}"
+        state_nodes = env.cluster.state_nodes()
+        from karpenter_tpu.scheduler.topology import Topology
+        from karpenter_tpu.scheduler.scheduler import Scheduler
+
+        topology = Topology(
+            env.store, env.cluster, state_nodes, env.node_pools,
+            env.instance_types, pods,
+        )
+        scheduler = Scheduler(
+            env.store, env.node_pools, env.cluster, state_nodes, topology,
+            env.instance_types, [], env.recorder, env.clock,
+            engine=env.scheduler_kwargs["engine"],
+        )
+        sn = state_nodes[0]
+        assert not sn.hostport_usage
+        solve = ffd_topo._TopoSolve(scheduler, pods)
+        solve.run(60.0)
+        assert sn.hostport_usage, "expected a port join on the existing node"
+        solve.abort()
+        assert not sn.hostport_usage, "abort left phantom port entries"
