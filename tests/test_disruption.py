@@ -7,6 +7,7 @@ import pytest
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclaim import (
     CONDITION_DISRUPTION_REASON,
+    CONDITION_DRIFTED,
     CONDITION_INITIALIZED,
 )
 from karpenter_tpu.apis.nodepool import Budget
@@ -395,3 +396,56 @@ class TestSpotToSpot:
             "SpotToSpotConsolidation requires 15" in e.message
             for e in env.recorder.events
         )
+
+
+class TestDisruptionDecisionMetrics:
+    """suite_test.go:1930-2037 — decisions fire the decision/reason/
+    consolidation_type counter when commands start."""
+
+    def _assert_decision_fires(self, env, decision, reason, ctype):
+        from karpenter_tpu.controllers.disruption import queue as qmod
+
+        labels = {"decision": decision, "reason": reason, "consolidation_type": ctype}
+        before = qmod._DECISIONS_TOTAL.value(labels)
+        assert env.reconcile() is True
+        assert qmod._DECISIONS_TOTAL.value(labels) == before + 1
+
+    def test_single_node_empty_fires_delete_empty(self):
+        # suite_test.go:1930
+        env = Env()
+        env.store.create(nodepool("default"))
+        env.add_pair("m-empty-1")
+        self._assert_decision_fires(env, "delete", "empty", "empty")
+
+    def test_single_node_drift_fires_delete_drifted(self):
+        # suite_test.go:1942 — drifted node whose pods fit elsewhere: delete
+        env = Env()
+        env.store.create(nodepool("default"))
+        # a second, non-disruptable node able to absorb the pods
+        env.add_pair("m-other-1", consolidatable=False)
+        pods = [unschedulable_pod(requests={"cpu": "100m"}) for _ in range(2)]
+        _, claim = env.add_pair("m-drift-1", pods=pods)
+        claim.set_condition(CONDITION_DRIFTED, "True")
+        env.store.update(claim)
+        env.informer.flush()
+        self._assert_decision_fires(env, "delete", "drifted", "")
+
+    def test_single_node_drift_fires_replace_drifted(self):
+        # suite_test.go:1967 — drifted node with pods and nowhere to put
+        # them: replacement launched
+        env = Env()
+        env.store.create(nodepool("default"))
+        pods = [unschedulable_pod(requests={"cpu": "2"}) for _ in range(2)]
+        _, claim = env.add_pair("m-driftr-1", pods=pods)
+        claim.set_condition(CONDITION_DRIFTED, "True")
+        env.store.update(claim)
+        env.informer.flush()
+        self._assert_decision_fires(env, "replace", "drifted", "")
+
+    def test_multi_node_empty_fires_delete_empty(self):
+        # suite_test.go:1990 — several empty nodes coalesce into one command
+        env = Env()
+        env.store.create(nodepool("default"))
+        for i in range(3):
+            env.add_pair(f"m-multi-{i}")
+        self._assert_decision_fires(env, "delete", "empty", "empty")
