@@ -31,10 +31,10 @@ reproducing the host loop's decisions bit-for-bit:
    matrices — exact, no device round-trip on the sequential path.
 
 Eligibility is checked first (`eligible`): solves with reserved capacity,
-minValues, or PreferNoSchedule relaxation — and pods with volumes — take
-the host path, which remains the semantics oracle. Topology-engaged solves
-(spread, pod (anti-)affinity, inverse anti-affinity from cluster pods) and
-host-port shapes run the topo-aware driver (ops/ffd_topo.py).
+minValues, or PreferNoSchedule relaxation — and hostname-pinned pods —
+take the host path, which remains the semantics oracle. Topology-engaged
+solves and host-port/volume shapes run the topo-aware driver
+(ops/ffd_topo.py).
 """
 
 from __future__ import annotations

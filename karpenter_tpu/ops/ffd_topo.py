@@ -204,12 +204,11 @@ def _topo_sig(pod: Pod) -> tuple:
 
 
 def _group_eligible_topo(pod: Pod) -> bool:
-    """Per-shape gates for topo mode: topology constraints of every kind are
-    allowed (spread, pod (anti-)affinity, preferred/multi-term node affinity
-    — the relax ladder and volatile paths handle them), as are host ports
-    (conflict-tracked on the volatile paths); volumes still decline."""
-    if getattr(pod.spec, "volumes", None):
-        return False
+    """Per-shape gates for topo mode: every remaining shape feature is
+    handled — topology constraints (relax ladder + volatile paths), host
+    ports (conflict-tracked), and volumes (per-pod CSI attach-limit checks
+    against existing nodes; volume-derived zone requirements were already
+    injected by VolumeTopology before the solve)."""
     return True
 
 
@@ -260,6 +259,8 @@ class _TopoSolve(_DeviceSolve):
         self.g_rep: list[Pod] = []  # shape representative (for meta refresh)
         self.g_ports: list[list] = []  # host ports per shape (usually empty)
         self._any_ports = False  # _claim_hp (base class) tracked when True
+        self.g_volumes: list[bool] = []  # shape has PVC-backed volumes
+        self._any_volumes = False
         self._known_tg_count = len(self.topology.topology_groups) + len(
             self.topology.inverse_topology_groups
         )
@@ -275,6 +276,7 @@ class _TopoSolve(_DeviceSolve):
         self._saved_counts: list[tuple] = []
         self._saved_group_dicts: Optional[tuple] = None
         self._saved_node_hp: list[tuple] = []
+        self._saved_node_vols: list[tuple] = []
         self._relax_restore: dict[str, Pod] = {}
         self._aborted = False
         self._scan = _ScanOrder()
@@ -340,10 +342,14 @@ class _TopoSolve(_DeviceSolve):
         self.g_ports.append(ports)
         if ports:
             self._any_ports = True
-        self._append_group_meta(pod, ports)
+        has_volumes = bool(getattr(pod.spec, "volumes", None))
+        self.g_volumes.append(has_volumes)
+        if has_volumes:
+            self._any_volumes = True
+        self._append_group_meta(pod, ports, has_volumes)
         return gi
 
-    def _append_group_meta(self, pod: Pod, ports: list) -> None:
+    def _append_group_meta(self, pod: Pod, ports: list, has_volumes: bool) -> None:
         """Per-shape topology metadata (also recomputed by
         _maybe_refresh_groups when relaxation creates new groups mid-solve)."""
         topo = self.topology
@@ -351,11 +357,12 @@ class _TopoSolve(_DeviceSolve):
         # inverse groups match via counts() = selects() (their node filter is
         # the permissive zero value, topologynodefilter.go:27-40) — a shape
         # an existing pod's anti-affinity selector matches is volatile too;
-        # host-port shapes are volatile too (conflict admission accumulates)
+        # host-port and volume shapes are volatile too (their admission
+        # state accumulates per candidate / is per-pod)
         inv_matched = [
             tg for tg in topo.inverse_topology_groups.values() if tg.selects(pod)
         ]
-        self.g_volatile.append(bool(owned or inv_matched or ports))
+        self.g_volatile.append(bool(owned or inv_matched or ports or has_volumes))
         # host matching order: owned groups in dict order, then matching
         # inverse groups (topology.py _matching_topologies)
         self.g_matched.append(owned + inv_matched)
@@ -414,8 +421,8 @@ class _TopoSolve(_DeviceSolve):
         self.g_matched.clear()
         self.g_rec.clear()
         self.g_inv_owned.clear()
-        for rep, ports in zip(self.g_rep, self.g_ports):
-            self._append_group_meta(rep, ports)
+        for rep, ports, has_vols in zip(self.g_rep, self.g_ports, self.g_volumes):
+            self._append_group_meta(rep, ports, has_vols)
         self._rec_plans.clear()
         self._join_plans.clear()
         # (no snapshot extension needed: abort() restores the pre-solve group
@@ -472,11 +479,16 @@ class _TopoSolve(_DeviceSolve):
             dict(topo.inverse_topology_groups),
             dict(topo._shape_groups),
         )
-        # port joins on existing nodes mutate the SHARED state_node usage;
-        # a fallback must not leave phantom port entries behind
+        # port/volume joins on existing nodes mutate the SHARED state_node
+        # usage; a fallback must not leave phantom entries behind
         if self._any_ports:
             self._saved_node_hp = [
                 (nd.en.state_node, nd.en.state_node.hostport_usage.copy())
+                for nd in self.nodes
+            ]
+        if self._any_volumes:
+            self._saved_node_vols = [
+                (nd.en.state_node, nd.en.state_node.volume_usage.copy())
                 for nd in self.nodes
             ]
 
@@ -497,6 +509,8 @@ class _TopoSolve(_DeviceSolve):
             tg.empty_domains = empty
         for sn, usage in self._saved_node_hp:
             sn.hostport_usage = usage
+        for sn, usage in self._saved_node_vols:
+            sn.volume_usage = usage
         for orig in self._relax_restore.values():
             topo.update(orig)
             self.s.update_cached_pod_data(orig)
@@ -583,12 +597,22 @@ class _TopoSolve(_DeviceSolve):
         (existingnode.go:63-101)."""
         topo = self.topology
         gp = self.g_ports[gi]
+        vols = None
+        if self.g_volumes[gi]:
+            from karpenter_tpu.scheduling.volumeusage import get_volumes
+
+            vols = get_volumes(self.s.store, pod)
         for nd in self.nodes:
             tol = nd.gtol.get(gi)
             if tol is None:
                 tol = Taints(nd.en.cached_taints).tolerates_pod(pod) is None
                 nd.gtol[gi] = tol
             if not tol:
+                continue
+            if (
+                vols is not None
+                and nd.en.state_node.volume_usage.exceeds_limits(vols) is not None
+            ):
                 continue
             if gp and nd.en.state_node.hostport_usage.conflicts(pod, gp) is not None:
                 continue
@@ -627,6 +651,8 @@ class _TopoSolve(_DeviceSolve):
             topo.record(pod, nd.en.cached_taints, joint)
             if gp:
                 nd.en.state_node.hostport_usage.add(pod, gp)
+            if vols is not None:
+                nd.en.state_node.volume_usage.add(pod, vols)
             return True
         return False
 

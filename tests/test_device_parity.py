@@ -254,6 +254,10 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
                 host_ip=rng.choice(["", "", "10.0.0.1"]),
                 protocol=rng.choice(["TCP", "TCP", "UDP"]),
             )
+        if rng.random() < 0.1:
+            # PVC-backed volumes: per-pod or shared claims against CSI
+            # attach limits on seeded existing nodes
+            kwargs["volume"] = rng.choice(["own", "own", f"shared-{si}"])
     selector = {}
     roll = rng.random()
     if roll < 0.3:
@@ -355,22 +359,74 @@ def build_case(seed: int, topo: bool = False):
         shapes[0][0]["topology_spread_constraints"] = [_random_spread(rng)]
     picks = [rng.randrange(len(shapes)) for _ in range(n_pods)]
 
+    # storage objects for volume shapes: StorageClass + one PVC per
+    # volume-bearing pod (or per shared group) + CSINode attach limits on
+    # some existing nodes (created BEFORE the Node so ingestion sees them)
+    storage: list = []
+    if topo and any(s[0].get("volume") for s in shapes):
+        from karpenter_tpu.apis.core import (
+            CSINode,
+            CSINodeDriver,
+            ObjectMeta,
+            PersistentVolumeClaim,
+            StorageClass,
+        )
+
+        driver = "ebs.csi.example.com"
+        storage.append(
+            StorageClass(metadata=ObjectMeta(name="fast"), provisioner=driver)
+        )
+        pvc_names = set()
+        for i, si in enumerate(picks):
+            mode = shapes[si][0].get("volume")
+            if mode == "own":
+                pvc_names.add(f"pvc-p-{i:05d}")
+            elif mode:
+                pvc_names.add(f"pvc-{mode}")
+        for name in sorted(pvc_names):
+            storage.append(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=name), storage_class_name="fast"
+                )
+            )
+        limited = [
+            CSINode(
+                metadata=ObjectMeta(name=node.metadata.name),
+                drivers=[
+                    CSINodeDriver(name=driver, allocatable_count=rng.randint(1, 2))
+                ],
+            )
+            for node in nodes
+            if rng.random() < 0.5
+        ]
+        nodes = limited + nodes
+
     def build_pods():
+        from karpenter_tpu.apis.core import Volume
+
         pods = []
         for i, si in enumerate(picks):
             kwargs, spec_kwargs = shapes[si]
             port = kwargs.get("host_port")
-            if port is not None:
-                kwargs = {k: v for k, v in kwargs.items() if k != "host_port"}
+            volume = kwargs.get("volume")
+            if port is not None or volume is not None:
+                kwargs = {
+                    k: v
+                    for k, v in kwargs.items()
+                    if k not in ("host_port", "volume")
+                }
             p = unschedulable_pod(name=f"p-{i:05d}", **kwargs, **spec_kwargs)
             if port is not None:
                 p.spec.containers[0].ports = [port]
+            if volume is not None:
+                pvc = f"pvc-p-{i:05d}" if volume == "own" else f"pvc-{volume}"
+                p.spec.volumes = [Volume(name="data", persistent_volume_claim=pvc)]
             p.metadata.uid = f"uid-{i:05d}"
             p.metadata.creation_timestamp = float(i % 7)  # exercise uid ties
             pods.append(p)
         return pods
 
-    return pools, nodes, bound, ds_pods, build_pods
+    return pools, storage + nodes, bound, ds_pods, build_pods
 
 
 def decisions(results):

@@ -11,6 +11,10 @@ from karpenter_tpu.cloudprovider.types import (
     Offerings,
     RESERVATION_ID_LABEL,
 )
+from karpenter_tpu.scheduler.nodeclaim import (
+    RESERVED_OFFERING_MODE_STRICT,
+    ReservedOfferingError,
+)
 from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
 from karpenter_tpu.utils.resources import parse_resource_list
 
@@ -181,3 +185,28 @@ class TestDeletingNodeRescheduling:
             for p in n.currently_reschedulable_pods(env.store, Limits.from_pdbs([]))
         ]
         assert resched == []
+
+
+class TestStrictReservedMode:
+    def test_strict_mode_errors_instead_of_falling_back(self):
+        """suite_test.go:3976 — with compatible reserved offerings that can't
+        be reserved, strict mode surfaces ReservedOfferingError instead of
+        silently falling back to on-demand."""
+        env = Env(
+            catalog=reserved_catalog(reservation_capacity=0),
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert not results.new_node_claims
+        [err] = list(results.pod_errors.values())
+        assert isinstance(err, ReservedOfferingError)
+
+    def test_strict_mode_reserves_when_capacity_available(self):
+        env = Env(
+            catalog=reserved_catalog(reservation_capacity=1),
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.reserved_offerings

@@ -6,8 +6,9 @@ reference line cited per test class), each run against BOTH solver paths:
 - device: the batched fast path (engine on, DEVICE_MIN_PODS patched to 1)
 
 Device runs assert DEVICE_SOLVES advanced; specs whose features the device
-path intentionally declines (hostname selectors, host ports, volumes)
-assert the fallback EXPLICITLY, so eligibility regressions can't hide.
+path intentionally declines (hostname selectors, reserved capacity,
+minValues) assert the fallback EXPLICITLY, so eligibility regressions can't
+hide.
 Topology and preferred-affinity/relaxation specs run the topo-aware driver
 (ops/ffd_topo.py) and must match host decisions exactly. Deleting-node rescheduling specs
 (suite_test.go:3545-3699) live with the provisioner/e2e tests instead —
@@ -830,3 +831,102 @@ class TestHostPortsBothPaths:
         assert sn.hostport_usage, "expected a port join on the existing node"
         solve.abort()
         assert not sn.hostport_usage, "abort left phantom port entries"
+
+    def test_abort_restores_existing_node_volume_usage(self):
+        # volume twin of the port rollback spec: a mid-solve fallback must
+        # not leave phantom PVC attach counts on the shared state node
+        from karpenter_tpu.apis.core import (
+            CSINode,
+            CSINodeDriver,
+            ObjectMeta,
+            PersistentVolumeClaim,
+            StorageClass,
+            Volume,
+        )
+        from karpenter_tpu.ops import ffd_topo
+        from karpenter_tpu.scheduler.scheduler import Scheduler
+        from karpenter_tpu.scheduler.topology import Topology
+
+        driver = "ebs.csi.example.com"
+        env = make_env("device")
+        env.store.create(
+            StorageClass(metadata=ObjectMeta(name="fast"), provisioner=driver)
+        )
+        env.store.create(
+            CSINode(
+                metadata=ObjectMeta(name="vn1"),
+                drivers=[CSINodeDriver(name=driver, allocatable_count=4)],
+            )
+        )
+        env.store.create(registered_node(name="vn1", pool="default"))
+        env.informer.flush()
+        pods = []
+        for i in range(2):
+            env.store.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name=f"rb-pvc-{i}"), storage_class_name="fast"
+                )
+            )
+            p = unschedulable_pod(
+                name=f"vp-{i}",
+                requests={"cpu": "100m"},
+                volumes=[Volume(name="data", persistent_volume_claim=f"rb-pvc-{i}")],
+            )
+            p.metadata.uid = f"vp-uid-{i}"
+            pods.append(p)
+        state_nodes = env.cluster.state_nodes()
+        topology = Topology(
+            env.store, env.cluster, state_nodes, env.node_pools,
+            env.instance_types, pods,
+        )
+        scheduler = Scheduler(
+            env.store, env.node_pools, env.cluster, state_nodes, topology,
+            env.instance_types, [], env.recorder, env.clock,
+            engine=env.scheduler_kwargs["engine"],
+        )
+        sn = state_nodes[0]
+        solve = ffd_topo._TopoSolve(scheduler, pods)
+        solve.run(60.0)
+        assert sn.volume_usage._volumes, "expected a volume join on the node"
+        solve.abort()
+        assert not sn.volume_usage._volumes, "abort left phantom volume entries"
+
+
+class TestExplicitDeviceFallbacks:
+    """The features the device path still declines must decline LOUDLY —
+    these specs pin the eligibility gates (ffd.py eligible())."""
+
+    def test_reserved_capacity_solve_falls_back(self, path):
+        from karpenter_tpu.ops.catalog import CatalogEngine
+
+        from test_reserved_and_deleting import reserved_catalog
+
+        catalog = reserved_catalog(reservation_capacity=1)
+        kwargs = {"catalog": catalog}
+        if path == "device":
+            kwargs["engine"] = CatalogEngine(catalog)
+        env = Env(**kwargs)
+        results = schedule(
+            path, [unschedulable_pod(requests={"cpu": "1"})],
+            device_falls_back=True, env=env,
+        )
+        assert not results.pod_errors
+
+    def test_min_values_solve_falls_back(self, path):
+        pools = [
+            nodepool(
+                "default",
+                requirements=[
+                    {
+                        "key": wk.LABEL_INSTANCE_TYPE,
+                        "operator": "Exists",
+                        "minValues": 2,
+                    }
+                ],
+            )
+        ]
+        results = schedule(
+            path, [unschedulable_pod(requests={"cpu": "1"})],
+            device_falls_back=True, node_pools=pools,
+        )
+        assert not results.pod_errors

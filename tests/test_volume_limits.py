@@ -1,6 +1,8 @@
 """Volume-limit scheduling specs (reference suite_test.go:2776-2919):
 CSI attach limits on existing nodes force overflow onto new capacity;
-pods sharing one PVC count it once; strict reserved offering mode."""
+pods sharing one PVC count it once. Every spec runs on BOTH solver paths
+(volume shapes take the topo driver's volatile node path). The
+strict-reserved-mode specs live in test_reserved_and_deleting.py."""
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import (
@@ -11,14 +13,12 @@ from karpenter_tpu.apis.core import (
     StorageClass,
     Volume,
 )
-from karpenter_tpu.scheduler.nodeclaim import (
-    RESERVED_OFFERING_MODE_STRICT,
-    ReservedOfferingError,
-)
-
+from device_path import both_paths_fixture
 from helpers import node_claim_pair, nodepool, unschedulable_pod
-from test_reserved_and_deleting import reserved_catalog
-from test_scheduler import Env
+from test_scheduler import Env as HostEnv
+
+Env = HostEnv
+path = both_paths_fixture(globals())
 
 DRIVER = "ebs.csi.example.com"
 
@@ -78,28 +78,3 @@ class TestVolumeLimits:
         results = env.schedule(pods)
         assert not results.pod_errors
         assert not results.new_node_claims
-
-
-class TestStrictReservedMode:
-    def test_strict_mode_errors_instead_of_falling_back(self):
-        """suite_test.go:3976 — with compatible reserved offerings that can't
-        be reserved, strict mode surfaces ReservedOfferingError instead of
-        silently falling back to on-demand."""
-        env = Env(
-            catalog=reserved_catalog(reservation_capacity=0),
-            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
-        )
-        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
-        assert not results.new_node_claims
-        [err] = list(results.pod_errors.values())
-        assert isinstance(err, ReservedOfferingError)
-
-    def test_strict_mode_reserves_when_capacity_available(self):
-        env = Env(
-            catalog=reserved_catalog(reservation_capacity=1),
-            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
-        )
-        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
-        assert not results.pod_errors
-        [nc] = results.new_node_claims
-        assert nc.reserved_offerings
