@@ -434,7 +434,9 @@ class NodeClaim:
         return self
 
     def to_api_nodeclaim(self):
-        """Template stamp with this claim's narrowed requirements/types."""
+        """Template stamp with this claim's narrowed requirements/types and
+        accumulated resource requests (daemon overhead + every added pod —
+        the reference carries them on Spec.Resources, nodeclaim.go:98,172)."""
         template = self.template
         saved_reqs, saved_its = template.requirements, template.instance_type_options
         template.requirements = self.requirements
@@ -442,6 +444,7 @@ class NodeClaim:
         try:
             claim = template.to_node_claim()
             claim.metadata.annotations.update(self.annotations)
+            claim.spec.resources.requests = dict(self.requests)
         finally:
             template.requirements, template.instance_type_options = saved_reqs, saved_its
         return claim

@@ -4,7 +4,19 @@ pkg/controllers/provisioning/suite_test.go — names kept, lines cited)."""
 import pytest
 
 from karpenter_tpu.apis import labels as wk
-from karpenter_tpu.apis.core import Container, ObjectMeta, Pod, PodSpec, pod_resource_requests
+from karpenter_tpu.apis.core import (
+    Affinity,
+    Container,
+    NodeAffinity,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    pod_resource_requests,
+)
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.utils.resources import parse_resource_list
 
@@ -152,3 +164,259 @@ class TestDaemonSetAccounting:
         pod.spec.containers[0].requests = {}
         results = env.schedule([pod])
         assert not results.pod_errors
+
+
+class TestDaemonSetEligibility:
+    """suite_test.go:1045-1320 — which daemonsets count toward claim
+    overhead. Asserted via the scheduler-sim claim's accumulated requests
+    (the created-claim stamping itself is covered by the hostname-affinity
+    and request-carrying specs below)."""
+
+    def _overhead_env(self, ds_pod, **pool_kwargs):
+        return Env(node_pools=[nodepool("default", **pool_kwargs)], daemonset_pods=[ds_pod])
+
+    def _claim_cpu(self, env, pod_kwargs=None):
+        results = env.schedule(
+            [unschedulable_pod(**(pod_kwargs or {"requests": {"cpu": "1"}}))]
+        )
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        return nc.requests["cpu"]
+
+    def test_intolerable_daemonset_ignored(self):
+        # suite_test.go:1045 — pool tainted; the daemon lacks a toleration
+        dp = daemonset_pod(daemonset(requests={"cpu": "2"}))
+        env = self._overhead_env(
+            dp, taints=[Taint(key="foo", value="bar", effect="NoSchedule")]
+        )
+        cpu = self._claim_cpu(
+            env,
+            {
+                "requests": {"cpu": "1"},
+                "tolerations": [Toleration(operator="Exists")],
+            },
+        )
+        assert cpu == pytest.approx(1.0)
+
+    def test_invalid_selector_daemonset_ignored(self):
+        # suite_test.go:1077
+        dp = daemonset_pod(daemonset(requests={"cpu": "2"}))
+        dp.spec.node_selector = {"node": "invalid"}
+        env = self._overhead_env(dp)
+        assert self._claim_cpu(env) == pytest.approx(1.0)
+
+    def test_not_in_unspecified_key_daemonset_counted(self):
+        # suite_test.go:1099 — NotIn over an undefined key matches
+        dp = daemonset_pod(daemonset(requests={"cpu": "2"}))
+        dp.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {"key": "foo", "operator": "NotIn", "values": ["bar"]}
+                        ]
+                    )
+                ]
+            )
+        )
+        env = self._overhead_env(dp)
+        assert self._claim_cpu(env) == pytest.approx(3.0)
+
+    def test_hostname_affinity_daemonset_replaced_by_template(self):
+        # suite_test.go:1122 — the daemonset controller stamps per-node name
+        # affinity on live pods; the provisioner replaces it with the
+        # TEMPLATE's required affinity while keeping the live pod's requests
+        # (which a LimitRange may have overridden)
+        from karpenter_tpu.apis.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorTerm,
+        )
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(nodepool("default", labels={"foo": "bar"}))
+        ds = daemonset(requests={"cpu": "4"})
+        ds.spec.template_spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {"key": "foo", "operator": "In", "values": ["bar"]}
+                        ]
+                    )
+                ]
+            )
+        )
+        store.create(ds)
+        live = daemonset_pod(ds, node_name="node-name")
+        live.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": "metadata.name",
+                                "operator": "In",
+                                "values": ["node-name"],
+                            }
+                        ]
+                    )
+                ]
+            )
+        )
+        live.spec.containers[0].requests = parse_resource_list({"cpu": "2"})
+        store.create(live)
+        informer.flush()
+        pod = store.create(
+            unschedulable_pod(
+                requests={"cpu": "1"}, node_selector={"foo": "bar"}
+            )
+        )
+        run_batch(harness, [pod])
+        [claim] = store.list("NodeClaim")
+        # live requests (2) respected, hostname pin replaced: daemon counted
+        assert claim.spec.resources.requests["cpu"] == pytest.approx(3.0)
+
+    def test_multi_term_affinity_daemonset_counted(self):
+        # suite_test.go:1194 — one incompatible OR term doesn't disqualify
+        dp = daemonset_pod(daemonset(requests={"cpu": "2"}))
+        dp.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {"key": "undefined-custom", "operator": "In", "values": ["x"]}
+                        ]
+                    ),
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": wk.LABEL_TOPOLOGY_ZONE,
+                                "operator": "In",
+                                "values": ["kwok-zone-1"],
+                            }
+                        ]
+                    ),
+                ]
+            )
+        )
+        env = self._overhead_env(dp)
+        assert self._claim_cpu(env) == pytest.approx(3.0)
+
+    def test_incompatible_preference_daemonset_counted(self):
+        # suite_test.go:1254 — preferences are ignored for daemon compat
+        dp = daemonset_pod(daemonset(requests={"cpu": "2"}))
+        dp.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                {"key": "undefined-custom", "operator": "In", "values": ["x"]}
+                            ]
+                        ),
+                    )
+                ]
+            )
+        )
+        env = self._overhead_env(dp)
+        assert self._claim_cpu(env) == pytest.approx(3.0)
+
+    def test_prefer_no_schedule_taint_daemonset_counted(self):
+        # suite_test.go:1282 — daemons auto-tolerate PreferNoSchedule
+        dp = daemonset_pod(daemonset(requests={"cpu": "2"}))
+        env = self._overhead_env(
+            dp, taints=[Taint(key="soft", value="true", effect="PreferNoSchedule")]
+        )
+        cpu = self._claim_cpu(
+            env,
+            {
+                "requests": {"cpu": "1"},
+                "tolerations": [Toleration(operator="Exists")],
+            },
+        )
+        assert cpu == pytest.approx(3.0)
+
+
+class TestNodeClaimRequestContents:
+    """suite_test.go:1468-1745 — what the created NodeClaim carries."""
+
+    def _provision_one(self, pool, pod=None):
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(pool)
+        p = store.create(pod or unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [p])
+        [claim] = store.list("NodeClaim")
+        return claim
+
+    def test_request_has_expected_requirements(self):
+        # suite_test.go:1468 — instance-type and nodepool requirements
+        pool = nodepool("default")
+        claim = self._provision_one(pool)
+        by_key = {r["key"]: r for r in claim.spec.requirements}
+        assert by_key[wk.NODEPOOL_LABEL_KEY]["values"] == ["default"]
+        assert wk.LABEL_INSTANCE_TYPE in by_key
+        assert len(by_key[wk.LABEL_INSTANCE_TYPE]["values"]) >= 1
+
+    def test_request_has_additional_requirements(self):
+        # suite_test.go:1489 — custom template requirements propagate
+        pool = nodepool(
+            "default",
+            requirements=[
+                {"key": "custom-requirement-key", "operator": "In", "values": ["value"]},
+                {"key": "custom-requirement-key2", "operator": "In", "values": ["value"]},
+            ],
+        )
+        claim = self._provision_one(pool)
+        by_key = {r["key"]: r for r in claim.spec.requirements}
+        assert by_key["custom-requirement-key"]["values"] == ["value"]
+        assert by_key["custom-requirement-key2"]["values"] == ["value"]
+
+    def test_request_restricts_instance_types_on_architecture(self):
+        # suite_test.go:1543
+        pool = nodepool(
+            "default",
+            requirements=[{"key": wk.LABEL_ARCH, "operator": "In", "values": ["arm64"]}],
+        )
+        claim = self._provision_one(pool)
+        by_key = {r["key"]: r for r in claim.spec.requirements}
+        assert by_key[wk.LABEL_ARCH]["values"] == ["arm64"]
+        assert all("arm64" in name for name in by_key[wk.LABEL_INSTANCE_TYPE]["values"])
+
+    def test_request_has_owner_reference(self):
+        # suite_test.go:1648
+        pool = nodepool("default")
+        claim = self._provision_one(pool)
+        [ref] = [o for o in claim.metadata.owner_references if o.kind == "NodePool"]
+        assert ref.name == "default"
+        assert ref.uid == pool.metadata.uid
+
+    def test_request_propagates_node_class_ref(self):
+        # suite_test.go:1666
+        pool = nodepool("default")
+        pool.spec.template.spec.node_class_ref.group = "karpenter.test.sh"
+        pool.spec.template.spec.node_class_ref.kind = "TestNodeClass"
+        pool.spec.template.spec.node_class_ref.name = "test"
+        claim = self._provision_one(pool)
+        ref = claim.spec.node_class_ref
+        assert (ref.group, ref.kind, ref.name) == (
+            "karpenter.test.sh",
+            "TestNodeClass",
+            "test",
+        )
+
+    def test_request_carries_resource_requests_with_daemon_overhead(self):
+        # suite_test.go:1694/1720
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(nodepool("default"))
+        ds = daemonset(requests={"cpu": "1"})
+        store.create(ds)
+        p = store.create(unschedulable_pod(requests={"cpu": "1", "memory": "1Mi"}))
+        run_batch(harness, [p])
+        [claim] = store.list("NodeClaim")
+        assert claim.spec.resources.requests["cpu"] == pytest.approx(2.0)
