@@ -227,13 +227,6 @@ class TestDaemonSetEligibility:
         # affinity on live pods; the provisioner replaces it with the
         # TEMPLATE's required affinity while keeping the live pod's requests
         # (which a LimitRange may have overridden)
-        from karpenter_tpu.apis.core import (
-            Affinity,
-            NodeAffinity,
-            NodeSelectorTerm,
-        )
-        from karpenter_tpu.utils.resources import parse_resource_list
-
         harness = make_provisioner_harness()
         clock, store, provider, cluster, informer, prov = harness
         store.create(nodepool("default", labels={"foo": "bar"}))
@@ -420,3 +413,66 @@ class TestNodeClaimRequestContents:
         run_batch(harness, [p])
         [claim] = store.list("NodeClaim")
         assert claim.spec.resources.requests["cpu"] == pytest.approx(2.0)
+
+
+class TestClaimMetadataStamping:
+    """suite_test.go:1321-1394 — template annotations/labels and
+    requirement-derived labels land on created claims."""
+
+    def _claim_for(self, pool):
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(pool)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        [claim] = store.list("NodeClaim")
+        return claim
+
+    def test_annotations_propagate(self):
+        # suite_test.go:1321
+        pool = nodepool("default")
+        pool.spec.template.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        claim = self._claim_for(pool)
+        assert claim.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] == "true"
+
+    def test_labels_propagate(self):
+        # suite_test.go:1339 — template labels + single-valued In
+        # requirements become labels; other operators don't
+        pool = nodepool(
+            "default",
+            labels={"test-key-1": "test-value-1"},
+            requirements=[
+                {"key": "test-key-2", "operator": "In", "values": ["test-value-2"]},
+                {"key": "test-key-3", "operator": "NotIn", "values": ["test-value-3"]},
+            ],
+        )
+        claim = self._claim_for(pool)
+        assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "default"
+        assert claim.metadata.labels["test-key-1"] == "test-value-1"
+        by_key = {r["key"]: r for r in claim.spec.requirements}
+        assert by_key["test-key-2"]["values"] == ["test-value-2"]
+        assert by_key["test-key-3"]["operator"] == "NotIn"
+
+
+class TestHealthyNodePoolScheduledTime:
+    """suite_test.go:305-332 — the healthy-pool scheduled timestamp drives
+    the pod-provisioning-latency SLO metric."""
+
+    def _run(self, healthy):
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        pool = nodepool("default")
+        pool.set_condition("NodeRegistrationHealthy", "True" if healthy else "False")
+        store.create(pool)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        key = (pod.metadata.namespace, pod.metadata.name)
+        return key in cluster.pod_healthy_nodepool_scheduled_time
+
+    def test_marked_when_nodepool_registration_healthy(self):
+        # suite_test.go:305
+        assert self._run(healthy=True) is True
+
+    def test_not_marked_when_nodepool_registration_unhealthy(self):
+        # suite_test.go:319
+        assert self._run(healthy=False) is False
