@@ -36,7 +36,11 @@ DEFAULT_RESOURCE_DIMS = (
 
 
 def _req_cache_key(r: Requirement) -> tuple:
-    return (r.key, r.complement, r.greater_than, r.less_than, frozenset(r.values))
+    # min_values never affects compat masks, but interned rows feed the
+    # solver's canonical requirement families (ops/ffd.py fam_reqs) and the
+    # emitted claim requirements — conflating rows that differ only in
+    # minValues would stamp one template's minValues onto another's claims.
+    return (r.key, r.complement, r.greater_than, r.less_than, frozenset(r.values), r.min_values)
 
 
 _RTT_CACHE: dict[str, float] = {}
@@ -237,6 +241,31 @@ class CatalogEngine:
     @property
     def num_rows(self) -> int:
         return len(self._rows)
+
+    def value_matrix(self, key: str) -> np.ndarray:
+        """[n_values, I] bool — value-membership of each instance type's own
+        declared requirement for `key` (types not defining the key contribute
+        no values). Feeds the solver's minValues distinct-value counting
+        (types.go:190-224: counts union the type-DECLARED values, not the
+        query-narrowed ones). Cached per key for the engine's lifetime — the
+        catalog is immutable."""
+        cache = getattr(self, "_value_matrices", None)
+        if cache is None:
+            cache = self._value_matrices = {}
+        M = cache.get(key)
+        if M is None:
+            vals: dict[str, int] = {}
+            cols: list[tuple[int, int]] = []
+            for i, it in enumerate(self.instance_types):
+                row = it.requirements.get(key)
+                for v in row.values:
+                    vi = vals.setdefault(v, len(vals))
+                    cols.append((vi, i))
+            M = np.zeros((len(vals), self.num_instances), dtype=bool)
+            for vi, i in cols:
+                M[vi, i] = True
+            cache[key] = M
+        return M
 
     def _maybe_reencode(self) -> None:
         """Re-encode the catalog if the vocabulary outgrew the padded

@@ -124,8 +124,13 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     # tolerable (preferences.go:133-145) — shape groups would go stale.
     if scheduler.preferences.tolerate_prefer_no_schedule:
         return False
-    # Reserved capacity and minValues interplay stays host-side. The scan is
-    # cached on the (immutable) engine catalog.
+    # Reserved capacity: fallback mode (the default) is device-supported —
+    # reservation bookkeeping runs on every join exactly like the host's
+    # can_add→Add cycle and never REJECTS a candidate, so the monotone
+    # machinery stays sound. Strict mode turns reservation exhaustion into
+    # non-monotone candidate rejections plus scan-aborting
+    # ReservedOfferingErrors (scheduler.go:519,574 short-circuits) — host
+    # path. The catalog scan is cached on the (immutable) engine catalog.
     if scheduler.reserved_capacity_enabled:
         has_reserved = getattr(scheduler.engine, "_kt_has_reserved", None)
         if has_reserved is None:
@@ -136,11 +141,24 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
             )
             scheduler.engine._kt_has_reserved = has_reserved
         if has_reserved:
-            return False
+            from karpenter_tpu.scheduler.nodeclaim import (
+                RESERVED_OFFERING_MODE_FALLBACK,
+            )
+
+            if scheduler.reserved_offering_mode != RESERVED_OFFERING_MODE_FALLBACK:
+                return False
     dims = scheduler.engine.resource_dims
     for nct in scheduler.nodeclaim_templates:
         if nct.requirements.has_min_values():
-            return False
+            # Strict policy is fully supported (monotone: narrowing only
+            # shrinks the distinct-value count, so rejections are permanent).
+            # BestEffort relaxation MUTATES requirement rows mid-solve
+            # (nodeclaim.go:425-436 minValues write-back), which would
+            # corrupt the interned family rows — host path.
+            from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_STRICT
+
+            if scheduler.min_values_policy != MIN_VALUES_POLICY_STRICT:
+                return False
         # hostname-constrained templates would break family sharing (the
         # canonical family Requirements are hostname-free)
         if nct.requirements.has(wk.LABEL_HOSTNAME):
@@ -315,7 +333,7 @@ class _Claim:
 
     __slots__ = (
         "ti", "fam", "hostname", "type_mask", "u_ids", "rem", "count", "rank",
-        "members", "group_counts", "gdrop", "gknown",
+        "members", "group_counts", "gdrop", "gknown", "reserved",
     )
 
     def __init__(self, ti, fam, hostname, type_mask, u_ids, rem, rank):
@@ -334,6 +352,9 @@ class _Claim:
         # is a no-op). Subsumption survives further narrowing, so membership
         # is permanent.
         self.gknown: set[int] = set()
+        # reserved offerings currently held (nodeclaim.go:166-205), refreshed
+        # on every successful join like the host's can_add→Add cycle
+        self.reserved: list = []
 
 
 class _Node:
@@ -671,11 +692,68 @@ class _DeviceSolve:
         # per-claim-index HostPortUsage; populated only by the topo driver
         # when host ports are in play (plain solves gate ports shapes out)
         self._claim_hp: dict[int, HostPortUsage] = {}
+        # set for real in _prepare_templates; abort() may run before that
+        # (e.g. an ineligible shape found during grouping)
+        self.min_active = False
+        self.res_active = False
+        self._saved_rm: Optional[tuple] = None
 
     def abort(self) -> None:
         """Undo external state mutations before a host fallback. The plain
-        solver mutates nothing outside itself until emit; the topo driver
-        overrides this to restore topology counts/ownership."""
+        solver mutates nothing outside itself until emit EXCEPT reservation
+        bookkeeping; the topo driver overrides this to additionally restore
+        topology counts/ownership."""
+        self._restore_rm()
+
+    def _restore_rm(self) -> None:
+        if self._saved_rm is not None:
+            rm = self.s.reservation_manager
+            reservations, capacity = self._saved_rm
+            rm._reservations = {h: set(ids) for h, ids in reservations.items()}
+            rm._capacity = dict(capacity)
+
+    # -- reserved offerings (fallback mode; nodeclaim.go:166-205,324-346) ----
+
+    def _reserved_for(self, c: "_Claim") -> list:
+        """The host's _offerings_to_reserve over the claim's current
+        surviving types: reserved offerings compatible with the claim's
+        requirements that can still be reserved for its hostname, in catalog
+        order. Fallback mode never rejects, so this runs only on successful
+        joins — exactly the host's can_add→Add cadence."""
+        surv_u = np.zeros(self.U, dtype=bool)
+        surv_u[c.u_ids] = True
+        final = c.type_mask & surv_u[self.uid_of_type]
+        rm = self.s.reservation_manager
+        reqs = self.fam_reqs[c.fam]
+        out = []
+        for i, offs in self.res_offs:
+            if not final[i]:
+                continue
+            for oi, o in enumerate(offs):
+                if not o.available:
+                    continue
+                key = (c.fam, i, oi)
+                ok = self._res_compat.get(key)
+                if ok is None:
+                    ok = reqs.is_compatible(
+                        o.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                    )
+                    self._res_compat[key] = ok
+                if ok and rm.can_reserve(c.hostname, o):
+                    out.append(o)
+        return out
+
+    def _apply_reserved(self, c: "_Claim") -> None:
+        """NodeClaim.add's reservation tail: reserve the fresh set, release
+        ids that dropped out (nodeclaim.go:337-346)."""
+        updated = self._reserved_for(c)
+        rm = self.s.reservation_manager
+        rm.reserve(c.hostname, *updated)
+        updated_ids = {o.reservation_id for o in updated}
+        for o in c.reserved:
+            if o.reservation_id not in updated_ids:
+                rm.release(c.hostname, o)
+        c.reserved = updated
 
     def _order_hook_add(self, ci: int) -> None:
         """Claim-order observer: a claim was opened (index ci). The topo
@@ -833,6 +911,46 @@ class _DeviceSolve:
         self.tmpl_mask = np.zeros((T, self.I), dtype=bool)
         self.tmpl_options: list[list] = []
         self.usage0_f = np.zeros((T, self.D), dtype=np.float64)
+        # minValues specs per template: only template rows carry minValues
+        # (pods can't set it; joint merges keep the template's via max-merge),
+        # so the per-claim check is fully determined by (ti, surviving types)
+        self.tmpl_min: list[list[tuple[str, int]]] = [
+            [
+                (r.key, r.min_values)
+                for r in s.nodeclaim_templates[ti].requirements
+                if r.min_values is not None
+            ]
+            for ti in range(T)
+        ]
+        self.min_active = any(self.tmpl_min)
+        # reserved-capacity bookkeeping (fallback mode): per-type reserved
+        # offerings in catalog order + a snapshot of the ReservationManager
+        # so a fallback abort leaves the host loop uncorrupted state
+        self.res_active = bool(
+            s.reserved_capacity_enabled
+            and getattr(e, "_kt_has_reserved", False)
+        )
+        self._saved_rm: Optional[tuple] = None
+        if self.res_active:
+            self.res_offs: list[tuple[int, list]] = []
+            for i, it in enumerate(e.instance_types):
+                if it.has_reserved_offerings:
+                    self.res_offs.append(
+                        (
+                            i,
+                            [
+                                o
+                                for o in it.offerings
+                                if o.capacity_type == wk.CAPACITY_TYPE_RESERVED
+                            ],
+                        )
+                    )
+            self._res_compat: dict[tuple[int, int], bool] = {}
+            rm = s.reservation_manager
+            self._saved_rm = (
+                {h: set(ids) for h, ids in rm._reservations.items()},
+                dict(rm._capacity),
+            )
         index = {id(it): i for i, it in enumerate(e.instance_types)}
         name_index = {it.name: i for i, it in enumerate(e.instance_types)}
         self.opt_index: list[list[int]] = []
@@ -1004,6 +1122,17 @@ class _DeviceSolve:
                     c.gdrop.add(gi)  # usage only grows: permanently full
                     heapq.heappop(heap)
                     continue
+                # a fit-shrunk option set can newly violate minValues (the
+                # host re-filters on every can_add); unchanged sets passed
+                # when the claim last changed
+                if (
+                    self.min_active
+                    and not fitrows.all()
+                    and not self._min_join_ok(c, c.u_ids[fitrows])
+                ):
+                    c.gdrop.add(gi)  # diversity only shrinks: permanent
+                    heapq.heappop(heap)
+                    continue
             else:
                 fitrows = self._try_first_join(c, pod, g, gi)
                 if fitrows is None:
@@ -1025,6 +1154,8 @@ class _DeviceSolve:
             heapq.heapreplace(heap, (c.count, c.rank, ci))
             self._joined = c
             self._order_hook_move(ci, (count, rank, ci), (c.count, c.rank, ci))
+            if self.res_active:
+                self._apply_reserved(c)
             return True
         return False
 
@@ -1063,6 +1194,10 @@ class _DeviceSolve:
             fitrows = keep & (c.rem >= g.fit_floor).all(axis=1)
             if not fitrows.any():
                 return None
+            if self.min_active and not self._min_join_ok(
+                c, c.u_ids[fitrows], new_mask
+            ):
+                return None
             # commit the requirement-level narrowing (host narrows options on
             # every successful Add with the joint set)
             c.type_mask = new_mask
@@ -1073,6 +1208,12 @@ class _DeviceSolve:
             return fitrows[keep]
         fitrows = (c.rem >= g.fit_floor).all(axis=1)
         if not fitrows.any():
+            return None
+        if (
+            self.min_active
+            and not fitrows.all()
+            and not self._min_join_ok(c, c.u_ids[fitrows])
+        ):
             return None
         c.gknown.add(gi)
         return fitrows
@@ -1178,6 +1319,18 @@ class _DeviceSolve:
                     self._open_errs[(ti, gi)] = err
                 errs.append(err)
                 continue
+            if self.min_active and self.tmpl_min[ti]:
+                surv_u = np.zeros(self.U, dtype=bool)
+                surv_u[cand_u[fitrows]] = True
+                msg = self._min_fail(ti, candidate & surv_u[self.uid_of_type])
+                if msg is not None:
+                    err = self._filter_error(base, compat_v, offer_v, ti, g)
+                    err.min_values_incompatible = msg
+                    if limits_mask is None:
+                        self.open_cache[(ti, gi)] = (-1, None, None, None)
+                        self._open_errs[(ti, gi)] = err
+                    errs.append(err)
+                    continue
             # success: open the claim
             fam = self._intern_fam(rows, joint_tg)
             u_ids = cand_u[fitrows]
@@ -1242,6 +1395,8 @@ class _DeviceSolve:
         c.gknown.add(gi)
         self.claims.append(c)
         self._order_hook_add(len(self.claims) - 1)
+        if self.res_active:
+            self._apply_reserved(c)
 
     def _limits_mask(self, remaining: dict) -> np.ndarray:
         """Types whose CAPACITY fits inside the nodepool's remaining limits
@@ -1271,6 +1426,37 @@ class _DeviceSolve:
             for k, v in remaining.items()
         }
         self.limits_version += 1
+
+    # -- minValues (nodeclaim.go:425-436, types.go:190-224) ------------------
+
+    def _min_fail(self, ti: int, surv_types: np.ndarray) -> Optional[str]:
+        """The host's strict minValues gate over a surviving-type mask:
+        None when every template minValues key counts enough distinct
+        type-declared values, else the host's error message. The host skips
+        the check entirely when `remaining` is empty (satisfies_min_values
+        returns no error for zero types) — callers only reach here with a
+        non-empty surviving set."""
+        bad = []
+        for key, needed in self.tmpl_min[ti]:
+            M = self.engine.value_matrix(key)
+            count = int(M[:, surv_types].any(axis=1).sum()) if M.size else 0
+            if count < needed:
+                bad.append(key)
+        if bad:
+            return f"minValues requirement is not met for label(s) {sorted(bad)}"
+        return None
+
+    def _min_join_ok(self, c: "_Claim", new_u: np.ndarray, new_mask=None) -> bool:
+        """Would claim c still satisfy its template's minValues after a join
+        that leaves unique-alloc rows `new_u` (and optionally narrows the
+        type mask)? Monotone: once False for a (claim, group) pair it stays
+        False — callers may reject permanently."""
+        if not self.tmpl_min[c.ti]:
+            return True
+        mask = c.type_mask if new_mask is None else new_mask
+        surv_u = np.zeros(self.U, dtype=bool)
+        surv_u[new_u] = True
+        return self._min_fail(c.ti, mask & surv_u[self.uid_of_type]) is None
 
     def _filter_error(
         self,
@@ -1312,7 +1498,11 @@ class _DeviceSolve:
         order = self._order(gi_arr)
         from karpenter_tpu.ops import native as nat
 
-        if nat.get_lib() is not None:
+        # The native kernel's steady-state joins run without up-calls, so
+        # they can't re-run the minValues diversity gate or the per-join
+        # reservation bookkeeping — those solves take the instrumented
+        # Python loop (identical semantics, rare catalog shapes)
+        if nat.get_lib() is not None and not self.min_active and not self.res_active:
             pods_sorted = [self.pods[i] for i in order]
             driver = _NativeDriver(
                 self, pods_sorted, np.ascontiguousarray(gi_arr[order]), timeout
@@ -1427,6 +1617,11 @@ class _DeviceSolve:
                 requests,
             )
             nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] = "false"
+            if self.res_active and c.reserved:
+                # reservations were already applied to the shared manager at
+                # join time; finalize_scheduling pins capacity-type +
+                # reservation ids from this list (nodeclaim.go:207-220)
+                nc.reserved_offerings = list(c.reserved)
             s.new_node_claims.append(nc)
 
 

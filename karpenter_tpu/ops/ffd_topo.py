@@ -498,6 +498,7 @@ class _TopoSolve(_DeviceSolve):
         if self._aborted:
             return
         self._aborted = True
+        self._restore_rm()
         topo = self.topology
         if self._saved_group_dicts is not None:
             groups, inverse, shapes = self._saved_group_dicts
@@ -707,6 +708,8 @@ class _TopoSolve(_DeviceSolve):
         c.members.append(pod)
         c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
         self._scan.move(ci, old_key, (c.count, c.rank, ci))
+        if self.res_active:
+            self._apply_reserved(c)
 
     def _try_claims_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
         topo = self.topology
@@ -759,6 +762,12 @@ class _TopoSolve(_DeviceSolve):
                     fitrows = (c.rem >= g.fit_floor).all(axis=1)
                     if not fitrows.any():
                         continue
+                    if (
+                        self.min_active
+                        and not fitrows.all()
+                        and not self._min_join_ok(c, c.u_ids[fitrows])
+                    ):
+                        continue
                     self._commit_join(c, ci, pod, g, gi, fitrows)
                     self._apply_record_plan(gi, c)
                     if gp:
@@ -788,6 +797,12 @@ class _TopoSolve(_DeviceSolve):
                 fitrows = (c.rem >= g.fit_floor).all(axis=1)
                 if not fitrows.any():
                     continue
+                if (
+                    self.min_active
+                    and not fitrows.all()
+                    and not self._min_join_ok(c, c.u_ids[fitrows])
+                ):
+                    continue
             else:
                 compat_v, offer_v = self._joint_masks(final_rows, joint)
                 new_mask = c.type_mask & compat_v & offer_v
@@ -796,6 +811,10 @@ class _TopoSolve(_DeviceSolve):
                 keep = surv_u[c.u_ids]
                 fitrows = keep & (c.rem >= g.fit_floor).all(axis=1)
                 if not fitrows.any():
+                    continue
+                if self.min_active and not self._min_join_ok(
+                    c, c.u_ids[fitrows], new_mask
+                ):
                     continue
                 c.type_mask = new_mask
                 c.rem = c.rem[keep]
@@ -897,6 +916,15 @@ class _TopoSolve(_DeviceSolve):
             if not fitrows.any():
                 errs.append(self._filter_error(base, compat_v, offer_v, ti, g))
                 continue
+            if self.min_active and self.tmpl_min[ti]:
+                surv_u = np.zeros(self.U, dtype=bool)
+                surv_u[cand_u[fitrows]] = True
+                msg = self._min_fail(ti, candidate & surv_u[self.uid_of_type])
+                if msg is not None:
+                    err = self._filter_error(base, compat_v, offer_v, ti, g)
+                    err.min_values_incompatible = msg
+                    errs.append(err)
+                    continue
             canon = Requirements(*(r for r in joint if r.key != wk.LABEL_HOSTNAME))
             fam = self._intern_fam(final_rows, canon)
             u_ids = cand_u[fitrows]

@@ -50,6 +50,67 @@ from helpers import (
 from test_scheduler import Env
 
 CATALOG = construct_instance_types()
+_CATALOG_RES = None
+
+
+def reserved_catalog():
+    """The kwok catalog with deterministic reserved offerings grafted onto
+    every 9th type (two zones, ~quarter price, small per-reservation
+    capacities) — exercises the fallback-mode reservation bookkeeping:
+    capacity counting across claims, release on narrowing, finalize pinning."""
+    global _CATALOG_RES
+    if _CATALOG_RES is not None:
+        return _CATALOG_RES
+    from karpenter_tpu.cloudprovider.types import (
+        RESERVATION_ID_LABEL,
+        InstanceType,
+        Offering,
+        Offerings,
+    )
+    from karpenter_tpu.scheduling.requirements import (
+        Operator,
+        Requirement,
+        Requirements,
+    )
+
+    out = []
+    for i, it in enumerate(CATALOG):
+        if i % 9 != 0:
+            out.append(it)
+            continue
+        od = min(o.price for o in it.offerings)
+        res_offs = [
+            Offering(
+                requirements=Requirements(
+                    Requirement(
+                        wk.CAPACITY_TYPE_LABEL_KEY,
+                        Operator.IN,
+                        [wk.CAPACITY_TYPE_RESERVED],
+                    ),
+                    Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [zone]),
+                    Requirement(
+                        RESERVATION_ID_LABEL, Operator.IN, [f"cr-{i}-{zone}"]
+                    ),
+                ),
+                price=od * 0.25,
+                available=True,
+                reservation_capacity=1 + (i // 9) % 3,
+            )
+            for zone in ("kwok-zone-1", "kwok-zone-2")
+        ]
+        out.append(
+            InstanceType(
+                name=it.name,
+                requirements=it.requirements,
+                offerings=Offerings(list(it.offerings) + res_offs),
+                capacity=it.capacity,
+                overhead=it.overhead,
+            )
+        )
+    _CATALOG_RES = out
+    return out
+
+
 ZONES = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
 ARCHS = ["amd64", "arm64"]
 OSES = ["linux", "windows"]
@@ -85,6 +146,18 @@ def _random_nodepools(rng: random.Random, topo: bool = False):
                     "key": wk.LABEL_TOPOLOGY_ZONE,
                     "operator": rng.choice(["In", "NotIn"]),
                     "values": rng.sample(ZONES, rng.randint(1, 2)),
+                }
+            )
+        if rng.random() < 0.25:
+            # strict-policy minValues (device-supported since round 4):
+            # diversity gates reject joins as claims narrow
+            requirements.append(
+                {
+                    "key": rng.choice(
+                        [wk.LABEL_INSTANCE_TYPE, "karpenter.kwok.sh/instance-family"]
+                    ),
+                    "operator": "Exists",
+                    "minValues": rng.choice([2, 3, 5, 12]),
                 }
             )
         taints = []
@@ -299,9 +372,11 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
     return kwargs, spec_kwargs
 
 
-def build_case(seed: int, topo: bool = False):
+def build_case(seed: int, topo: bool = False, reserved: bool = False):
     """(node_pools, state_nodes, bound_pods, daemonset_pods, build_pods)."""
-    rng = random.Random(seed if not topo else seed + 1_000_000)
+    rng = random.Random(
+        seed + 1_000_000 if topo else seed + 2_000_000 if reserved else seed
+    )
     pools = _random_nodepools(rng, topo)
     nodes = []
     bound = []
@@ -457,9 +532,10 @@ def decisions(results):
     return claims, existing, errors
 
 
-def run_case(seed: int, topo: bool = False):
+def run_case(seed: int, topo: bool = False, reserved: bool = False):
     """Returns (host_decisions, device_decisions, device_ran)."""
-    pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo)
+    pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo, reserved)
+    catalog = reserved_catalog() if reserved else CATALOG
 
     def env(engine):
         import copy
@@ -469,6 +545,7 @@ def run_case(seed: int, topo: bool = False):
             state_nodes=copy.deepcopy(nodes),
             pods=copy.deepcopy(bound),
             daemonset_pods=copy.deepcopy(ds_pods),
+            catalog=catalog,
             engine=engine,
         )
 
@@ -481,7 +558,7 @@ def run_case(seed: int, topo: bool = False):
     ffd.STRICT = True
     ncmod._hostname_counter = itertools.count(1)
     try:
-        dev = decisions(env(CatalogEngine(CATALOG)).schedule(build_pods()))
+        dev = decisions(env(CatalogEngine(catalog)).schedule(build_pods()))
     finally:
         ffd.STRICT = old_strict
     return host, dev, ffd.DEVICE_SOLVES > solves0
@@ -525,6 +602,15 @@ class TestDeviceParity:
         assert host == dev
         assert ran
 
+    @pytest.mark.parametrize("seed", range(20))
+    def test_reserved_capacity_decision_parity(self, seed):
+        """Fallback-mode reserved capacity on the device path: per-join
+        reservation bookkeeping (reserve/release/capacity counting) and
+        finalize pinning must match the host loop exactly."""
+        host, dev, ran = run_case(seed, reserved=True)
+        assert host == dev
+        assert ran, "reserved device path unexpectedly fell back to the host loop"
+
     def test_device_solves_counter_never_regresses_to_fallback(self):
         """The production-shaped workload (≥64 plain pods, kwok catalog) must
         take the device path — guards against silent eligibility regressions."""
@@ -532,12 +618,12 @@ class TestDeviceParity:
         assert ran
 
 
-def main(n_cases: int, topo: bool = False) -> int:
+def main(n_cases: int, topo: bool = False, reserved: bool = False) -> int:
     failures = 0
     fallbacks = 0
-    label = "topo" if topo else "plain"
+    label = "topo" if topo else "reserved" if reserved else "plain"
     for seed in range(n_cases):
-        host, dev, ran = run_case(seed, topo)
+        host, dev, ran = run_case(seed, topo, reserved)
         if host != dev:
             failures += 1
             print(f"{label} seed {seed}: DIVERGED")
@@ -557,8 +643,10 @@ if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
     mode = sys.argv[2] if len(sys.argv) > 2 else "both"
     rc = 0
-    if mode in ("plain", "both"):
+    if mode in ("plain", "both", "all"):
         rc |= main(n)
-    if mode in ("topo", "both"):
+    if mode in ("topo", "both", "all"):
         rc |= main(n, topo=True)
+    if mode in ("reserved", "all"):
+        rc |= main(n, reserved=True)
     sys.exit(rc)

@@ -1,0 +1,330 @@
+"""MinValues scheduling specs ported from the reference's MinValues context
+(instance_selection_test.go:661-1578), run on BOTH solver paths — strict
+minValues is fully supported on the device fast path (the distinct-value
+count only shrinks as claims narrow, so device rejections stay monotone)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Affinity, NodeAffinity, NodeSelectorTerm
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering, Offerings
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from device_path import both_paths_fixture
+from helpers import nodepool, unschedulable_pod
+from test_scheduler import Env as HostEnv
+
+Env = HostEnv
+path = both_paths_fixture(globals())
+
+# the reference's custom numeric key ("karpenter/numerical-value")
+GEN_KEY = "karpenter/numerical-value"
+
+
+def fake_it(name, cpu, price, arch="arm64", gen=None):
+    """fake.NewInstanceType twin: one spot offering in test-zone-1, optional
+    custom numeric-generation requirement."""
+    rows = [
+        Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN, [name]),
+        Requirement(wk.LABEL_ARCH, Operator.IN, [arch]),
+        Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+        Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["test-zone-1"]),
+        Requirement(
+            wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, [wk.CAPACITY_TYPE_SPOT]
+        ),
+    ]
+    if gen is not None:
+        rows.append(Requirement(GEN_KEY, Operator.IN, [str(gen)]))
+    return InstanceType(
+        name=name,
+        requirements=Requirements(*rows),
+        offerings=Offerings(
+            [
+                Offering(
+                    requirements=Requirements(
+                        Requirement(
+                            wk.CAPACITY_TYPE_LABEL_KEY,
+                            Operator.IN,
+                            [wk.CAPACITY_TYPE_SPOT],
+                        ),
+                        Requirement(
+                            wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["test-zone-1"]
+                        ),
+                    ),
+                    price=price,
+                    available=True,
+                )
+            ]
+        ),
+        capacity=parse_resource_list(
+            {"cpu": str(cpu), "memory": f"{cpu}Gi", "pods": "110"}
+        ),
+    )
+
+
+def env_for(catalog, pools):
+    kwargs = {"catalog": catalog, "node_pools": pools}
+    if Env is not HostEnv:  # device leg: engine over the same custom catalog
+        kwargs["engine"] = CatalogEngine(catalog)
+    return Env(**kwargs)
+
+
+def min_pool(*reqs):
+    return [nodepool("default", requirements=list(reqs))]
+
+
+def two_small_pods():
+    return [
+        unschedulable_pod(name=f"p-{i}", requests={"cpu": "0.9", "memory": "0.9Gi"})
+        for i in range(2)
+    ]
+
+
+def expect_two_singleton_claims(results, min_options=2):
+    assert not results.pod_errors
+    assert len(results.new_node_claims) == 2
+    for nc in results.new_node_claims:
+        assert len(nc.pods) == 1
+        assert len(nc.instance_type_options) >= min_options
+
+
+class TestMinValues:
+    def test_in_operator_forces_spread_across_claims(self, path):
+        """instance_selection_test.go:662 — two pods that would pack onto the
+        big type must split so every claim keeps >= minValues options."""
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        pools = min_pool(
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": ["instance-type-1", "instance-type-2"],
+                "minValues": 2,
+            }
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_gt_operator(self, path):
+        """instance_selection_test.go:739 — minValues with Gt."""
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52, gen=2),
+            fake_it("instance-type-2", 1, 1.0, gen=3),
+            fake_it("instance-type-3", 4, 1.2, gen=4),
+        ]
+        pools = min_pool(
+            {"key": GEN_KEY, "operator": "Gt", "values": ["2"], "minValues": 2}
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_gt_operator_unsatisfied(self, path):
+        """instance_selection_test.go:835 — pod Gt narrows to one type; the
+        template's Exists minValues 2 fails with the host's message."""
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52, gen=2),
+            fake_it("instance-type-2", 4, 1.0, gen=3),
+        ]
+        pools = min_pool(
+            {"key": GEN_KEY, "operator": "Exists", "minValues": 2}
+        )
+        pods = [
+            unschedulable_pod(
+                name=f"p-{i}",
+                requests={"cpu": "0.9", "memory": "0.9Gi"},
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    {
+                                        "key": GEN_KEY,
+                                        "operator": "Gt",
+                                        "values": ["2"],
+                                    }
+                                ]
+                            )
+                        ]
+                    )
+                ),
+            )
+            for i in range(2)
+        ]
+        results = env_for(catalog, pools).schedule(pods)
+        assert len(results.pod_errors) == 2
+        for err in results.pod_errors.values():
+            assert "minValues requirement is not met for label(s)" in str(err)
+            assert GEN_KEY in str(err)
+
+    def test_lt_operator(self, path):
+        """instance_selection_test.go:924 — minValues with Lt."""
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52, gen=2),
+            fake_it("instance-type-2", 2, 1.0, gen=3),
+            fake_it("instance-type-3", 4, 1.2, gen=4),
+        ]
+        pools = min_pool(
+            {"key": GEN_KEY, "operator": "Lt", "values": ["4"], "minValues": 2}
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_lt_operator_unsatisfied(self, path):
+        """instance_selection_test.go:1019 — Lt leaves one compatible type;
+        minValues 2 drops the template at construction, so no nodepool can
+        host the pods."""
+        catalog = [
+            fake_it("instance-type-1", 2, 0.52, gen=2),
+            fake_it("instance-type-2", 4, 1.2, gen=4),
+        ]
+        pools = min_pool(
+            {"key": GEN_KEY, "operator": "Lt", "values": ["4"], "minValues": 2}
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        assert len(results.pod_errors) == 2
+
+    def test_max_of_in_and_notin(self, path):
+        """instance_selection_test.go:1090 — same key via In (minValues 1)
+        and NotIn (minValues 2): the stricter count wins."""
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52),
+            fake_it("instance-type-2", 2, 1.0),
+            fake_it("instance-type-3", 4, 1.2),
+        ]
+        pools = min_pool(
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": ["instance-type-1", "instance-type-2", "instance-type-3"],
+                "minValues": 1,
+            },
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "NotIn",
+                "values": ["instance-type-3"],
+                "minValues": 2,
+            },
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_max_of_gt_and_lt(self, path):
+        """instance_selection_test.go:1190 — Gt minValues 1 + Lt minValues 2
+        on the numeric key: max applies over the window (3, 5)."""
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52, gen=2),
+            fake_it("instance-type-2", 1, 1.0, gen=3),
+            fake_it("instance-type-3", 4, 1.2, gen=4),
+            fake_it("instance-type-4", 4, 1.2, gen=5),
+        ]
+        pools = min_pool(
+            {"key": GEN_KEY, "operator": "Gt", "values": ["2"], "minValues": 1},
+            {"key": GEN_KEY, "operator": "Lt", "values": ["5"], "minValues": 2},
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_fails_when_catalog_smaller_than_min(self, path):
+        """instance_selection_test.go:1309 — minValues 11 over a 10-type
+        catalog can never be satisfied."""
+        catalog = [fake_it(f"instance-type-{i}", 1, 0.5 + i * 0.01) for i in range(10)]
+        pools = min_pool(
+            {"key": wk.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": 11}
+        )
+        results = env_for(catalog, pools).schedule(
+            [unschedulable_pod(name="p-0", requests={"cpu": "0.5"})]
+        )
+        assert len(results.pod_errors) == 1
+
+    def test_fails_after_truncation(self, path):
+        """instance_selection_test.go:1337 — the solve satisfies minValues
+        but launch-time truncation to 1 option breaks it; the claim is
+        rejected and its pods error."""
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        pools = min_pool(
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": ["instance-type-1", "instance-type-2"],
+                "minValues": 2,
+            }
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        assert not results.pod_errors
+        results.truncate_instance_types(max_items=1)
+        assert not results.new_node_claims
+        assert len(results.pod_errors) == 2
+        for err in results.pod_errors.values():
+            assert "couldn't meet minValues requirements" in str(err)
+
+    def test_max_of_multiple_operators_same_key(self, path):
+        """instance_selection_test.go:1412 — Exists minValues 1 + In
+        minValues 2 on instance-type: the max (2) applies."""
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        pools = min_pool(
+            {"key": wk.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": 1},
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": ["instance-type-1", "instance-type-2"],
+                "minValues": 2,
+            },
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_multiple_requirement_keys(self, path):
+        """instance_selection_test.go:1497 — arch Exists minValues 2 +
+        instance-type minValues 1: joining the second pod would collapse the
+        arch diversity to one, forcing a second claim."""
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52, arch="arm64"),
+            fake_it("instance-type-2", 4, 1.0, arch="amd64"),
+        ]
+        pools = min_pool(
+            {"key": wk.LABEL_ARCH, "operator": "Exists", "minValues": 2},
+            {
+                "key": wk.LABEL_INSTANCE_TYPE,
+                "operator": "In",
+                "values": ["instance-type-1", "instance-type-2"],
+                "minValues": 1,
+            },
+        )
+        results = env_for(catalog, pools).schedule(two_small_pods())
+        expect_two_singleton_claims(results)
+
+    def test_best_effort_policy_falls_back_to_host(self, path):
+        """BestEffort minValues relaxation mutates requirement rows mid-solve
+        (nodeclaim.go:425-436) — the device path declines it by design. A
+        catalog with fewer types than the minimum schedules anyway under
+        BestEffort, with the claim annotated relaxed."""
+        from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_BEST_EFFORT
+
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        pools = min_pool(
+            {"key": wk.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": 3}
+        )
+        kwargs = {
+            "catalog": catalog,
+            "node_pools": pools,
+            "min_values_policy": MIN_VALUES_POLICY_BEST_EFFORT,
+        }
+        pods = [unschedulable_pod(name="p-0", requests={"cpu": "0.5"})]
+        if Env is not HostEnv:
+            kwargs["engine"] = CatalogEngine(catalog)
+            from karpenter_tpu.ops import ffd
+
+            f0 = ffd.DEVICE_FALLBACKS
+            results = HostEnv(**kwargs).schedule(pods)
+            assert ffd.DEVICE_FALLBACKS > f0, "BestEffort must decline the device path"
+        else:
+            results = Env(**kwargs).schedule(pods)
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert len(nc.instance_type_options) == 2
+        assert (
+            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] == "true"
+        )
+        # the relaxed requirement records the achievable count
+        assert nc.requirements.get(wk.LABEL_INSTANCE_TYPE).min_values == 2

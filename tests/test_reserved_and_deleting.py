@@ -26,7 +26,21 @@ from helpers import (
     nodepool,
     unschedulable_pod,
 )
-from test_scheduler import Env
+from device_path import both_paths_fixture
+from test_scheduler import Env as HostEnv
+
+Env = HostEnv
+path = both_paths_fixture(globals())
+
+
+def env_for(catalog, **kwargs):
+    """Env over a custom catalog; the device leg gets an engine on it."""
+    from karpenter_tpu.ops.catalog import CatalogEngine
+
+    kwargs["catalog"] = catalog
+    if Env is not HostEnv:
+        kwargs["engine"] = CatalogEngine(catalog)
+    return Env(**kwargs)
 
 
 def reserved_catalog(reservation_capacity=2):
@@ -83,7 +97,7 @@ class TestReservedCapacity:
     """scheduling/reservationmanager.go + nodeclaim.go reserved offerings."""
 
     def test_reserved_offering_preferred(self):
-        env = Env(catalog=reserved_catalog())
+        env = env_for(reserved_catalog())
         results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
         [nc] = results.new_node_claims
         # the claim holds the reservation: capacity-type narrowed to reserved
@@ -93,7 +107,7 @@ class TestReservedCapacity:
     def test_reservation_capacity_tracked_across_claims(self):
         # 2 reserved instances available; 3 claims' worth of pods → the third
         # claim falls back to on-demand (fallback mode default)
-        env = Env(catalog=reserved_catalog(reservation_capacity=2))
+        env = env_for(reserved_catalog(reservation_capacity=2))
         pods = [unschedulable_pod(requests={"cpu": "3"}) for _ in range(3)]
         results = env.schedule(pods)
         assert len(results.new_node_claims) == 3
@@ -103,16 +117,14 @@ class TestReservedCapacity:
         assert len(reserved_claims) == 2
 
     def test_exhausted_reservation_falls_back_to_on_demand(self):
-        env = Env(catalog=reserved_catalog(reservation_capacity=0))
+        env = env_for(reserved_catalog(reservation_capacity=0))
         results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
         [nc] = results.new_node_claims
         assert not nc.reserved_offerings
         assert not results.pod_errors
 
     def test_reserved_disabled_by_feature_gate(self):
-        env = Env(
-            catalog=reserved_catalog(), reserved_capacity_enabled=False
-        )
+        env = env_for(reserved_catalog(), reserved_capacity_enabled=False)
         results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
         [nc] = results.new_node_claims
         assert not nc.reserved_offerings
@@ -188,25 +200,44 @@ class TestDeletingNodeRescheduling:
 
 
 class TestStrictReservedMode:
+    """Strict mode is an explicit device-path fallback (scan-aborting
+    ReservedOfferingErrors are non-monotone): on the device leg these run
+    the host loop and the fallback counter must advance."""
+
+    def _strict_env(self, capacity):
+        from karpenter_tpu.ops.catalog import CatalogEngine
+
+        catalog = reserved_catalog(reservation_capacity=capacity)
+        kwargs = {
+            "catalog": catalog,
+            "reserved_offering_mode": RESERVED_OFFERING_MODE_STRICT,
+        }
+        if Env is not HostEnv:
+            kwargs["engine"] = CatalogEngine(catalog)
+        return HostEnv(**kwargs)
+
+    def _schedule(self, env, pods):
+        from karpenter_tpu.ops import ffd
+
+        f0 = ffd.DEVICE_FALLBACKS
+        results = env.schedule(pods)
+        if Env is not HostEnv:
+            assert ffd.DEVICE_FALLBACKS > f0, "strict mode must decline the device path"
+        return results
+
     def test_strict_mode_errors_instead_of_falling_back(self):
         """suite_test.go:3976 — with compatible reserved offerings that can't
         be reserved, strict mode surfaces ReservedOfferingError instead of
         silently falling back to on-demand."""
-        env = Env(
-            catalog=reserved_catalog(reservation_capacity=0),
-            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
-        )
-        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        env = self._strict_env(0)
+        results = self._schedule(env, [unschedulable_pod(requests={"cpu": "1"})])
         assert not results.new_node_claims
         [err] = list(results.pod_errors.values())
         assert isinstance(err, ReservedOfferingError)
 
     def test_strict_mode_reserves_when_capacity_available(self):
-        env = Env(
-            catalog=reserved_catalog(reservation_capacity=1),
-            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
-        )
-        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
+        env = self._strict_env(1)
+        results = self._schedule(env, [unschedulable_pod(requests={"cpu": "1"})])
         assert not results.pod_errors
         [nc] = results.new_node_claims
         assert nc.reserved_offerings

@@ -896,8 +896,10 @@ class TestExplicitDeviceFallbacks:
     """The features the device path still declines must decline LOUDLY —
     these specs pin the eligibility gates (ffd.py eligible())."""
 
-    def test_reserved_capacity_solve_falls_back(self, path):
-        from karpenter_tpu.ops.catalog import CatalogEngine
+    def test_reserved_capacity_fallback_mode_runs_on_device(self, path):
+        """Fallback-mode reserved capacity is device-supported since round 4:
+        the claim reserves cr-1 and finalize pins it (nodeclaim.go:207-220)."""
+        from karpenter_tpu.cloudprovider.types import RESERVATION_ID_LABEL
 
         from test_reserved_and_deleting import reserved_catalog
 
@@ -907,12 +909,40 @@ class TestExplicitDeviceFallbacks:
             kwargs["engine"] = CatalogEngine(catalog)
         env = Env(**kwargs)
         results = schedule(
+            path, [unschedulable_pod(requests={"cpu": "1"})], env=env,
+        )
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY).has(
+            wk.CAPACITY_TYPE_RESERVED
+        )
+        assert nc.requirements.get(RESERVATION_ID_LABEL).has("cr-1")
+
+    def test_strict_reserved_solve_falls_back(self, path):
+        """Strict mode turns reservation exhaustion into scan-aborting
+        errors (non-monotone) — the device path declines it by design."""
+        from karpenter_tpu.scheduler.nodeclaim import RESERVED_OFFERING_MODE_STRICT
+
+        from test_reserved_and_deleting import reserved_catalog
+
+        catalog = reserved_catalog(reservation_capacity=2)
+        kwargs = {
+            "catalog": catalog,
+            "reserved_offering_mode": RESERVED_OFFERING_MODE_STRICT,
+        }
+        if path == "device":
+            kwargs["engine"] = CatalogEngine(catalog)
+        env = Env(**kwargs)
+        results = schedule(
             path, [unschedulable_pod(requests={"cpu": "1"})],
             device_falls_back=True, env=env,
         )
         assert not results.pod_errors
 
-    def test_min_values_solve_falls_back(self, path):
+    def test_strict_min_values_runs_on_device(self, path):
+        """Strict-policy minValues is device-supported since round 4 (the
+        diversity count only shrinks, so rejections stay monotone); only
+        BestEffort relaxation declines (see test_minvalues_oracle)."""
         pools = [
             nodepool(
                 "default",
@@ -926,7 +956,8 @@ class TestExplicitDeviceFallbacks:
             )
         ]
         results = schedule(
-            path, [unschedulable_pod(requests={"cpu": "1"})],
-            device_falls_back=True, node_pools=pools,
+            path, [unschedulable_pod(requests={"cpu": "1"})], node_pools=pools,
         )
         assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert len(nc.instance_type_options) >= 2
