@@ -215,9 +215,16 @@ def satisfies_min_values(
         return (
             len(instance_types),
             incompatible,
-            f"minValues requirement is not met for label(s) {sorted(incompatible)}",
+            min_values_error(incompatible),
         )
     return len(instance_types), {}, None
+
+
+def min_values_error(keys) -> str:
+    """The user-facing minValues failure text (types.go:218). Shared with the
+    device solver's diversity gate (ops/ffd.py _min_fail) — host/device
+    decision parity compares error STRINGS, so there must be one source."""
+    return f"minValues requirement is not met for label(s) {sorted(keys)}"
 
 
 def truncate_instance_types(

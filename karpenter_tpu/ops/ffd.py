@@ -945,7 +945,7 @@ class _DeviceSolve:
                             ],
                         )
                     )
-            self._res_compat: dict[tuple[int, int], bool] = {}
+            self._res_compat: dict[tuple[int, int, int], bool] = {}
             rm = s.reservation_manager
             self._saved_rm = (
                 {h: set(ids) for h, ids in rm._reservations.items()},
@@ -1443,7 +1443,9 @@ class _DeviceSolve:
             if count < needed:
                 bad.append(key)
         if bad:
-            return f"minValues requirement is not met for label(s) {sorted(bad)}"
+            from karpenter_tpu.cloudprovider.types import min_values_error
+
+            return min_values_error(bad)
         return None
 
     def _min_join_ok(self, c: "_Claim", new_u: np.ndarray, new_mask=None) -> bool:

@@ -326,8 +326,13 @@ class _TopoSolve(_DeviceSolve):
         if any(name not in dims for name in data.requests):
             return None
         group = _Group(data, dims)
-        if group.has_hostname:
-            return None
+        # hostname-constrained shapes are handled VOLATILE: the claim scan
+        # gates on the pod's hostname row against each claim's placeholder
+        # (can_add's compat rejection, nodeclaim.go:285-291), and new-claim
+        # attempts reproduce the host's compat error with the exact consumed
+        # placeholder string — this driver draws from the host's counter, so
+        # even pathological selectors naming placeholder strings behave
+        # identically to a pure host run
         group.rowset = self._rows_sans_hostname(group.reqs)
         gi = len(self.groups)
         self.groups.append(group)
@@ -362,7 +367,10 @@ class _TopoSolve(_DeviceSolve):
         inv_matched = [
             tg for tg in topo.inverse_topology_groups.values() if tg.selects(pod)
         ]
-        self.g_volatile.append(bool(owned or inv_matched or ports or has_volumes))
+        has_hostname = self.groups[len(self.g_volatile)].has_hostname
+        self.g_volatile.append(
+            bool(owned or inv_matched or ports or has_volumes or has_hostname)
+        )
         # host matching order: owned groups in dict order, then matching
         # inverse groups (topology.py _matching_topologies)
         self.g_matched.append(owned + inv_matched)
@@ -737,6 +745,13 @@ class _TopoSolve(_DeviceSolve):
             # claim's accumulated usage reject this candidate
             if gp and self._claim_hp[ci].conflicts(pod, gp) is not None:
                 continue
+            # hostname-constrained shapes: the host's compat gate sees the
+            # claim's placeholder hostname row vs the pod's hostname row
+            # (nodeclaim.go:285-291) — reject unless the placeholder
+            # satisfies the pod's requirement (NotIn rows usually pass,
+            # In[real-node] rows never do)
+            if g.has_hostname and not g.reqs.get(wk.LABEL_HOSTNAME).has(c.hostname):
+                continue
             ent = fam_join.get((c.fam, gi))
             if ent is None:
                 ent = self._build_fam_join(c.fam, gi)
@@ -871,6 +886,20 @@ class _TopoSolve(_DeviceSolve):
                     errs.append(
                         ValueError(f"checking host port usage, {conflict}")
                     )
+                    continue
+            if g.has_hostname:
+                # the host's compat gate runs with the claim's placeholder
+                # hostname row included (nodeclaim.go:285-291) — reproduce
+                # its exact error text, placeholder string and all
+                claim_reqs = Requirements(*nct.requirements.values())
+                claim_reqs.add(
+                    Requirement(wk.LABEL_HOSTNAME, Operator.IN, [hostname])
+                )
+                cerr = claim_reqs.compatible(
+                    g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                )
+                if cerr is not None:
+                    errs.append(ValueError(f"incompatible requirements, {cerr}"))
                     continue
             tg = self._tg(ti, gi)
             if tg is None:

@@ -1,0 +1,450 @@
+"""Consolidation specs ported from the reference's consolidation_test.go
+(delete/replace gates, scheduling-interaction blocks, reserved offerings,
+lifetime-weighted candidate order). Complements test_disruption.py's
+emptiness/budget/spot-to-spot coverage."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.apis.nodepool import Budget
+from karpenter_tpu.cloudprovider.types import (
+    RESERVATION_ID_LABEL,
+    InstanceType,
+    Offering,
+    Offerings,
+)
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from helpers import nodepool, registered_node, unschedulable_pod
+from test_disruption import Env
+
+
+def owned_pod(requests=None, **kw):
+    """A ReplicaSet-owned pod (the reference binds RS pods so they're
+    reschedulable; ours are reschedulable regardless — kept for fidelity)."""
+    return unschedulable_pod(requests=requests or {"cpu": "1"}, **kw)
+
+
+class TestConsolidationDelete:
+    """consolidation_test.go:2309-3104 — the Delete context."""
+
+    def test_can_delete_nodes(self):
+        """:2309 — two underfilled nodes merge; candidates deleted."""
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np)
+        for i in range(2):
+            env.add_pair(
+                f"del-{i}", pods=[owned_pod()],
+                instance_type="s-16x-amd64-linux",
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert len(cmd.candidates) == 2
+
+    def test_evicts_pods_without_owner_ref(self):
+        """:2709 — ownerRef-less pods don't block consolidation; they are
+        rescheduled like any active pod."""
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np)
+        bare = unschedulable_pod(requests={"cpu": "1"})
+        assert not bare.metadata.owner_references
+        env.add_pair(
+            "bare-0", pods=[bare],
+            instance_type="s-16x-amd64-linux",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        env.add_pair(
+            "bare-1", pods=[owned_pod()],
+            instance_type="s-16x-amd64-linux",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert len(cmd.candidates) == 2
+
+    def test_delete_when_non_karpenter_capacity_fits(self):
+        """:2424 — an unmanaged node with room counts as a rescheduling
+        target, so the managed candidate can be deleted outright."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        unmanaged = registered_node(
+            name="byo-node",
+            capacity={"cpu": "64", "memory": "256Gi", "pods": "110"},
+        )
+        del unmanaged.metadata.labels[wk.NODEPOOL_LABEL_KEY]
+        env.store.create(unmanaged)
+        env.add_pair(
+            "managed-0", pods=[owned_pod()],
+            instance_type="s-16x-amd64-linux",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert cmd.decision() == "delete"
+        assert [c.state_node.name() for c in cmd.candidates] == ["managed-0"]
+
+    def test_delete_while_invalid_nodepool_exists(self):
+        """:3041 — a nodepool whose requirements admit no instance type
+        doesn't poison consolidation for healthy pools."""
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np)
+        bad = nodepool(
+            "invalid",
+            requirements=[
+                {"key": wk.LABEL_ARCH, "operator": "In", "values": ["s390x"]}
+            ],
+        )
+        env.store.create(bad)
+        for i in range(2):
+            env.add_pair(
+                f"ok-{i}", pods=[owned_pod()],
+                instance_type="s-16x-amd64-linux",
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert len(cmd.candidates) == 2
+
+    def test_delete_with_permanently_pending_pod(self):
+        """:2949 — a pod that can never schedule anywhere doesn't block
+        consolidating unrelated nodes."""
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np)
+        giant = unschedulable_pod(name="stuck", requests={"cpu": "10000"})
+        env.store.create(giant)
+        for i in range(2):
+            env.add_pair(
+                f"ok-{i}", pods=[owned_pod()],
+                instance_type="s-16x-amd64-linux",
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            )
+        env.informer.flush()
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert len(cmd.candidates) == 2
+
+    def test_wont_make_non_pending_pod_go_pending(self):
+        """:3001 — no consolidation when the candidates' pods have nowhere
+        cheaper to go (deleting would leave them pending)."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        # each node is fully used by its pod (cpu AND memory): the cheaper
+        # low-memory c-family can't fit 14Gi, larger shapes cost more, and
+        # the nodes are already on the cheapest capacity type (spot)
+        for i in range(2):
+            env.add_pair(
+                f"full-{i}",
+                pods=[owned_pod(requests={"cpu": "3.5", "memory": "14Gi"})],
+                instance_type="s-4x-amd64-linux",
+                capacity_type=wk.CAPACITY_TYPE_SPOT,
+                capacity={"cpu": "4", "memory": "16Gi", "pods": "110"},
+            )
+        assert env.reconcile() is False
+        assert env.queue.get_commands() == []
+
+    def test_wont_delete_if_pods_land_on_uninitialized_node(self):
+        """:2757 — rescheduling targets must be initialized; a command whose
+        simulation uses an uninitialized node is discarded."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair(
+            "young-0",
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        # strip initialization: lifecycle hasn't finished this node yet
+        claim.set_condition("Initialized", "False")
+        del node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY]
+        env.store.update(node)
+        env.store.update(claim)
+        env.add_pair(
+            "old-0", pods=[owned_pod()],
+            instance_type="s-16x-amd64-linux",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        env.informer.flush()
+        env.reconcile()
+        for cmd in env.queue.get_commands():
+            assert "old-0" not in [c.state_node.name() for c in cmd.candidates]
+
+    def test_considers_initialized_nodes_before_uninitialized(self):
+        """:2803 — with an initialized node offering the same room, the
+        candidate IS deletable (pods target the initialized node)."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        env.add_pair(
+            "ready-0",
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        env.add_pair(
+            "old-0", pods=[owned_pod()],
+            instance_type="s-16x-amd64-linux",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        assert env.reconcile() is True
+        cmds = env.queue.get_commands()
+        assert any(
+            "old-0" in [c.state_node.name() for c in cmd.candidates]
+            or "ready-0" in [c.state_node.name() for c in cmd.candidates]
+            for cmd in cmds
+        )
+
+
+class TestConsolidationScheduling:
+    """consolidation_test.go:4099-4233 — topology interplay."""
+
+    def test_replace_maintains_zonal_topology_spread(self):
+        """:4099 — the replacement for a spread-constrained pod is pinned to
+        the candidate's zone so the spread stays satisfied."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        spread = TopologySpreadConstraint(
+            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+            max_skew=1,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": "spread"}),
+        )
+        for i, zone in enumerate(["kwok-zone-1", "kwok-zone-2", "kwok-zone-3"]):
+            pod = owned_pod(
+                labels={"app": "spread"}, topology_spread_constraints=[spread]
+            )
+            env.add_pair(
+                f"zonal-{i}", pods=[pod], zone=zone,
+                instance_type="s-32x-amd64-linux",
+                capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+            )
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert cmd.decision() == "replace"
+        [candidate] = cmd.candidates
+        cand_zone = candidate.state_node.labels()[wk.LABEL_TOPOLOGY_ZONE]
+        [rep] = cmd.replacements
+        zone_row = rep.node_claim.requirements.get(wk.LABEL_TOPOLOGY_ZONE)
+        assert set(zone_row.values_list()) == {cand_zone}
+
+    def test_wont_delete_if_it_violates_pod_anti_affinity(self):
+        """:4173 — pods with required hostname anti-affinity can't co-locate,
+        so the would-be delete is rejected."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        anti = Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(match_labels={"app": "anti"}),
+                    )
+                ]
+            )
+        )
+        for i in range(2):
+            pod = owned_pod(labels={"app": "anti"}, affinity=anti)
+            env.add_pair(
+                f"anti-{i}", pods=[pod],
+                instance_type="s-16x-amd64-linux",
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            )
+        env.reconcile()
+        # neither a delete nor a merge may co-locate the two pods: any
+        # command must keep them on separate hosts (1 candidate + replacement)
+        for cmd in env.queue.get_commands():
+            assert len(cmd.candidates) == 1
+
+
+class TestReservedConsolidation:
+    """consolidation_test.go:4389 — reserved→reserved moves."""
+
+    @staticmethod
+    def reserved_types():
+        def it(name, cpu, od_price, rid, res_price):
+            rows = Requirements(
+                Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN, [name]),
+                Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+                Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]),
+                Requirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_RESERVED],
+                ),
+            )
+
+            def off(ct, price, rid=None, cap=0):
+                r = [
+                    Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, [ct]),
+                    Requirement(
+                        wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]
+                    ),
+                ]
+                if rid:
+                    r.append(Requirement(RESERVATION_ID_LABEL, Operator.IN, [rid]))
+                return Offering(
+                    requirements=Requirements(*r), price=price, available=True,
+                    reservation_capacity=cap,
+                )
+
+            return InstanceType(
+                name=name,
+                requirements=rows,
+                offerings=Offerings(
+                    [
+                        off(wk.CAPACITY_TYPE_ON_DEMAND, od_price),
+                        off(wk.CAPACITY_TYPE_RESERVED, res_price, rid, cap=4),
+                    ]
+                ),
+                capacity=parse_resource_list(
+                    {"cpu": str(cpu), "memory": f"{cpu * 4}Gi", "pods": "110"}
+                ),
+            )
+
+        return [
+            it("big-reserved", 16, 2.0, "cr-big", 1.0),
+            it("small-reserved", 4, 0.6, "cr-small", 0.2),
+        ]
+
+    def test_consolidates_reserved_to_reserved(self):
+        env = Env(instance_types=self.reserved_types())
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair(
+            "res-0", pods=[owned_pod()],
+            instance_type="big-reserved",
+            capacity_type=wk.CAPACITY_TYPE_RESERVED,
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        node.metadata.labels[RESERVATION_ID_LABEL] = "cr-big"
+        claim.metadata.labels[RESERVATION_ID_LABEL] = "cr-big"
+        env.store.update(node)
+        env.store.update(claim)
+        env.informer.flush()
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert cmd.decision() == "replace"
+        [rep] = cmd.replacements
+        names = {it.name for it in rep.node_claim.instance_type_options}
+        assert names == {"small-reserved"}
+        # the replacement holds the cheaper reservation
+        assert rep.node_claim.requirements.get(RESERVATION_ID_LABEL).has(
+            "cr-small"
+        )
+
+
+class TestMinValuesConsolidation:
+    """consolidation_test.go:4680 — consolidation never relaxes minValues."""
+
+    @staticmethod
+    def minvalues_types():
+        def it(name, cpu, price):
+            return InstanceType(
+                name=name,
+                requirements=Requirements(
+                    Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN, [name]),
+                    Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+                    Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+                    Requirement(
+                        wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]
+                    ),
+                    Requirement(
+                        wk.CAPACITY_TYPE_LABEL_KEY,
+                        Operator.IN,
+                        [wk.CAPACITY_TYPE_ON_DEMAND],
+                    ),
+                ),
+                offerings=Offerings(
+                    [
+                        Offering(
+                            requirements=Requirements(
+                                Requirement(
+                                    wk.CAPACITY_TYPE_LABEL_KEY,
+                                    Operator.IN,
+                                    [wk.CAPACITY_TYPE_ON_DEMAND],
+                                ),
+                                Requirement(
+                                    wk.LABEL_TOPOLOGY_ZONE,
+                                    Operator.IN,
+                                    ["kwok-zone-1"],
+                                ),
+                            ),
+                            price=price,
+                            available=True,
+                        )
+                    ]
+                ),
+                capacity=parse_resource_list(
+                    {"cpu": str(cpu), "memory": f"{cpu * 4}Gi", "pods": "110"}
+                ),
+            )
+
+        # candidate shape + exactly TWO cheaper types
+        return [it("huge", 32, 4.0), it("mid", 4, 0.5), it("small", 2, 0.3)]
+
+    def test_does_not_relax_min_values_when_best_effort(self):
+        from karpenter_tpu.operator.options import Options
+
+        opts = Options(min_values_policy="BestEffort")
+        env = Env(options=opts, instance_types=self.minvalues_types())
+        # minValues 3: provisioning (BestEffort) may relax, but consolidation
+        # replacements must NOT — the cheaper set has only 2 distinct types
+        env.store.create(
+            nodepool(
+                "default",
+                requirements=[
+                    {
+                        "key": wk.LABEL_INSTANCE_TYPE,
+                        "operator": "Exists",
+                        "minValues": 3,
+                    }
+                ],
+            )
+        )
+        env.add_pair(
+            "huge-0", pods=[owned_pod()],
+            instance_type="huge",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        env.reconcile()
+        for cmd in env.queue.get_commands():
+            assert cmd.decision() != "replace"
+
+
+class TestLifetimeWeightedOrder:
+    """consolidation_test.go:4003 — candidates closer to expiry disrupt
+    first (disruption cost scales by lifetime remaining)."""
+
+    def test_expiring_candidate_preferred(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        _, young = env.add_pair(
+            "young-1", pods=[owned_pod()],
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        _, dying = env.add_pair(
+            "dying-1", pods=[owned_pod()],
+            instance_type="s-32x-amd64-linux",
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+        env.clock.step(90.0)
+        young.spec.expire_after = 10_000.0
+        dying.spec.expire_after = 100.0  # ~10% lifetime left
+        env.store.update(young)
+        env.store.update(dying)
+        env.informer.flush()
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert "dying-1" in [c.state_node.name() for c in cmd.candidates]

@@ -343,14 +343,42 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
         selector[wk.CAPACITY_TYPE_LABEL_KEY] = rng.choice(
             [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
         )
+    hostname_pin = None
+    if rng.random() < 0.06:
+        # hostname pins: an existing node's name (joins it if feasible), a
+        # bogus name (per-template compat errors embedding the consumed
+        # placeholder strings), or a NotIn row (satisfied by any placeholder)
+        hn_roll = rng.random()
+        if hn_roll < 0.45:
+            selector[wk.LABEL_HOSTNAME] = f"existing-{rng.randint(0, 5)}"
+        elif hn_roll < 0.8:
+            selector[wk.LABEL_HOSTNAME] = "no-such-node"
+        else:
+            hostname_pin = f"existing-{rng.randint(0, 5)}"
     if selector:
         kwargs["node_selector"] = selector
     spec_kwargs = {}
+    if hostname_pin is not None and "affinity" not in kwargs:
+        spec_kwargs["affinity"] = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            {
+                                "key": wk.LABEL_HOSTNAME,
+                                "operator": "NotIn",
+                                "values": [hostname_pin],
+                            }
+                        ]
+                    )
+                ]
+            )
+        )
     if rng.random() < 0.25:
         spec_kwargs["tolerations"] = [
             Toleration(key="team", operator="Equal", value="infra", effect="NoSchedule")
         ]
-    if rng.random() < 0.15 and "affinity" not in kwargs:
+    if rng.random() < 0.15 and "affinity" not in kwargs and "affinity" not in spec_kwargs:
         op = rng.choice(["In", "NotIn"])
         spec_kwargs["affinity"] = Affinity(
             node_affinity=NodeAffinity(
@@ -611,6 +639,14 @@ class TestDeviceParity:
         assert host == dev
         assert ran, "reserved device path unexpectedly fell back to the host loop"
 
+    @pytest.mark.parametrize("seed", range(12))
+    def test_reserved_with_topology_decision_parity(self, seed):
+        """Reserved bookkeeping on the TOPO driver: zone-narrowed volatile
+        joins must hold/release exactly the offerings the host would."""
+        host, dev, ran = run_case(seed, topo=True, reserved=True)
+        assert host == dev
+        assert ran, "reserved+topo device path unexpectedly fell back"
+
     def test_device_solves_counter_never_regresses_to_fallback(self):
         """The production-shaped workload (≥64 plain pods, kwok catalog) must
         take the device path — guards against silent eligibility regressions."""
@@ -621,7 +657,11 @@ class TestDeviceParity:
 def main(n_cases: int, topo: bool = False, reserved: bool = False) -> int:
     failures = 0
     fallbacks = 0
-    label = "topo" if topo else "reserved" if reserved else "plain"
+    label = (
+        "reserved+topo"
+        if topo and reserved
+        else "topo" if topo else "reserved" if reserved else "plain"
+    )
     for seed in range(n_cases):
         host, dev, ran = run_case(seed, topo, reserved)
         if host != dev:
@@ -649,4 +689,6 @@ if __name__ == "__main__":
         rc |= main(n, topo=True)
     if mode in ("reserved", "all"):
         rc |= main(n, reserved=True)
+    if mode in ("restopo", "all"):
+        rc |= main(n, topo=True, reserved=True)
     sys.exit(rc)

@@ -27,10 +27,10 @@ from helpers import bind_pod, node_claim_pair, nodepool, unschedulable_pod
 
 
 class Env:
-    def __init__(self, options=None):
+    def __init__(self, options=None, instance_types=None):
         self.clock = FakeClock()
         self.store = Store(clock=self.clock)
-        self.provider = FakeCloudProvider()
+        self.provider = FakeCloudProvider(instance_types)
         self.cluster = Cluster(self.clock, self.store, self.provider)
         self.informer = StateInformer(self.store, self.cluster)
         self.recorder = Recorder(clock=self.clock)

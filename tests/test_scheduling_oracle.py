@@ -6,9 +6,10 @@ reference line cited per test class), each run against BOTH solver paths:
 - device: the batched fast path (engine on, DEVICE_MIN_PODS patched to 1)
 
 Device runs assert DEVICE_SOLVES advanced; specs whose features the device
-path intentionally declines (hostname selectors, reserved capacity,
-minValues) assert the fallback EXPLICITLY, so eligibility regressions can't
-hide.
+path intentionally declines (strict reserved mode, BestEffort minValues
+relaxation) assert the fallback EXPLICITLY, so eligibility regressions can't
+hide. Hostname selectors, fallback-mode reserved capacity, and strict
+minValues all RUN on the device path since round 4.
 Topology and preferred-affinity/relaxation specs run the topo-aware driver
 (ops/ffd_topo.py) and must match host decisions exactly. Deleting-node rescheduling specs
 (suite_test.go:3545-3699) live with the provisioner/e2e tests instead —
@@ -172,8 +173,42 @@ class TestNodeSelectors:
     def test_hostname_selector_not_schedulable(self, path):
         # suite_test.go:221 — placeholder hostnames never match a selector
         pod = unschedulable_pod(node_selector={wk.LABEL_HOSTNAME: "some-node"})
-        results = schedule(path, [pod], device_falls_back=True)
+        results = schedule(path, [pod])
         assert len(results.pod_errors) == 1
+        [err] = results.pod_errors.values()
+        assert "incompatible requirements" in str(err)
+        assert wk.LABEL_HOSTNAME in str(err)
+
+    def test_hostname_selector_matches_existing_node(self, path):
+        """A hostname-pinned pod can only land on the named existing node."""
+        node = registered_node(
+            name="pinned-node", pool="default",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        pod = unschedulable_pod(
+            requests={"cpu": "1"},
+            node_selector={wk.LABEL_HOSTNAME: "pinned-node"},
+        )
+        filler = [unschedulable_pod(requests={"cpu": "1"}) for _ in range(3)]
+        results = schedule(path, [pod] + filler, state_nodes=[node])
+        assert not results.pod_errors
+        [en] = [e for e in results.existing_nodes if e.pods]
+        # the host loop binds deepcopies — compare by name
+        assert pod.metadata.name in {p.metadata.name for p in en.pods}
+
+    def test_hostname_not_in_schedules_anywhere(self, path):
+        """NotIn hostname rows are satisfied by any placeholder — the pod
+        packs onto new claims normally (double-negative carve-out)."""
+        pod = unschedulable_pod(
+            requests={"cpu": "1"},
+            affinity=node_affinity(
+                [req(wk.LABEL_HOSTNAME, "NotIn", "forbidden-node")]
+            ),
+        )
+        others = [unschedulable_pod(requests={"cpu": "1"}) for _ in range(3)]
+        results = schedule(path, [pod] + others)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 1
 
     def test_selector_outside_nodepool_constraints_fails(self, path):
         pools = [
