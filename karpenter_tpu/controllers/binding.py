@@ -24,6 +24,7 @@ from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.scheduling.hostportusage import get_host_ports
 from karpenter_tpu.scheduling.volumeusage import get_volumes
 from karpenter_tpu.scheduling.requirements import Requirements, strict_pod_requirements
+from karpenter_tpu.scheduling.taints import Taints
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.statenode import StateNode
 from karpenter_tpu.utils import pod as podutil
@@ -114,7 +115,14 @@ class BindingController:
             return False
         if sn.is_marked_for_deletion() or sn.node.metadata.deletion_timestamp is not None:
             return False
-        if sn.taints().tolerates_pod(pod) is not None:
+        # kube-scheduler only hard-blocks on NoSchedule/NoExecute;
+        # PreferNoSchedule is a scoring preference and never prevents a bind
+        # (Karpenter's own simulation soft-blocks it until the relax ladder
+        # tolerates — the binding stand-in must not copy that strictness)
+        hard = Taints(
+            t for t in sn.taints() if t.effect in ("NoSchedule", "NoExecute")
+        )
+        if hard.tolerates_pod(pod) is not None:
             return False
         node_reqs = Requirements.from_labels(sn.labels())
         if node_reqs.compatible(strict_pod_requirements(pod)) is not None:

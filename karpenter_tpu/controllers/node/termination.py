@@ -152,6 +152,19 @@ class TerminationController:
         if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
             return
         claim = self._claim_for(node)
+        # If the underlying instance no longer exists AND the kubelet is not
+        # reporting Ready, skip the graceful drain — pods can't run anyway
+        # (termination/controller.go:109-120). A Ready node means the kubelet
+        # process still lives despite the provider's answer, so drain anyway.
+        ready = next(
+            (c.status for c in node.status.conditions if c.type == "Ready"), ""
+        )
+        if ready != "True":
+            try:
+                self.cloud_provider.get(node.spec.provider_id)
+            except NodeClaimNotFoundError:
+                self._finalize(node)
+                return
         self.terminator.taint(node)
         grace_expiration = self._grace_expiration(claim)
 
@@ -199,6 +212,12 @@ class TerminationController:
                 return  # wait for the instance to actually go away
             except NodeClaimNotFoundError:
                 pass
+        self._finalize(node)
+
+    def _finalize(self, node: Node) -> None:
+        """Counter + duration histogram + finalizer removal — shared by the
+        drained path and the instance-gone fast path so the two metrics
+        never drift apart."""
         _NODES_TERMINATED.inc(
             {"nodepool": node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
         )

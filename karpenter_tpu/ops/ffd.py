@@ -120,10 +120,10 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
         return False
     if len(scheduler.existing_nodes) > DEVICE_MAX_EXISTING:
         return False
-    # The relaxation ladder may mutate pods when PreferNoSchedule taints are
-    # tolerable (preferences.go:133-145) — shape groups would go stale.
-    if scheduler.preferences.tolerate_prefer_no_schedule:
-        return False
+    # PreferNoSchedule pools extend the relax ladder with the wildcard
+    # toleration rung (preferences.go:133-145): every pod is potentially
+    # relaxable, so those solves route straight to the topo driver (which
+    # relaxes exactly like the host) — see solve_device.
     # Reserved capacity: fallback mode (the default) is device-supported —
     # reservation bookkeeping runs on every join exactly like the host's
     # can_add→Add cycle and never REJECTS a candidate, so the monotone
@@ -1644,8 +1644,12 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         _FALLBACKS_CTR.inc()
         return None
     topo = scheduler.topology
-    if getattr(topo, "topology_groups", None) or getattr(
-        topo, "inverse_topology_groups", None
+    if (
+        getattr(topo, "topology_groups", None)
+        or getattr(topo, "inverse_topology_groups", None)
+        # PreferNoSchedule pools: every pod may relax via the wildcard
+        # toleration rung — only the topo driver drives the relax ladder
+        or scheduler.preferences.tolerate_prefer_no_schedule
     ):
         attempts = [ffd_topo._TopoSolve]
     else:

@@ -339,7 +339,13 @@ class _TopoSolve(_DeviceSolve):
         self.gheaps.append([])
         self.gsynced.append(0)
         self.nptr.append(0)
-        self.g_rep.append(pod)
+        # SNAPSHOT the representative: a mid-relax pod keeps mutating in
+        # place on later rungs, and _maybe_refresh_groups recomputes this
+        # group's topology metadata from its rep — a live reference would
+        # silently shift the group onto the FUTURE shape's topology groups
+        # (soak seed 101: a wildcard-toleration rung re-pointed a pre-relax
+        # group at a fresh-count spread group, admitting an over-skew join)
+        self.g_rep.append(copy.deepcopy(pod))
         self.g_relaxable.append(self._shape_relaxable(pod))
         from karpenter_tpu.scheduling.hostportusage import get_host_ports
 
@@ -351,10 +357,12 @@ class _TopoSolve(_DeviceSolve):
         self.g_volumes.append(has_volumes)
         if has_volumes:
             self._any_volumes = True
-        self._append_group_meta(pod, ports, has_volumes)
+        self._append_group_meta(pod, ports, has_volumes, group.has_hostname)
         return gi
 
-    def _append_group_meta(self, pod: Pod, ports: list, has_volumes: bool) -> None:
+    def _append_group_meta(
+        self, pod: Pod, ports: list, has_volumes: bool, has_hostname: bool
+    ) -> None:
         """Per-shape topology metadata (also recomputed by
         _maybe_refresh_groups when relaxation creates new groups mid-solve)."""
         topo = self.topology
@@ -362,12 +370,11 @@ class _TopoSolve(_DeviceSolve):
         # inverse groups match via counts() = selects() (their node filter is
         # the permissive zero value, topologynodefilter.go:27-40) — a shape
         # an existing pod's anti-affinity selector matches is volatile too;
-        # host-port and volume shapes are volatile too (their admission
-        # state accumulates per candidate / is per-pod)
+        # host-port, volume, and hostname-constrained shapes are volatile
+        # too (their admission state accumulates per candidate / is per-pod)
         inv_matched = [
             tg for tg in topo.inverse_topology_groups.values() if tg.selects(pod)
         ]
-        has_hostname = self.groups[len(self.g_volatile)].has_hostname
         self.g_volatile.append(
             bool(owned or inv_matched or ports or has_volumes or has_hostname)
         )
@@ -429,8 +436,10 @@ class _TopoSolve(_DeviceSolve):
         self.g_matched.clear()
         self.g_rec.clear()
         self.g_inv_owned.clear()
-        for rep, ports, has_vols in zip(self.g_rep, self.g_ports, self.g_volumes):
-            self._append_group_meta(rep, ports, has_vols)
+        for rep, ports, has_vols, group in zip(
+            self.g_rep, self.g_ports, self.g_volumes, self.groups
+        ):
+            self._append_group_meta(rep, ports, has_vols, group.has_hostname)
         self._rec_plans.clear()
         self._join_plans.clear()
         # (no snapshot extension needed: abort() restores the pre-solve group
@@ -453,6 +462,18 @@ class _TopoSolve(_DeviceSolve):
             t.when_unsatisfiable == "ScheduleAnyway"
             for t in spec.topology_spread_constraints
         ):
+            return True
+        if self.s.preferences.tolerate_prefer_no_schedule:
+            # the ladder's final rung adds a wildcard PreferNoSchedule
+            # toleration (preferences.go:133-145) unless already present
+            for t in spec.tolerations:
+                if (
+                    t.operator == "Exists"
+                    and t.effect == "PreferNoSchedule"
+                    and t.key == ""
+                    and t.value == ""
+                ):
+                    return False
             return True
         return False
 

@@ -8,6 +8,8 @@ per kind — same ingestion semantics, one linearized stream.
 
 from __future__ import annotations
 
+import copy
+
 from karpenter_tpu.runtime.store import ADDED, DELETED, MODIFIED, Event, Store
 from karpenter_tpu.state.cluster import Cluster
 
@@ -34,7 +36,14 @@ class StateInformer:
             if event.type == DELETED:
                 self.cluster.delete_node(obj.metadata.name)
             else:
-                self.cluster.update_node(obj)
+                # Snapshot: the store shares objects by reference and
+                # controllers mutate them in place, but Cluster diffing
+                # (nodepool resource accounting, consolidation triggers,
+                # cluster.go:600-646/857-874) needs the PREVIOUS state to
+                # stay distinct — real informers deliver fresh object
+                # versions per event. Pods skip this (their diffing keys off
+                # the bindings map, and they dominate event volume).
+                self.cluster.update_node(copy.deepcopy(obj))
         elif kind == "Pod":
             if event.type == DELETED:
                 self.cluster.delete_pod(obj.metadata.namespace, obj.metadata.name)
@@ -44,7 +53,7 @@ class StateInformer:
             if event.type == DELETED:
                 self.cluster.delete_node_claim(obj.metadata.name)
             else:
-                self.cluster.update_node_claim(obj)
+                self.cluster.update_node_claim(copy.deepcopy(obj))
         elif kind == "NodePool":
             # NodePool changes invalidate consolidation decisions
             # (informer/nodepool.go:45-55).

@@ -186,7 +186,7 @@ def node_claim_pair(
     return node, claim
 
 
-def make_provisioner_harness(options=None):
+def make_provisioner_harness(options=None, instance_types=None):
     """Store + cluster + informer + Provisioner wiring shared by the
     provisioner-level suites (one copy; keep constructor churn here)."""
     from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
@@ -200,7 +200,7 @@ def make_provisioner_harness(options=None):
 
     clock = FakeClock()
     store = Store(clock=clock)
-    provider = FakeCloudProvider()
+    provider = FakeCloudProvider(instance_types)
     cluster = Cluster(clock, store, provider)
     informer = StateInformer(store, cluster)
     recorder = Recorder(clock=clock)

@@ -187,3 +187,33 @@ class TestBinding:
         cluster.pod_to_node_claim[key] = "n2-claim"
         binder.reconcile()
         assert store.get("Pod", pod.metadata.name).spec.node_name == "n2"
+
+    def test_prefer_no_schedule_taint_does_not_block_binding(self):
+        """kube-scheduler hard-blocks only on NoSchedule/NoExecute;
+        PreferNoSchedule is a scoring preference — a pod without any
+        toleration still binds (regression: soft-only pools deadlocked the
+        e2e loop because the simulation scheduled but the binder refused)."""
+        from karpenter_tpu.apis.core import Taint
+
+        clock, store, cluster, informer, binder = make_binder()
+        node, claim = node_claim_pair("soft-n1")
+        node.spec.taints = [Taint(key="lane", value="slow", effect="PreferNoSchedule")]
+        store.create(claim)
+        store.create(node)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        informer.flush()
+        assert binder.reconcile() == 1
+        assert store.get("Pod", pod.metadata.name).spec.node_name == "soft-n1"
+
+    def test_no_schedule_taint_still_blocks_binding(self):
+        from karpenter_tpu.apis.core import Taint
+
+        clock, store, cluster, informer, binder = make_binder()
+        node, claim = node_claim_pair("hard-n1")
+        node.spec.taints = [Taint(key="team", value="infra", effect="NoSchedule")]
+        store.create(claim)
+        store.create(node)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        informer.flush()
+        binder.reconcile()
+        assert store.get("Pod", pod.metadata.name).spec.node_name == ""

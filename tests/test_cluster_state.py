@@ -265,3 +265,369 @@ class TestSimulationIsolation:
         assert live.hostport_usage.conflicts(
             p2, [HostPort("0.0.0.0", 8080, "TCP")]
         ) is None
+
+
+class TestPodSchedulingTimes:
+    """state/suite_test.go:106-187 — pod schedulable/decision bookkeeping."""
+
+    def _mark(self, cluster, pods, pool="default-pool", errors=None):
+        cluster.mark_pod_scheduling_decisions(
+            errors or {}, {pool: list(pods)}, {}
+        )
+
+    def test_schedulable_time_stored_once(self, env):
+        clock, store, cluster, informer = env
+        from helpers import nodepool
+
+        np = nodepool("default-pool")
+        np.set_condition("NodeRegistrationHealthy", "True")
+        store.create(np)
+        pod = bound_pod("p1", "")
+        key = ("default", "p1")
+        self._mark(cluster, [pod])
+        first = cluster.pod_scheduling_success_time(key)
+        assert first == clock.now()
+        clock.step(10.0)
+        self._mark(cluster, [pod])
+        # suite_test.go:122 — an existing time is never overwritten
+        assert cluster.pod_scheduling_success_time(key) == first
+
+    def test_error_clears_schedulable_time_and_claim_mapping(self, env):
+        clock, store, cluster, informer = env
+        from helpers import nodepool
+
+        store.create(nodepool("default-pool"))
+        pod = bound_pod("p1", "")
+        key = ("default", "p1")
+        cluster.mark_pod_scheduling_decisions(
+            {}, {"default-pool": [pod]}, {"claim-a": [pod]}
+        )
+        assert cluster.pod_scheduling_success_time(key) > 0
+        assert cluster.pod_node_claim_mapping(key) == "claim-a"
+        clock.step(5.0)
+        # suite_test.go:170 — an error wipes both
+        cluster.mark_pod_scheduling_decisions({pod: ValueError("no room")}, {}, {})
+        assert cluster.pod_scheduling_success_time(key) == 0.0
+        assert cluster.pod_node_claim_mapping(key) == ""
+
+    def test_pod_deletion_clears_mappings(self, env):
+        clock, store, cluster, informer = env
+        from helpers import nodepool
+
+        store.create(nodepool("default-pool"))
+        pod = bound_pod("p1", "")
+        key = ("default", "p1")
+        cluster.ack_pods(pod)
+        self._mark(cluster, [pod])
+        store.create(pod)
+        informer.flush()
+        store.delete("Pod", "p1")
+        informer.flush()
+        # suite_test.go:137,187 — deletion clears every per-pod mapping
+        assert cluster.pod_scheduling_success_time(key) == 0.0
+        assert cluster.pod_ack_time(key) == 0.0
+        assert cluster.pod_scheduling_decision_time(key) == 0.0
+
+    def test_healthy_nodepool_time_requires_condition(self, env):
+        clock, store, cluster, informer = env
+        from helpers import nodepool
+
+        np = nodepool("default-pool")  # NodeRegistrationHealthy unset
+        store.create(np)
+        pod = bound_pod("p1", "")
+        key = ("default", "p1")
+        self._mark(cluster, [pod])
+        assert cluster.pod_healthy_nodepool_scheduled_time.get(key) is None
+        np.set_condition("NodeRegistrationHealthy", "True")
+        store.update(np)
+        clock.step(3.0)
+        self._mark(cluster, [pod])
+        assert cluster.pod_healthy_nodepool_scheduled_time[key] == clock.now()
+
+
+class TestUsageHydration:
+    """state/suite_test.go:245-424 — volume/hostport usage survive updates."""
+
+    def _pod_with_port(self, name, node_name, port=8080):
+        from karpenter_tpu.apis.core import ContainerPort
+
+        pod = bound_pod(name, node_name)
+        pod.spec.containers[0].ports = [
+            ContainerPort(container_port=80, host_port=port)
+        ]
+        return pod
+
+    def test_hostport_usage_hydrated_on_node_update(self, env):
+        clock, store, cluster, informer = env
+        store.create(self._pod_with_port("p1", "node-1"))
+        store.create(make_node())
+        informer.flush()
+        [n] = cluster.state_nodes()
+        from karpenter_tpu.scheduling.hostportusage import HostPort
+
+        conflict = n.hostport_usage.conflicts(
+            bound_pod("p2", "node-1"), [HostPort("0.0.0.0", 8080, "TCP")]
+        )
+        assert conflict is not None
+
+    def test_hostport_usage_survives_nodeclaim_update(self, env):
+        clock, store, cluster, informer = env
+        store.create(self._pod_with_port("p1", "node-1"))
+        node = make_node()
+        claim = make_claim()
+        store.create(claim)
+        store.create(node)
+        informer.flush()
+        claim.metadata.labels["refresh"] = "1"
+        store.update(claim)
+        informer.flush()
+        [n] = cluster.state_nodes()
+        from karpenter_tpu.scheduling.hostportusage import HostPort
+
+        assert n.hostport_usage.conflicts(
+            bound_pod("p2", "node-1"), [HostPort("0.0.0.0", 8080, "TCP")]
+        ) is not None
+
+    def test_same_name_node_and_claim_one_state_node(self, env):
+        """suite_test.go:425 — a NodeClaim and Node sharing one name (and
+        provider id) collapse into a single state node."""
+        clock, store, cluster, informer = env
+        store.create(make_claim(name="twin", pid="kwok://twin"))
+        node = make_node(name="twin", pid="kwok://twin")
+        store.create(node)
+        informer.flush()
+        assert len(cluster.state_nodes()) == 1
+
+
+class TestPodCounting:
+    """state/suite_test.go:453-645."""
+
+    def test_unbound_pods_not_counted(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        store.create(bound_pod("floating", ""))
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert n.total_pod_requests().get("cpu", 0.0) == 0.0
+
+    def test_terminal_pods_not_counted(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        done = bound_pod("done", "node-1", cpu=2.0)
+        done.status.phase = "Succeeded"
+        store.create(done)
+        informer.flush()
+        [n] = cluster.state_nodes()
+        assert n.total_pod_requests().get("cpu", 0.0) == 0.0
+
+
+class TestAntiAffinityTracking:
+    """state/suite_test.go:1034-1169."""
+
+    def _anti_pod(self, name, node_name, required=True):
+        from karpenter_tpu.apis.core import (
+            Affinity,
+            LabelSelector,
+            PodAffinityTerm,
+            PodAntiAffinity,
+            WeightedPodAffinityTerm,
+        )
+
+        term = PodAffinityTerm(
+            topology_key=wk.LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"app": "x"}),
+        )
+        anti = (
+            PodAntiAffinity(required=[term])
+            if required
+            else PodAntiAffinity(
+                preferred=[WeightedPodAffinityTerm(weight=1, pod_affinity_term=term)]
+            )
+        )
+        pod = bound_pod(name, node_name)
+        pod.spec.affinity = Affinity(pod_anti_affinity=anti)
+        return pod
+
+    def _tracked(self, cluster):
+        seen = []
+        cluster.for_pods_with_anti_affinity(
+            lambda pod, node: (seen.append(pod.metadata.name), True)[1]
+        )
+        return seen
+
+    def test_required_anti_affinity_tracked(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        store.create(self._anti_pod("anti-1", "node-1"))
+        informer.flush()
+        assert self._tracked(cluster) == ["anti-1"]
+
+    def test_preferred_anti_affinity_not_tracked(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        store.create(self._anti_pod("soft-1", "node-1", required=False))
+        informer.flush()
+        assert self._tracked(cluster) == []
+
+    def test_deleted_pod_stops_tracking(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        store.create(self._anti_pod("anti-1", "node-1"))
+        informer.flush()
+        store.delete("Pod", "anti-1")
+        informer.flush()
+        assert self._tracked(cluster) == []
+
+
+class TestSyncedVariants:
+    """state/suite_test.go:1218-1555."""
+
+    def test_synced_with_providerless_nodes(self, env):
+        """:1260 — unmanaged nodes with no provider id don't block the gate
+        (they're tracked under their node name)."""
+        clock, store, cluster, informer = env
+        node = make_node()
+        node.spec.provider_id = ""
+        del node.metadata.labels[wk.NODEPOOL_LABEL_KEY]
+        store.create(node)
+        informer.flush()
+        assert cluster.synced() is True
+
+    def test_not_synced_until_claim_resolves_provider_id(self, env):
+        """:1410 — a launched claim without a provider id blocks."""
+        clock, store, cluster, informer = env
+        claim = make_claim()
+        claim.status.provider_id = ""
+        claim.set_condition("Launched", "True")
+        store.create(claim)
+        informer.flush()
+        assert cluster.synced() is False
+        claim.status.provider_id = "kwok://node-1"
+        store.update(claim)
+        informer.flush()
+        assert cluster.synced() is True
+
+    def test_new_node_after_initial_sync_keeps_synced(self, env):
+        """:1507 — ingestion keeps pace with additions."""
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        informer.flush()
+        assert cluster.synced() is True
+        store.create(make_node(name="node-2", pid="kwok://node-2"))
+        informer.flush()
+        assert cluster.synced() is True
+
+
+class TestDaemonSetCache:
+    """state/suite_test.go:1557-1696."""
+
+    def _ds_and_pod(self, name, pod_name, ts):
+        from helpers import daemonset, daemonset_pod
+
+        ds = daemonset(name)
+        pod = daemonset_pod(ds)
+        pod.metadata.name = pod_name
+        pod.metadata.creation_timestamp = ts
+        pod.spec.node_name = "node-1"
+        return ds, pod
+
+    def test_newest_pod_wins(self, env):
+        clock, store, cluster, informer = env
+        ds, old = self._ds_and_pod("ds-1", "old", 1.0)
+        store.create(ds)
+        store.create(old)
+        informer.flush()
+        _, new = self._ds_and_pod("ds-1", "new", 5.0)
+        store.create(new)
+        store.update(ds)  # reference re-reconciles the daemonset (suite:1568)
+        informer.flush()
+        assert cluster.get_daemonset_pod(ds).metadata.name == "new"
+        # an OLDER pod must not displace the cached newest (suite:1596)
+        _, stale = self._ds_and_pod("ds-1", "stale", 0.5)
+        store.create(stale)
+        store.update(ds)
+        informer.flush()
+        assert cluster.get_daemonset_pod(ds).metadata.name == "new"
+
+    def test_daemonset_delete_clears_cache(self, env):
+        clock, store, cluster, informer = env
+        ds, pod = self._ds_and_pod("ds-1", "p", 1.0)
+        store.create(ds)
+        store.create(pod)
+        informer.flush()
+        assert cluster.get_daemonset_pod(ds) is not None
+        store.delete("DaemonSet", "ds-1")
+        informer.flush()
+        assert cluster.get_daemonset_pod(ds) is None
+
+
+class TestConsolidationState:
+    """state/suite_test.go:1697-1739."""
+
+    def test_state_changes_after_ttl(self, env):
+        clock, store, cluster, informer = env
+        first = cluster.consolidation_state()
+        clock.step(1.0)
+        assert cluster.consolidation_state() == first
+        clock.step(301.0)  # 5m TTL elapses
+        assert cluster.consolidation_state() != first
+
+    def test_nodepool_update_changes_state(self, env):
+        from helpers import nodepool
+
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        informer.flush()
+        state = cluster.consolidation_state()
+        clock.step(1.0)
+        np = nodepool("default-pool")
+        store.create(np)
+        informer.flush()
+        assert cluster.consolidation_state() != state
+
+
+class TestNodePoolResourceAccounting:
+    """state/suite_test.go:1933-2362."""
+
+    def test_multiple_nodepools(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node(name="a-1", pid="kwok://a-1", pool="pool-a"))
+        store.create(make_node(name="a-2", pid="kwok://a-2", pool="pool-a"))
+        store.create(make_node(name="b-1", pid="kwok://b-1", pool="pool-b"))
+        informer.flush()
+        assert cluster.nodepool_resources_for("pool-a")["cpu"] == pytest.approx(8.0)
+        assert cluster.nodepool_resources_for("pool-b")["cpu"] == pytest.approx(4.0)
+        assert cluster.nodepool_resources_for("pool-a")[NODE_RESOURCE] == 2.0
+
+    def test_node_switching_nodepools_moves_resources(self, env):
+        clock, store, cluster, informer = env
+        node = make_node(pool="pool-a")
+        store.create(node)
+        informer.flush()
+        assert cluster.nodepool_resources_for("pool-a")["cpu"] == pytest.approx(4.0)
+        node.metadata.labels[wk.NODEPOOL_LABEL_KEY] = "pool-b"
+        store.update(node)
+        informer.flush()
+        assert cluster.nodepool_resources_for("pool-a") == {}
+        assert cluster.nodepool_resources_for("pool-b")["cpu"] == pytest.approx(4.0)
+
+    def test_mark_unmark_for_deletion_updates_resources(self, env):
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        informer.flush()
+        assert cluster.nodepool_resources_for("default-pool")["cpu"] == pytest.approx(4.0)
+        cluster.mark_for_deletion("kwok://node-1")
+        assert cluster.nodepool_resources_for("default-pool") == {}
+        cluster.unmark_for_deletion("kwok://node-1")
+        assert cluster.nodepool_resources_for("default-pool")["cpu"] == pytest.approx(4.0)
+
+    def test_no_double_subtract_on_mark_then_delete(self, env):
+        """:2362 — marking for deletion and then deleting the node must not
+        subtract capacity twice."""
+        clock, store, cluster, informer = env
+        store.create(make_node())
+        informer.flush()
+        cluster.mark_for_deletion("kwok://node-1")
+        store.delete("Node", "node-1")
+        informer.flush()
+        assert cluster.nodepool_resources_for("default-pool") == {}

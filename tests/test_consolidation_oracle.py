@@ -448,3 +448,136 @@ class TestLifetimeWeightedOrder:
         assert env.reconcile() is True
         [cmd] = env.queue.get_commands()
         assert "dying-1" in [c.state_node.name() for c in cmd.candidates]
+
+
+class TestEmptinessEligibility:
+    """emptiness_test.go — which pods keep a node non-empty."""
+
+    def _empty_command(self, env):
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        return cmd
+
+    def test_daemonset_pods_do_not_block_emptiness(self):
+        """emptiness_test.go — a node with only a DaemonSet pod is empty."""
+        from karpenter_tpu.apis.core import OwnerReference
+
+        env = Env()
+        env.store.create(nodepool("default"))
+        ds_pod = unschedulable_pod(requests={"cpu": "100m"})
+        ds_pod.metadata.owner_references.append(
+            OwnerReference(kind="DaemonSet", name="ds", uid="ds-uid")
+        )
+        env.add_pair("empty-ds", pods=[ds_pod])
+        cmd = self._empty_command(env)
+        assert [c.state_node.name() for c in cmd.candidates] == ["empty-ds"]
+        assert cmd.decision() == "delete"
+
+    def test_terminating_deployment_pods_do_not_block(self):
+        """A terminating (deletion-timestamped) ReplicaSet pod counts as
+        gone — the node is empty."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        dying = unschedulable_pod(requests={"cpu": "1"})
+        dying.metadata.deletion_timestamp = 1.0
+        dying.metadata.finalizers.append("keep")
+        env.add_pair("empty-term", pods=[dying])
+        cmd = self._empty_command(env)
+        assert [c.state_node.name() for c in cmd.candidates] == ["empty-term"]
+
+    def test_terminating_statefulset_pod_blocks(self):
+        """A terminating StatefulSet pod still needs its slot (the
+        replacement can't start until it fully exits) — not empty."""
+        from karpenter_tpu.apis.core import OwnerReference
+
+        env = Env()
+        env.store.create(nodepool("default"))
+        sts_pod = unschedulable_pod(requests={"cpu": "1"})
+        sts_pod.metadata.owner_references.append(
+            OwnerReference(kind="StatefulSet", name="db", uid="sts-uid")
+        )
+        sts_pod.metadata.deletion_timestamp = 1.0
+        sts_pod.metadata.finalizers.append("keep")
+        env.add_pair("sts-node", pods=[sts_pod])
+        env.reconcile()
+        for cmd in env.queue.get_commands():
+            # the node may consolidate via other methods but never as EMPTY
+            assert cmd.decision() != "delete" or cmd.candidates[0].reschedulable_pods
+
+    def test_do_not_disrupt_false_annotation_allows_emptiness(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair("empty-false")
+        node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "false"
+        env.store.update(node)
+        env.informer.flush()
+        cmd = self._empty_command(env)
+        assert [c.state_node.name() for c in cmd.candidates] == ["empty-false"]
+
+
+class TestDriftOrdering:
+    """drift_test.go — replacement flow and candidate order."""
+
+    def _drifted_pair(self, env, name, at, pods=()):
+        from karpenter_tpu.apis.nodeclaim import CONDITION_DRIFTED
+
+        node, claim = env.add_pair(
+            name, pods=pods,
+            instance_type="s-16x-amd64-linux",
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+        )
+        claim.set_condition(CONDITION_DRIFTED, "True", now=at)
+        env.store.update(claim)
+        return node, claim
+
+    def test_earliest_drift_goes_first(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        env.clock.step(100.0)
+        self._drifted_pair(env, "late-drift", at=90.0, pods=[owned_pod()])
+        self._drifted_pair(env, "early-drift", at=10.0, pods=[owned_pod()])
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        assert [c.state_node.name() for c in cmd.candidates] == ["early-drift"]
+
+    def test_empty_drifted_node_not_counted_against_drift_budget(self):
+        """Empty drifted nodes take the emptiness path; the drift budget is
+        spent on non-empty ones only."""
+        from karpenter_tpu.apis.nodepool import Budget
+
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [
+            Budget(nodes="1", reasons=["Drifted"]),
+            Budget(nodes="100%"),
+        ]
+        env.store.create(np)
+        self._drifted_pair(env, "drift-empty", at=5.0)  # no pods -> emptiness
+        self._drifted_pair(env, "drift-busy", at=6.0, pods=[owned_pod()])
+        assert env.reconcile() is True
+        # first pass wins with emptiness (method order); the empty node's
+        # command must not consume the Drifted budget
+        [cmd] = env.queue.get_commands()
+        assert [c.state_node.name() for c in cmd.candidates] == ["drift-empty"]
+
+    def test_drift_replacement_failure_untaints(self):
+        """drift_test.go — when the replacement dies (lifecycle gave up on
+        the launch), the command rolls back: candidates untainted, the
+        Disrupted condition cleared, the original claim kept."""
+        from karpenter_tpu.apis.nodeclaim import CONDITION_DISRUPTION_REASON
+
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = self._drifted_pair(env, "drift-fail", at=5.0, pods=[owned_pod()])
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        [rep] = cmd.replacements
+        # the launch failed terminally: lifecycle deleted the replacement
+        env.store.delete("NodeClaim", rep.name)
+        env.informer.flush()
+        env.queue.reconcile()
+        env.informer.flush()
+        node = env.store.get("Node", "drift-fail")
+        assert not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        claim = env.store.get("NodeClaim", "drift-fail-claim")
+        assert not claim.condition_is_true(CONDITION_DISRUPTION_REASON)

@@ -163,6 +163,10 @@ def _random_nodepools(rng: random.Random, topo: bool = False):
         taints = []
         if rng.random() < 0.25:
             taints.append(Taint(key="team", value="infra", effect="NoSchedule"))
+        if rng.random() < 0.12:
+            # engages the relax ladder's wildcard-toleration rung for the
+            # whole solve (routes to the topo driver)
+            taints.append(Taint(key="soft", value="lane", effect="PreferNoSchedule"))
         limits = None
         if rng.random() < 0.3:
             limits = {"cpu": str(rng.choice([16, 64, 256]))}
@@ -598,6 +602,18 @@ class TestDeviceParity:
         host, dev, ran = run_case(seed)
         assert host == dev
         assert ran, "device path unexpectedly fell back to the host loop"
+
+    @pytest.mark.parametrize("seed", [101, 147])
+    def test_group_rep_immune_to_later_relax_mutation(self, seed):
+        """Regression (soak seeds 101/147): a mid-relax pod mutates in place
+        on later rungs (e.g. the wildcard PreferNoSchedule toleration); the
+        driver's per-group representative must be a snapshot, or a
+        mid-solve group refresh re-points earlier shape groups at the FUTURE
+        shape's topology groups — whose fresh store-seeded counts admit
+        over-skew joins the host rejects."""
+        host, dev, ran = run_case(seed, topo=True)
+        assert host == dev
+        assert ran
 
     def test_relaxation_creates_topology_group_mid_solve(self):
         """Regression (soak seed 469): relaxing a multi-term node-affinity

@@ -8,6 +8,7 @@ from karpenter_tpu.apis.core import (
     Condition,
     Node,
     ObjectMeta,
+    OwnerReference,
     Taint,
     VolumeAttachment,
 )
@@ -241,6 +242,139 @@ class TestTermination:
         ctrl.reconcile(store.get("Node", "term-2"))
         ctrl.reconcile(store.get("Node", "term-2"))
         assert store.try_get("Node", "term-2") is None
+
+    def test_deletes_node_without_nodeclaim(self, env):
+        """termination suite:123 — node-only termination (no paired claim)
+        walks the same finalizer pipeline."""
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, _ = node_claim_pair("solo-1")
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "solo-1"))
+        assert store.try_get("Node", "solo-1") is None
+
+    def test_instance_gone_skips_drain_when_not_ready(self, env):
+        """termination suite:593 — a NotReady node whose cloud instance has
+        vanished is deleted immediately, undrained (kubelet can't run pods)."""
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("gone-1")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        node.status.conditions = [Condition(type="Ready", status="False")]
+        store.create(node)
+        pod = bind_pod(unschedulable_pod(), node)
+        store.create(pod)
+        # provider.created intentionally empty: the instance is gone
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "gone-1"))
+        assert store.try_get("Node", "gone-1") is None
+        # the pod was never evicted — no graceful drain happened
+        assert store.try_get("Pod", pod.metadata.name) is not None
+
+    def test_instance_gone_still_drains_when_ready(self, env):
+        """termination suite:626 — a READY node drains normally even if the
+        provider says the instance is gone (the kubelet is demonstrably up)."""
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("ready-1")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        pod = bind_pod(unschedulable_pod(), node)
+        store.create(pod)
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "ready-1"))
+        assert store.try_get("Node", "ready-1") is not None  # drain pending
+        assert queue.has(pod)
+
+    def test_disrupted_taint_tolerating_pods_not_evicted(self, env):
+        """termination suite:220,250 — pods tolerating the disruption taint
+        (Equal or Exists) ride the node down without eviction, and don't
+        block its deletion."""
+        from karpenter_tpu.apis.core import Toleration
+
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("tol-1")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        equal = bind_pod(
+            unschedulable_pod(
+                name="tol-equal",
+                tolerations=[
+                    Toleration(
+                        key=wk.DISRUPTED_TAINT_KEY,
+                        operator="Equal",
+                        value="",
+                        effect="NoSchedule",
+                    )
+                ],
+            ),
+            node,
+        )
+        exists = bind_pod(
+            unschedulable_pod(
+                name="tol-exists",
+                tolerations=[
+                    Toleration(key=wk.DISRUPTED_TAINT_KEY, operator="Exists")
+                ],
+            ),
+            node,
+        )
+        store.create(equal)
+        store.create(exists)
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "tol-1"))
+        assert not queue.has(equal) and not queue.has(exists)
+        claim = store.get("NodeClaim", "tol-1-claim")
+        assert claim.condition_is_true(CONDITION_DRAINED)
+
+    def test_static_pods_not_evicted(self, env):
+        """termination suite:509 — node-owned (static) pods are never posted
+        to the eviction API and don't block the drain."""
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("static-1")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        static = bind_pod(unschedulable_pod(name="static-pod"), node)
+        static.metadata.owner_references.append(
+            OwnerReference(kind="Node", name="static-1", uid="node-uid")
+        )
+        store.create(static)
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "static-1"))
+        assert not queue.has(static)
+        claim = store.get("NodeClaim", "static-1-claim")
+        assert claim.condition_is_true(CONDITION_DRAINED)
+
+    def test_ownerless_pods_evicted(self, env):
+        """termination suite:309 — pods without an ownerRef still drain."""
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("bare-1")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        bare = bind_pod(unschedulable_pod(name="bare-pod"), node)
+        assert not bare.metadata.owner_references
+        store.create(bare)
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "bare-1"))
+        assert queue.has(bare)
+        queue.reconcile()
+        assert store.try_get("Pod", "bare-pod") is None
+        ctrl.reconcile(store.get("Node", "bare-1"))
+        claim = store.get("NodeClaim", "bare-1-claim")
+        assert claim.condition_is_true(CONDITION_DRAINED)
 
     def test_pdb_blocks_eviction(self, env):
         clock, store, provider, recorder = env

@@ -294,6 +294,138 @@ class TestMinValues:
         results = env_for(catalog, pools).schedule(two_small_pods())
         expect_two_singleton_claims(results)
 
+    def test_best_effort_nodeclaim_spec_carries_relaxation(self, path):
+        """provisioning/suite_test.go:2688 — under BestEffort the launched
+        NodeClaim's spec carries the NARROWED instance-type values with the
+        relaxed (achievable) minValues, and the relaxed annotation."""
+        if path == "device":
+            pytest.skip("provisioner-level spec; solver path exercised above")
+        from karpenter_tpu.scheduling.requirements import requirements_from_dicts
+
+        from helpers import make_provisioner_harness, nodepool, unschedulable_pod
+        from karpenter_tpu.operator.options import Options
+
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        clock, store, provider, cluster, informer, prov = make_provisioner_harness(
+            options=Options(min_values_policy="BestEffort"),
+            instance_types=catalog,
+        )
+        store.create(
+            nodepool(
+                "default",
+                requirements=[
+                    {
+                        "key": wk.LABEL_INSTANCE_TYPE,
+                        "operator": "In",
+                        "values": [
+                            "instance-type-1",
+                            "instance-type-2",
+                            "instance-type-3",
+                        ],
+                        "minValues": 3,
+                    }
+                ],
+            )
+        )
+        pod = unschedulable_pod(requests={"cpu": "0.5"})
+        store.create(pod)
+        informer.flush()
+        prov.trigger(pod.metadata.uid)
+        clock.step(1.5)
+        assert prov.reconcile() is not None
+        [claim] = store.list("NodeClaim")
+        assert (
+            claim.metadata.annotations[
+                wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY
+            ]
+            == "true"
+        )
+        reqs = requirements_from_dicts(claim.spec.requirements)
+        row = reqs.get(wk.LABEL_INSTANCE_TYPE)
+        assert set(row.values_list()) == {"instance-type-1", "instance-type-2"}
+        assert row.min_values == 2
+
+    def test_best_effort_relaxes_before_falling_back_to_other_nodepools(self, path):
+        """provisioning/suite_test.go:2758 — the high-weight pool relaxes its
+        minValues rather than ceding the pod to a lower-weight pool."""
+        if path == "device":
+            pytest.skip("provisioner-level spec; solver path exercised above")
+        from helpers import make_provisioner_harness, nodepool, unschedulable_pod
+        from karpenter_tpu.operator.options import Options
+
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        clock, store, provider, cluster, informer, prov = make_provisioner_harness(
+            options=Options(min_values_policy="BestEffort"),
+            instance_types=catalog,
+        )
+        heavy = nodepool(
+            "heavy",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "In",
+                    "values": [
+                        "instance-type-1",
+                        "instance-type-2",
+                        "instance-type-3",
+                    ],
+                    "minValues": 3,
+                }
+            ],
+            weight=100,
+        )
+        light = nodepool("light", weight=10)
+        store.create(heavy)
+        store.create(light)
+        pod = unschedulable_pod(requests={"cpu": "0.5"})
+        store.create(pod)
+        informer.flush()
+        prov.trigger(pod.metadata.uid)
+        clock.step(1.5)
+        assert prov.reconcile() is not None
+        [claim] = store.list("NodeClaim")
+        assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "heavy"
+        assert (
+            claim.metadata.annotations[
+                wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY
+            ]
+            == "true"
+        )
+
+    def test_strict_falls_back_to_other_nodepools(self, path):
+        """Strict policy: the minValues pool is unusable (template dropped),
+        so the pod lands on the lower-weight pool instead."""
+        if path == "device":
+            pytest.skip("provisioner-level spec; solver path exercised above")
+        from helpers import make_provisioner_harness, nodepool, unschedulable_pod
+
+        catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
+        clock, store, provider, cluster, informer, prov = make_provisioner_harness(
+            instance_types=catalog,
+        )
+        heavy = nodepool(
+            "heavy",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "Exists",
+                    "minValues": 3,
+                }
+            ],
+            weight=100,
+        )
+        light = nodepool("light", weight=10)
+        store.create(heavy)
+        store.create(light)
+        pod = unschedulable_pod(requests={"cpu": "0.5"})
+        store.create(pod)
+        informer.flush()
+        prov.trigger(pod.metadata.uid)
+        clock.step(1.5)
+        assert prov.reconcile() is not None
+        [claim] = store.list("NodeClaim")
+        assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "light"
+
     def test_best_effort_policy_falls_back_to_host(self, path):
         """BestEffort minValues relaxation mutates requirement rows mid-solve
         (nodeclaim.go:425-436) — the device path declines it by design. A

@@ -927,6 +927,207 @@ class TestHostPortsBothPaths:
         assert not sn.volume_usage._volumes, "abort left phantom volume entries"
 
 
+class TestNodePoolSelection:
+    """provisioning/suite_test.go:2521-2628 — which pool hosts a pod."""
+
+    def test_schedules_to_explicitly_selected_nodepool(self, path):
+        pools = [nodepool("target"), nodepool("other")]
+        pod = unschedulable_pod(node_selector={wk.NODEPOOL_LABEL_KEY: "target"})
+        results = schedule(path, [pod], node_pools=pools)
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "target"
+
+    def test_schedules_to_nodepool_by_template_labels(self, path):
+        pools = [nodepool("labeled", labels={"foo": "bar"}), nodepool("plain")]
+        pod = unschedulable_pod(node_selector={"foo": "bar"})
+        results = schedule(path, [pod], node_pools=pools)
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "labeled"
+
+    def test_avoids_prefer_no_schedule_pool_when_another_matches(self, path):
+        from karpenter_tpu.apis.core import Taint
+
+        tainted = nodepool(
+            "soft-tainted",
+            taints=[Taint(key="foo", value="bar", effect="PreferNoSchedule")],
+        )
+        pools = [tainted, nodepool("clean")]
+        results = schedule(path, [unschedulable_pod()], node_pools=pools)
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "clean"
+
+    def test_highest_weight_pool_always_wins(self, path):
+        pools = [
+            nodepool("w0"),
+            nodepool("w20", weight=20),
+            nodepool("w100", weight=100),
+        ]
+        pods = [unschedulable_pod() for _ in range(3)]
+        results = schedule(path, pods, node_pools=pools)
+        assert not results.pod_errors
+        for nc in results.new_node_claims:
+            assert nc.nodepool_name == "w100"
+
+    def test_explicit_selection_beats_weight(self, path):
+        pools = [nodepool("target"), nodepool("heavy", weight=100)]
+        pod = unschedulable_pod(node_selector={wk.NODEPOOL_LABEL_KEY: "target"})
+        results = schedule(path, [pod], node_pools=pools)
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "target"
+
+
+class TestCapacityShapes:
+    """provisioning/suite_test.go:413-458 — accelerators and maxPods."""
+
+    @staticmethod
+    def _gpu_catalog():
+        from karpenter_tpu.cloudprovider.types import (
+            InstanceType,
+            Offering,
+            Offerings,
+        )
+        from karpenter_tpu.scheduling.requirements import (
+            Operator,
+            Requirement,
+            Requirements,
+        )
+
+        def it(name, extra_resources, pods="110"):
+            cap = parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": pods})
+            cap.update(parse_resource_list(extra_resources))
+            return InstanceType(
+                name=name,
+                requirements=Requirements(
+                    Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN, [name]),
+                    Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+                    Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+                    Requirement(
+                        wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]
+                    ),
+                    Requirement(
+                        wk.CAPACITY_TYPE_LABEL_KEY,
+                        Operator.IN,
+                        [wk.CAPACITY_TYPE_ON_DEMAND],
+                    ),
+                ),
+                offerings=Offerings(
+                    [
+                        Offering(
+                            requirements=Requirements(
+                                Requirement(
+                                    wk.CAPACITY_TYPE_LABEL_KEY,
+                                    Operator.IN,
+                                    [wk.CAPACITY_TYPE_ON_DEMAND],
+                                ),
+                                Requirement(
+                                    wk.LABEL_TOPOLOGY_ZONE,
+                                    Operator.IN,
+                                    ["kwok-zone-1"],
+                                ),
+                            ),
+                            price=1.0,
+                            available=True,
+                        )
+                    ]
+                ),
+                capacity=cap,
+            )
+
+        return [
+            it("gpu-vendor-a", {"vendor-a.example.com/gpu": "2"}),
+            it("gpu-vendor-b", {"vendor-b.example.com/gpu": "2"}),
+        ]
+
+    def test_provisions_nodes_for_accelerators(self, path):
+        """:413 — each pod lands on the type carrying its vendor's GPU."""
+        catalog = self._gpu_catalog()
+        kwargs = {"catalog": catalog}
+        if path == "device":
+            kwargs["engine"] = CatalogEngine(catalog)
+        env = Env(**kwargs)
+        pods = [
+            unschedulable_pod(
+                name="gpu-a", requests={"vendor-a.example.com/gpu": "1"}
+            ),
+            unschedulable_pod(
+                name="gpu-b", requests={"vendor-b.example.com/gpu": "1"}
+            ),
+        ]
+        results = schedule(path, pods, env=env)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 2
+        by_pod = {
+            nc.pods[0].metadata.name: {it.name for it in nc.instance_type_options}
+            for nc in results.new_node_claims
+        }
+        assert by_pod["gpu-a"] == {"gpu-vendor-a"}
+        assert by_pod["gpu-b"] == {"gpu-vendor-b"}
+
+    def test_provisions_multiple_nodes_when_max_pods_set(self, path):
+        """:428 — a single-pod instance type forces one claim per pod."""
+        from karpenter_tpu.cloudprovider.types import (
+            InstanceType,
+            Offering,
+            Offerings,
+        )
+        from karpenter_tpu.scheduling.requirements import (
+            Operator,
+            Requirement,
+            Requirements,
+        )
+
+        single = InstanceType(
+            name="single-pod-instance-type",
+            requirements=Requirements(
+                Requirement(
+                    wk.LABEL_INSTANCE_TYPE, Operator.IN, ["single-pod-instance-type"]
+                ),
+                Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]),
+                Requirement(wk.LABEL_OS, Operator.IN, ["linux"]),
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]),
+                Requirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [wk.CAPACITY_TYPE_ON_DEMAND],
+                ),
+            ),
+            offerings=Offerings(
+                [
+                    Offering(
+                        requirements=Requirements(
+                            Requirement(
+                                wk.CAPACITY_TYPE_LABEL_KEY,
+                                Operator.IN,
+                                [wk.CAPACITY_TYPE_ON_DEMAND],
+                            ),
+                            Requirement(
+                                wk.LABEL_TOPOLOGY_ZONE,
+                                Operator.IN,
+                                ["kwok-zone-1"],
+                            ),
+                        ),
+                        price=0.5,
+                        available=True,
+                    )
+                ]
+            ),
+            capacity=parse_resource_list(
+                {"cpu": "16", "memory": "64Gi", "pods": "1"}
+            ),
+        )
+        catalog = [single]
+        kwargs = {"catalog": catalog}
+        if path == "device":
+            kwargs["engine"] = CatalogEngine(catalog)
+        env = Env(**kwargs)
+        pods = [unschedulable_pod(requests={"cpu": "100m"}) for _ in range(3)]
+        results = schedule(path, pods, env=env)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 3
+        for nc in results.new_node_claims:
+            assert len(nc.pods) == 1
+
+
 class TestExplicitDeviceFallbacks:
     """The features the device path still declines must decline LOUDLY —
     these specs pin the eligibility gates (ffd.py eligible())."""

@@ -166,3 +166,117 @@ class TestReservationManager:
         # releasing a hostname without the reservation is a no-op
         manager.release("host-b", offering)
         assert manager.remaining_capacity(offering) == 1
+
+
+class TestReservationManagerBatches:
+    """reservationmanager_test.go:194-350 — multi-offering calls, partial
+    releases, over-reserve panics, and mixed-operation consistency."""
+
+    @staticmethod
+    def _multi_offerings(n=3, capacity=2):
+        from karpenter_tpu.cloudprovider.types import (
+            RESERVATION_ID_LABEL,
+            InstanceType,
+            Offering,
+            Offerings,
+        )
+        from karpenter_tpu.scheduling.requirements import (
+            Operator,
+            Requirement,
+            Requirements,
+        )
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        offs = [
+            Offering(
+                requirements=Requirements(
+                    Requirement(
+                        wk.CAPACITY_TYPE_LABEL_KEY,
+                        Operator.IN,
+                        [wk.CAPACITY_TYPE_RESERVED],
+                    ),
+                    Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, ["kwok-zone-1"]),
+                    Requirement(RESERVATION_ID_LABEL, Operator.IN, [f"cr-{i}"]),
+                ),
+                price=0.1,
+                available=True,
+                reservation_capacity=capacity,
+            )
+            for i in range(n)
+        ]
+        it = InstanceType(
+            name="multi-res",
+            requirements=Requirements(
+                Requirement(wk.LABEL_INSTANCE_TYPE, Operator.IN, ["multi-res"]),
+            ),
+            offerings=Offerings(offs),
+            capacity=parse_resource_list({"cpu": "4", "memory": "16Gi"}),
+        )
+        manager = ReservationManager({"default": [it]})
+        return manager, offs
+
+    def test_multiple_offerings_single_reserve_call(self):
+        manager, offs = self._multi_offerings()
+        manager.reserve("host-a", *offs)
+        for o in offs:
+            assert manager.has_reservation("host-a", o)
+            assert manager.remaining_capacity(o) == 1
+
+    def test_mixed_new_and_existing_reservations(self):
+        manager, offs = self._multi_offerings()
+        manager.reserve("host-a", offs[0])
+        manager.reserve("host-a", *offs)  # offs[0] held, others new
+        assert manager.remaining_capacity(offs[0]) == 1
+        assert manager.remaining_capacity(offs[1]) == 1
+        assert manager.remaining_capacity(offs[2]) == 1
+
+    def test_over_reserve_raises(self):
+        manager, offs = self._multi_offerings(n=1, capacity=1)
+        manager.reserve("host-a", offs[0])
+        with pytest.raises(Exception):
+            manager.reserve("host-b", offs[0])
+
+    def test_partial_release(self):
+        manager, offs = self._multi_offerings()
+        manager.reserve("host-a", *offs)
+        manager.release("host-a", offs[0], offs[1])
+        assert not manager.has_reservation("host-a", offs[0])
+        assert not manager.has_reservation("host-a", offs[1])
+        assert manager.has_reservation("host-a", offs[2])
+        assert manager.remaining_capacity(offs[0]) == 2
+        assert manager.remaining_capacity(offs[2]) == 1
+
+    def test_release_multiple_offerings_single_call(self):
+        manager, offs = self._multi_offerings()
+        manager.reserve("host-a", *offs)
+        manager.release("host-a", *offs)
+        for o in offs:
+            assert manager.remaining_capacity(o) == 2
+
+    def test_reserve_release_cycles_track_capacity(self):
+        manager, offs = self._multi_offerings(n=1, capacity=2)
+        o = offs[0]
+        for cycle in range(5):
+            manager.reserve(f"host-{cycle}", o)
+            assert manager.remaining_capacity(o) == 1
+            manager.release(f"host-{cycle}", o)
+            assert manager.remaining_capacity(o) == 2
+
+    def test_mixed_operations_stay_consistent(self):
+        """reservationmanager_test.go:331-350 — interleaved reserves and
+        releases across hosts never drift the counters."""
+        manager, offs = self._multi_offerings(n=2, capacity=3)
+        a, b = offs
+        manager.reserve("h1", a)
+        manager.reserve("h2", a, b)
+        manager.reserve("h3", b)
+        assert manager.remaining_capacity(a) == 1
+        assert manager.remaining_capacity(b) == 1
+        manager.release("h2", a)
+        manager.reserve("h4", a)
+        manager.release("h1", a)
+        manager.release("h3", b)
+        assert manager.remaining_capacity(a) == 2
+        assert manager.remaining_capacity(b) == 2
+        assert manager.has_reservation("h4", a)
+        assert manager.has_reservation("h2", b)
