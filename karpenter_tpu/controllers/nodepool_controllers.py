@@ -21,6 +21,89 @@ from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.utils.clock import Clock
 
 
+import re as _re
+
+# CEL pattern for budget nodes (nodepool.go:99 kubebuilder marker):
+# a plain non-negative integer or a 0-100 percent
+_BUDGET_NODES_RE = _re.compile(r"^((100|[0-9]{1,2})%|[0-9]+)$")
+# duration format: minutes/hours only — no seconds precision
+# (nodepool.go:117 `^([0-9]+(m|h)+)+$`); runtime durations are parsed
+# floats, so the equivalent check is whole-minute granularity
+_LABEL_NAME_RE = _re.compile(r"^[A-Za-z0-9]([A-Za-z0-9_.\-]*[A-Za-z0-9])?$")
+_LABEL_VALUE_RE = _re.compile(r"^([A-Za-z0-9]([A-Za-z0-9_.\-]*[A-Za-z0-9])?)?$")
+_DNS_SUBDOMAIN_RE = _re.compile(
+    r"^[a-z0-9]([a-z0-9\-]*[a-z0-9])?(\.[a-z0-9]([a-z0-9\-]*[a-z0-9])?)*$"
+)
+_VALID_TAINT_EFFECTS = frozenset({"NoSchedule", "PreferNoSchedule", "NoExecute"})
+_VALID_OPERATORS = frozenset({"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"})
+
+
+def _validate_qualified_name(key: str) -> str | None:
+    """k8s qualified-name rules: [dns-subdomain/]name, name 1-63 chars
+    (validation the CRD enforces via CEL + apimachinery)."""
+    if not key:
+        return "key is required"
+    if len(key) > 316:
+        return f"key {key!r} exceeds the 316-character limit"
+    if "/" in key:
+        prefix, _, name = key.partition("/")
+        if not prefix or len(prefix) > 253 or not _DNS_SUBDOMAIN_RE.match(prefix):
+            return f"key {key!r} has an invalid prefix"
+        if "/" in name:
+            return f"key {key!r} has more than one prefix separator"
+    else:
+        name = key
+    if len(name) > 63 or not _LABEL_NAME_RE.match(name):
+        return f"key {key!r} is not a qualified name"
+    return None
+
+
+def _validate_budget(budget) -> str | None:
+    if not _BUDGET_NODES_RE.match(budget.nodes):
+        return f"invalid budget nodes value {budget.nodes!r}"
+    if (budget.schedule is None) != (budget.duration is None):
+        return "budget schedule and duration must be specified together"
+    if budget.schedule is not None:
+        from karpenter_tpu.utils import cron
+
+        err = cron.validate(budget.schedule)
+        if err is not None:
+            return f"invalid budget schedule {budget.schedule!r}: {err}"
+    if budget.duration is not None:
+        if budget.duration < 0:
+            return "budget duration must not be negative"
+        if budget.duration % 60 != 0:
+            return "budget duration must not carry seconds precision"
+    return None
+
+
+def _validate_taint(taint) -> str | None:
+    err = _validate_qualified_name(taint.key)
+    if err is not None:
+        return f"invalid taint key: {err}"
+    if taint.value and not _LABEL_VALUE_RE.match(taint.value) or len(taint.value) > 63:
+        return f"invalid taint value {taint.value!r}"
+    if taint.effect and taint.effect not in _VALID_TAINT_EFFECTS:
+        return f"invalid taint effect {taint.effect!r}"
+    return None
+
+
+def _validate_requirement(req: dict) -> str | None:
+    key = req.get("key", "")
+    err = _validate_qualified_name(key)
+    if err is not None:
+        return f"invalid requirement key: {err}"
+    if key == wk.NODEPOOL_LABEL_KEY:
+        return f"requirement key {key!r} is reserved"
+    err = wk.is_restricted_label(key)
+    if err is not None:
+        return err
+    op = req.get("operator", "")
+    if op not in _VALID_OPERATORS:
+        return f"unsupported requirement operator {op!r}"
+    return None
+
+
 class HashController:
     """Maintains the static-field hash annotation driving drift
     (nodepool/hash/controller.go:46-124)."""
@@ -155,16 +238,18 @@ class ValidationController:
         self.store.apply(pool)
 
     def _validate(self, pool: NodePool) -> str | None:
+        """Runtime twin of the CRD's CEL validation rules
+        (nodepool.go kubebuilder markers; nodepool_validation_cel_test.go)."""
         for budget in pool.spec.disruption.budgets:
-            if budget.schedule is not None and budget.duration is None:
-                return "budget with schedule must set duration"
-            if not budget.nodes.endswith("%"):
-                try:
-                    int(budget.nodes)
-                except ValueError:
-                    return f"invalid budget nodes value {budget.nodes!r}"
+            err = _validate_budget(budget)
+            if err is not None:
+                return err
+        for taint in pool.spec.template.spec.taints:
+            err = _validate_taint(taint)
+            if err is not None:
+                return err
         for req in pool.spec.template.spec.requirements:
-            err = wk.is_restricted_label(req.get("key", ""))
+            err = _validate_requirement(req)
             if err is not None:
                 return err
         for key in pool.spec.template.labels:

@@ -338,3 +338,58 @@ class TestCandidacyGates:
                 Limits.from_pdbs([]), {"default": h.pool}, {"default": its},
                 FakeQueue(), GRACEFUL_DISRUPTION_CLASS,
             )
+
+
+class TestMirrorAndMultiPDB:
+    """suite_test.go — mirror (static) pods and stacked PDBs."""
+
+    def test_do_not_disrupt_mirror_pods_block(self):
+        """suite_test.go — a do-not-disrupt MIRROR pod blocks candidacy just
+        like any other do-not-disrupt pod (the annotation is an explicit
+        operator signal regardless of evictability)."""
+        h = Harness()
+        mirror = unschedulable_pod(requests={"cpu": "1"})
+        mirror.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        mirror.metadata.owner_references.append(
+            OwnerReference(kind="Node", name="cand-1", uid="node-uid")
+        )
+        sn = h.add_node(pods=[mirror])
+        with pytest.raises(Exception, match="do-not-disrupt"):
+            h.candidate(sn)
+
+    def test_fully_blocking_pdb_on_mirror_pod_does_not_block(self):
+        h = Harness()
+        mirror = unschedulable_pod(requests={"cpu": "1"}, labels={"app": "static"})
+        mirror.metadata.owner_references.append(
+            OwnerReference(kind="Node", name="cand-1", uid="node-uid")
+        )
+        h.store.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb-static"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={"app": "static"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            )
+        )
+        sn = h.add_node(pods=[mirror])
+        assert h.candidate(sn) is not None
+
+    def test_multiple_pdbs_on_same_pod_blocks(self):
+        """A pod matched by MORE than one PDB can never be evicted via the
+        Eviction API — the node is not a candidate (graceful)."""
+        h = Harness()
+        pod = unschedulable_pod(requests={"cpu": "1"}, labels={"app": "web"})
+        for i in range(2):
+            h.store.create(
+                PodDisruptionBudget(
+                    metadata=ObjectMeta(name=f"pdb-{i}"),
+                    spec=PodDisruptionBudgetSpec(
+                        selector=LabelSelector(match_labels={"app": "web"})
+                    ),
+                    status=PodDisruptionBudgetStatus(disruptions_allowed=10),
+                )
+            )
+        sn = h.add_node(pods=[pod])
+        with pytest.raises(Exception, match="pdb"):
+            h.candidate(sn)

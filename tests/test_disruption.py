@@ -449,3 +449,41 @@ class TestDisruptionDecisionMetrics:
         for i in range(3):
             env.add_pair(f"m-multi-{i}")
         self._assert_decision_fires(env, "delete", "empty", "empty")
+
+
+class TestLeftoverTaintCleanup:
+    """suite_test.go — taints from abandoned/restarted disruption actions."""
+
+    def test_leftover_disrupted_taint_removed(self):
+        """A node carrying the disrupted taint with NO in-flight command gets
+        untainted on the next reconcile pass (controller.go:131-152)."""
+        from karpenter_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair(
+            "stale-1", pods=[unschedulable_pod(requests={"cpu": "1"})]
+        )
+        node.spec.taints = list(node.spec.taints) + [DISRUPTED_NO_SCHEDULE_TAINT]
+        claim.set_condition("DisruptionReason", "True", reason="Underutilized")
+        env.store.update(node)
+        env.store.update(claim)
+        env.informer.flush()
+        env.controller.reconcile()
+        node = env.store.get("Node", "stale-1")
+        assert not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        claim = env.store.get("NodeClaim", "stale-1-claim")
+        assert not claim.condition_is_true("DisruptionReason")
+
+    def test_in_flight_command_keeps_taint(self):
+        """Nodes actively being processed by the queue keep their taint."""
+        env = Env()
+        env.store.create(nodepool("default"))
+        env.add_pair("active-1")
+        assert env.reconcile() is True  # emptiness command started
+        node = env.store.get("Node", "active-1")
+        assert any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        # another pass with the command still queued must NOT untaint
+        env.controller.reconcile()
+        node = env.store.get("Node", "active-1")
+        assert any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)

@@ -1,0 +1,216 @@
+"""NodePool runtime-validation specs ported from the reference's CEL rules
+(nodepool_validation_cel_test.go; the CRD enforces these via kubebuilder
+markers — here the ValidationController is the runtime twin, surfacing
+failures as the ValidationSucceeded condition)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Taint
+from karpenter_tpu.apis.nodepool import Budget
+from karpenter_tpu.controllers.nodepool_controllers import ValidationController
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool
+
+CONDITION = "ValidationSucceeded"
+
+
+def validate(pool):
+    clock = FakeClock()
+    store = Store(clock=clock)
+    store.create(pool)
+    ValidationController(store, clock).reconcile(pool)
+    cond = pool.get_condition(CONDITION)
+    return cond.status == "True", cond.message
+
+
+def expect_valid(pool):
+    ok, msg = validate(pool)
+    assert ok, msg
+
+
+def expect_invalid(pool, needle=""):
+    ok, msg = validate(pool)
+    assert not ok
+    if needle:
+        assert needle in msg, msg
+
+
+class TestBudgetValidation:
+    """nodepool_validation_cel_test.go — Budgets context."""
+
+    def _pool(self, *budgets):
+        np = nodepool("default")
+        np.spec.disruption.budgets = list(budgets)
+        return np
+
+    def test_invalid_cron_fails(self):
+        expect_invalid(
+            self._pool(Budget(nodes="10", schedule="*", duration=3600.0)),
+            "schedule",
+        )
+
+    def test_schedule_with_fewer_than_5_fields_fails(self):
+        expect_invalid(
+            self._pool(Budget(nodes="10", schedule="* * * *", duration=3600.0)),
+            "schedule",
+        )
+
+    def test_negative_duration_fails(self):
+        expect_invalid(
+            self._pool(Budget(nodes="10", schedule="* * * * *", duration=-60.0)),
+            "duration",
+        )
+
+    def test_seconds_precision_duration_fails(self):
+        expect_invalid(
+            self._pool(Budget(nodes="10", schedule="* * * * *", duration=90.0)),
+            "seconds",
+        )
+
+    def test_negative_nodes_int_fails(self):
+        expect_invalid(self._pool(Budget(nodes="-10")), "nodes")
+
+    def test_negative_nodes_percent_fails(self):
+        expect_invalid(self._pool(Budget(nodes="-10%")), "nodes")
+
+    def test_percent_with_more_than_3_digits_fails(self):
+        expect_invalid(self._pool(Budget(nodes="1000%")), "nodes")
+
+    def test_cron_without_duration_fails(self):
+        expect_invalid(
+            self._pool(Budget(nodes="10", schedule="* * * * *")), "together"
+        )
+
+    def test_duration_without_cron_fails(self):
+        expect_invalid(self._pool(Budget(nodes="10", duration=3600.0)), "together")
+
+    def test_both_duration_and_cron_succeeds(self):
+        expect_valid(
+            self._pool(Budget(nodes="10", schedule="* * * * *", duration=3600.0))
+        )
+
+    def test_neither_duration_nor_cron_succeeds(self):
+        expect_valid(self._pool(Budget(nodes="10")))
+
+    def test_special_cased_crons_succeed(self):
+        expect_valid(
+            self._pool(Budget(nodes="10", schedule="@daily", duration=3600.0))
+        )
+
+    def test_one_invalid_budget_of_many_fails(self):
+        expect_invalid(
+            self._pool(
+                Budget(nodes="10"),
+                Budget(nodes="10", schedule="@foo", duration=3600.0),
+            )
+        )
+
+    def test_multiple_reasons_allowed(self):
+        expect_valid(
+            self._pool(Budget(nodes="10", reasons=["Drifted", "Underutilized", "Empty"]))
+        )
+
+
+class TestTaintValidation:
+    def _pool(self, *taints):
+        return nodepool("default", taints=list(taints))
+
+    def test_valid_taints_succeed(self):
+        expect_valid(
+            self._pool(
+                Taint(key="team", value="infra", effect="NoSchedule"),
+                Taint(key="example.com/lane", value="slow", effect="PreferNoSchedule"),
+                Taint(key="a.b/c", effect="NoExecute"),
+            )
+        )
+
+    def test_invalid_taint_key_fails(self):
+        expect_invalid(self._pool(Taint(key="-bad-", effect="NoSchedule")), "key")
+
+    def test_missing_taint_key_fails(self):
+        expect_invalid(self._pool(Taint(key="", effect="NoSchedule")), "key")
+
+    def test_overlong_taint_key_fails(self):
+        expect_invalid(
+            self._pool(Taint(key="k" * 400, effect="NoSchedule")), "key"
+        )
+
+    def test_invalid_taint_value_fails(self):
+        expect_invalid(
+            self._pool(Taint(key="team", value="bad value!", effect="NoSchedule")),
+            "value",
+        )
+
+    def test_invalid_taint_effect_fails(self):
+        expect_invalid(
+            self._pool(Taint(key="team", effect="EvictEverything")), "effect"
+        )
+
+    def test_same_key_different_effects_succeeds(self):
+        expect_valid(
+            self._pool(
+                Taint(key="team", value="infra", effect="NoSchedule"),
+                Taint(key="team", value="infra", effect="NoExecute"),
+            )
+        )
+
+
+class TestRequirementValidation:
+    def _pool(self, *reqs):
+        return nodepool("default", requirements=list(reqs))
+
+    def test_valid_requirement_keys_succeed(self):
+        expect_valid(
+            self._pool(
+                {"key": "example.com/tier", "operator": "In", "values": ["gold"]},
+                {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "Exists"},
+            )
+        )
+
+    def test_invalid_requirement_key_fails(self):
+        expect_invalid(
+            self._pool({"key": "bad key!", "operator": "Exists"}), "key"
+        )
+
+    def test_overlong_requirement_key_fails(self):
+        expect_invalid(
+            self._pool({"key": "d" * 317, "operator": "Exists"}), "key"
+        )
+
+    def test_nodepool_label_key_rejected(self):
+        expect_invalid(
+            self._pool(
+                {"key": wk.NODEPOOL_LABEL_KEY, "operator": "In", "values": ["x"]}
+            ),
+            "reserved",
+        )
+
+    def test_supported_operators_allowed(self):
+        for op in ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"):
+            values = ["1"] if op in ("In", "NotIn", "Gt", "Lt") else []
+            expect_valid(
+                self._pool({"key": "example.com/k", "operator": op, "values": values})
+            )
+
+    def test_unsupported_operator_fails(self):
+        expect_invalid(
+            self._pool({"key": "example.com/k", "operator": "Near", "values": []}),
+            "operator",
+        )
+
+    def test_restricted_domain_fails(self):
+        expect_invalid(
+            self._pool({"key": "kubernetes.io/custom", "operator": "Exists"}),
+            "restricted",
+        )
+
+    def test_restricted_domain_exceptions_allowed(self):
+        expect_valid(
+            self._pool(
+                {"key": "node.kubernetes.io/instance-type", "operator": "Exists"},
+                {"key": "subdomain.kops.k8s.io/gpu", "operator": "Exists"},
+            )
+        )
