@@ -101,6 +101,9 @@ def _validate_requirement(req: dict) -> str | None:
     op = req.get("operator", "")
     if op not in _VALID_OPERATORS:
         return f"unsupported requirement operator {op!r}"
+    min_values = req.get("minValues")
+    if min_values is not None and not (1 <= min_values <= 50):
+        return f"minValues must be in [1, 50], got {min_values}"
     return None
 
 
@@ -244,7 +247,9 @@ class ValidationController:
             err = _validate_budget(budget)
             if err is not None:
                 return err
-        for taint in pool.spec.template.spec.taints:
+        for taint in list(pool.spec.template.spec.taints) + list(
+            getattr(pool.spec.template.spec, "startup_taints", ())
+        ):
             err = _validate_taint(taint)
             if err is not None:
                 return err

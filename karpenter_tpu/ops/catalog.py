@@ -166,6 +166,15 @@ class CatalogEngine:
         self._computed_rows = 0
         self._req_compat = np.zeros((0, self.num_instances), dtype=bool)
         self._offer_compat = np.zeros((0, self.num_offerings), dtype=bool)
+        # Cross-solve caches for the FFD drivers (ops/ffd.py): steady-state
+        # provisioner passes re-solve near-identical batches, and these are
+        # pure functions of requirement CONTENT (row-id frozensets are
+        # interned per engine). joint-mask cache: rowset -> (compat, offer)
+        # masks; family-transition cache: (claim rowset, group rowset) ->
+        # (kind, joint rowset, canonical joint Requirements). The joint
+        # Requirements are shared read-only — driver callers always copy.
+        self.solver_joint_cache: dict[frozenset, Optional[tuple]] = {}
+        self.solver_fam_trans: dict[tuple, tuple] = {}
 
     # -- catalog encoding ---------------------------------------------------
 

@@ -471,16 +471,28 @@ class _NativeDriver:
         if reusable:
             cached = self._pack_cache.get(id(candidate))
             if cached is None:
+                mask = self._pack(candidate)
+                u32 = np.ascontiguousarray(u_ids, dtype=np.int32)
+                # pre-cast the stable pointers: openings for the same
+                # (template, group) repeat thousands of times per pass and
+                # ctypes casts are measurable at that rate; the arrays are
+                # held in the tuple so their buffers can't move or recycle
                 cached = (
-                    self._pack(candidate),
-                    np.ascontiguousarray(u_ids, dtype=np.int32),
-                    candidate,  # hold the array so its id can't recycle
+                    mask.ctypes.data_as(nat.p_u64),
+                    u32.ctypes.data_as(nat.p_i32),
+                    len(u32),
+                    mask,
+                    u32,
+                    candidate,
                 )
                 self._pack_cache[id(candidate)] = cached
-            mask, u32 = cached[0], cached[1]
+            mask_ptr, u32_ptr, n_u = cached[0], cached[1], cached[2]
         else:
             mask = self._pack(candidate)
             u32 = np.ascontiguousarray(u_ids, dtype=np.int32)
+            mask_ptr = mask.ctypes.data_as(nat.p_u64)
+            u32_ptr = u32.ctypes.data_as(nat.p_i32)
+            n_u = len(u32)
         remc = np.ascontiguousarray(rem, dtype=np.float64)
         self.lib.kt_add_claim(
             self.ctx,
@@ -488,10 +500,10 @@ class _NativeDriver:
             fam,
             self._cur_pod_idx,
             gi,
-            mask.ctypes.data_as(nat.p_u64),
-            u32.ctypes.data_as(nat.p_i32),
+            mask_ptr,
+            u32_ptr,
             remc.ctypes.data_as(nat.p_f64),
-            len(u32),
+            n_u,
         )
 
     def drive(self) -> None:
@@ -661,8 +673,13 @@ class _DeviceSolve:
         self.claims: list[_Claim] = []
         self.nodes = [_Node(en) for en in scheduler.existing_nodes]
         self.seq = 0  # bucket-entry counter for the stable-sort order model
-        # joint requirement-set masks: frozenset(row ids) -> (compat, offer)
-        self.joint_cache: dict[frozenset, tuple[np.ndarray, np.ndarray]] = {}
+        # joint requirement-set masks: frozenset(row ids) -> (compat, offer).
+        # Shared on the ENGINE across solves: steady-state provisioner
+        # passes re-derive identical joints, and masks are pure content
+        # functions (rows are interned per engine). Bounded below.
+        if len(e.solver_joint_cache) > 100_000:
+            e.solver_joint_cache.clear()
+        self.joint_cache = e.solver_joint_cache
         # requirement-set families: frozenset(row ids) -> id, plus the
         # canonical hostname-free Requirements per id and the memoized join
         # transitions (family, group) -> reject | same | narrow
@@ -900,6 +917,13 @@ class _DeviceSolve:
         return frozenset(
             rid(r) for r in reqs if r.key != wk.LABEL_HOSTNAME
         )
+
+    @staticmethod
+    def _sans_hostname(reqs: Requirements) -> Requirements:
+        """Canonical hostname-free copy — the form every engine-level cache
+        (solver_fam_trans, family interning) keys on; all canonicalization
+        sites must share this ONE definition."""
+        return Requirements(*(r for r in reqs if r.key != wk.LABEL_HOSTNAME))
 
     def _prepare_templates(self) -> None:
         """Template masks/overheads + the batched device sweep over all
@@ -1222,26 +1246,48 @@ class _DeviceSolve:
         """Memoized family transition for group gi joining a claim of family
         fam: reject (incompatible), same (joint row-set unchanged — adding
         the group narrows nothing), or narrow (new family id + the combined
-        compat∧offering mask to AND into the claim's options)."""
+        compat∧offering mask to AND into the claim's options).
+
+        The requirement algebra is a pure function of the two row-sets, so
+        its outcome is cached on the ENGINE across solves (steady-state
+        passes re-derive identical transitions); only the per-solve family
+        id interning and the mask AND run per solve."""
         g = self.groups[gi]
-        base = self.fam_reqs[fam]
-        if base.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
-            ent = (self._REJECT,)
-        elif g.rowset <= self.fam_rows[fam]:
-            # every group row IS the claim's row for that key: joint == claim
-            ent = (self._SAME,)
-        else:
-            joint = Requirements(*base.values())
-            joint.add(*g.reqs.values())
-            rows = self._rows_sans_hostname(joint)
-            if rows == self.fam_rows[fam]:
-                ent = (self._SAME,)
+        base_rows = self.fam_rows[fam]
+        ckey = (base_rows, g.rowset)
+        cached = self.engine.solver_fam_trans.get(ckey)
+        if cached is None:
+            base = self.fam_reqs[fam]
+            if base.compatible(g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+                cached = (self._REJECT, None, None)
+            elif g.rowset <= base_rows:
+                # every group row IS the claim's row for that key
+                cached = (self._SAME, None, None)
             else:
-                compat_v, offer_v = self._joint_masks(rows, joint)
-                new_fam = self._intern_fam(rows, joint)
-                # trailing joint: the merged pre-topology requirement set,
-                # reused by the topo driver (never mutated — callers copy)
-                ent = (self._NARROW, new_fam, compat_v & offer_v, joint)
+                joint = Requirements(*base.values())
+                joint.add(*g.reqs.values())
+                rows = self._rows_sans_hostname(joint)
+                if rows == base_rows:
+                    cached = (self._SAME, None, None)
+                else:
+                    # canonical = hostname-free: the cache key strips
+                    # hostname, so two groups differing only in a hostname
+                    # pin share this entry — the claim's own placeholder row
+                    # is re-added by the consumers that need it. Shared
+                    # read-only across solves — callers copy.
+                    cached = (self._NARROW, rows, self._sans_hostname(joint))
+            if len(self.engine.solver_fam_trans) > 100_000:
+                self.engine.solver_fam_trans.clear()
+            self.engine.solver_fam_trans[ckey] = cached
+        kind, rows, joint = cached
+        if kind == self._NARROW:
+            compat_v, offer_v = self._joint_masks(rows, joint)
+            new_fam = self._intern_fam(rows, joint)
+            # trailing joint: the merged pre-topology requirement set,
+            # reused by the topo driver (never mutated — callers copy)
+            ent = (self._NARROW, new_fam, compat_v & offer_v, joint)
+        else:
+            ent = (kind,)
         self.fam_join[(fam, gi)] = ent
         return ent
 

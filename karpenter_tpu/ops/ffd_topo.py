@@ -855,10 +855,7 @@ class _TopoSolve(_DeviceSolve):
                 c.type_mask = new_mask
                 c.rem = c.rem[keep]
                 c.u_ids = c.u_ids[keep]
-                canon = Requirements(
-                    *(r for r in joint if r.key != wk.LABEL_HOSTNAME)
-                )
-                c.fam = self._intern_fam(final_rows, canon)
+                c.fam = self._intern_fam(final_rows, self._sans_hostname(joint))
                 fitrows = fitrows[keep]
             self._commit_join(c, ci, pod, g, gi, fitrows)
             self._apply_record_plan(gi, c)
@@ -975,8 +972,7 @@ class _TopoSolve(_DeviceSolve):
                     err.min_values_incompatible = msg
                     errs.append(err)
                     continue
-            canon = Requirements(*(r for r in joint if r.key != wk.LABEL_HOSTNAME))
-            fam = self._intern_fam(final_rows, canon)
+            fam = self._intern_fam(final_rows, self._sans_hostname(joint))
             u_ids = cand_u[fitrows]
             self._open_claim(
                 ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),

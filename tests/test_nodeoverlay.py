@@ -245,3 +245,42 @@ class TestEndToEnd:
         settle(clock, op, passes=6)
         for claim in store.list("NodeClaim"):
             assert not claim.condition_is_true("Drifted")
+
+
+class TestPriceAdjustmentFormats:
+    """nodeoverlay_validation_test.go:— the signed price-adjustment grammar:
+    signed ints/floats/percentages; unsigned forms rejected; below -100%
+    rejected; above +100% fine."""
+
+    def test_signed_forms_allowed(self):
+        for adj in ("+10", "-10", "+10.5", "-10.5", "+10%", "-99%", "-100%",
+                    "+150%", "+250%"):
+            assert overlay("a", price_adjustment=adj).validate() is None, adj
+
+    def test_unsigned_forms_rejected(self):
+        for adj in ("10", "10%", "10.5", "abc", "%", "+"):
+            assert overlay("a", price_adjustment=adj).validate() is not None, adj
+
+    def test_below_negative_hundred_percent_rejected(self):
+        assert overlay("a", price_adjustment="-101%").validate() is not None
+
+    def test_nodepool_label_selector_allowed(self):
+        """Overlays MAY select on karpenter.sh/nodepool (unlike nodepool
+        requirements, where the key is reserved)."""
+        from karpenter_tpu.apis import labels as wk
+
+        o = overlay(
+            "a",
+            requirements=[
+                {"key": wk.NODEPOOL_LABEL_KEY, "operator": "In", "values": ["p1"]}
+            ],
+        )
+        assert o.validate() is None
+
+    def test_empty_requirements_allowed(self):
+        assert overlay("a").validate() is None
+
+    def test_cpu_memory_pods_capacity_overrides_rejected(self):
+        for resource in ("cpu", "memory", "pods"):
+            o = overlay("a", capacity={resource: 4.0})
+            assert o.validate() is not None, resource

@@ -179,3 +179,123 @@ class TestProvisioner:
         run_batch(clock, informer, prov, [pod])
         [claim] = store.list("NodeClaim")
         assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "heavy"
+
+
+class TestVolumeTopologyVariants:
+    """provisioning/suite_test.go:1746-2101 — ephemeral volumes, bound PVs,
+    and invalid-PVC isolation."""
+
+    def test_ephemeral_volume_storageclass_zone_injected(self, env):
+        """:1867 — a generic ephemeral volume resolves through its storage
+        class; the zone constraint lands on the claim."""
+        clock, store, provider, cluster, informer, prov = env
+        from karpenter_tpu.apis.core import NodeSelectorTerm, StorageClass, Volume
+
+        store.create(nodepool("default"))
+        store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="zonal-eph"),
+                provisioner="ebs.csi.aws.com",
+                allowed_topologies=[
+                    NodeSelectorTerm(match_expressions=[
+                        {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                         "values": ["kwok-zone-2"]}
+                    ])
+                ],
+            )
+        )
+        pod = unschedulable_pod()
+        pod.spec.volumes = [Volume(name="scratch", ephemeral_storage_class="zonal-eph")]
+        store.create(pod)
+        run_batch(clock, informer, prov, [pod])
+        [claim] = store.list("NodeClaim")
+        zone_req = next(
+            r for r in claim.spec.requirements if r["key"] == wk.LABEL_TOPOLOGY_ZONE
+        )
+        assert zone_req["values"] == ["kwok-zone-2"]
+
+    def test_ephemeral_volume_incompatible_zone_fails(self, env):
+        """:1901 — storage-class zones outside the nodepool's reach leave
+        the pod pending."""
+        clock, store, provider, cluster, informer, prov = env
+        from karpenter_tpu.apis.core import NodeSelectorTerm, StorageClass, Volume
+
+        store.create(
+            nodepool(
+                "default",
+                requirements=[
+                    {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                     "values": ["kwok-zone-1"]}
+                ],
+            )
+        )
+        store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="elsewhere"),
+                provisioner="ebs.csi.aws.com",
+                allowed_topologies=[
+                    NodeSelectorTerm(match_expressions=[
+                        {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                         "values": ["kwok-zone-4"]}
+                    ])
+                ],
+            )
+        )
+        pod = unschedulable_pod()
+        pod.spec.volumes = [Volume(name="scratch", ephemeral_storage_class="elsewhere")]
+        store.create(pod)
+        run_batch(clock, informer, prov, [pod])
+        assert store.list("NodeClaim") == []
+
+    def test_bound_pvc_schedules_to_volume_zone(self, env):
+        """:1922 — a PVC bound to a real PV inherits the PV's node affinity."""
+        clock, store, provider, cluster, informer, prov = env
+        from karpenter_tpu.apis.core import (
+            NodeSelectorTerm,
+            PersistentVolume,
+            PersistentVolumeClaim,
+            Volume,
+        )
+
+        store.create(nodepool("default"))
+        store.create(
+            PersistentVolume(
+                metadata=ObjectMeta(name="pv-1"),
+                node_affinity_required=[
+                    NodeSelectorTerm(match_expressions=[
+                        {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                         "values": ["kwok-zone-3"]}
+                    ])
+                ],
+            )
+        )
+        pvc = PersistentVolumeClaim(metadata=ObjectMeta(name="pvc-bound"))
+        pvc.volume_name = "pv-1"
+        store.create(pvc)
+        pod = unschedulable_pod()
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="pvc-bound")]
+        store.create(pod)
+        run_batch(clock, informer, prov, [pod])
+        [claim] = store.list("NodeClaim")
+        zone_req = next(
+            r for r in claim.spec.requirements if r["key"] == wk.LABEL_TOPOLOGY_ZONE
+        )
+        assert zone_req["values"] == ["kwok-zone-3"]
+
+    def test_invalid_pvc_does_not_poison_valid_pods(self, env):
+        """:1817 — a pod referencing a missing PVC stays pending; the rest
+        of the batch provisions normally."""
+        clock, store, provider, cluster, informer, prov = env
+        from karpenter_tpu.apis.core import Volume
+
+        store.create(nodepool("default"))
+        bad = unschedulable_pod(name="bad-pvc-pod")
+        bad.spec.volumes = [Volume(name="data", persistent_volume_claim="no-such-pvc")]
+        good = unschedulable_pod(name="good-pod", requests={"cpu": "1"})
+        store.create(bad)
+        store.create(good)
+        run_batch(clock, informer, prov, [bad, good])
+        claims = store.list("NodeClaim")
+        assert len(claims) == 1
+        # only the valid pod is accounted on the claim
+        assert claims[0].spec.resources.requests.get("cpu", 0) >= 1.0
