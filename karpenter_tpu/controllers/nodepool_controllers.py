@@ -102,8 +102,13 @@ def _validate_requirement(req: dict) -> str | None:
     if op not in _VALID_OPERATORS:
         return f"unsupported requirement operator {op!r}"
     min_values = req.get("minValues")
-    if min_values is not None and not (1 <= min_values <= 50):
-        return f"minValues must be in [1, 50], got {min_values}"
+    if min_values is not None:
+        # requirements are untyped dicts: the validator must be total over
+        # whatever shape arrives, never raise mid-reconcile
+        if isinstance(min_values, bool) or not isinstance(min_values, int):
+            return f"minValues must be an integer, got {min_values!r}"
+        if not 1 <= min_values <= 50:
+            return f"minValues must be in [1, 50], got {min_values}"
     return None
 
 
@@ -248,7 +253,7 @@ class ValidationController:
             if err is not None:
                 return err
         for taint in list(pool.spec.template.spec.taints) + list(
-            getattr(pool.spec.template.spec, "startup_taints", ())
+            pool.spec.template.spec.startup_taints
         ):
             err = _validate_taint(taint)
             if err is not None:

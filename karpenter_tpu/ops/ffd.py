@@ -1017,7 +1017,12 @@ class _DeviceSolve:
                 requests = np.zeros((len(row_sets), self.D), dtype=np.float32)
                 fz = e.feasibility(row_sets, requests, e.key_presence(reqs_list))
                 for i, rows in enumerate(keysets):
-                    self.joint_cache[rows] = (fz.compat[i], fz.has_offering[i])
+                    # copy: these persist on the engine across solves, and a
+                    # row VIEW would pin the whole padded sweep matrix alive
+                    self.joint_cache[rows] = (
+                        fz.compat[i].copy(),
+                        fz.has_offering[i].copy(),
+                    )
 
     _MISSING = object()
 

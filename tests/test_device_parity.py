@@ -404,28 +404,54 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
     return kwargs, spec_kwargs
 
 
-def build_case(seed: int, topo: bool = False, reserved: bool = False):
+def build_case(
+    seed: int, topo: bool = False, reserved: bool = False, cluster: bool = False
+):
     """(node_pools, state_nodes, bound_pods, daemonset_pods, build_pods)."""
     rng = random.Random(
-        seed + 1_000_000 if topo else seed + 2_000_000 if reserved else seed
+        seed + 1_000_000
+        if topo
+        else seed + 2_000_000
+        if reserved
+        else seed + 3_000_000
+        if cluster
+        else seed
     )
     pools = _random_nodepools(rng, topo)
     nodes = []
     bound = []
-    for i in range(rng.randint(0, 6)):
+    # cluster mode: a steady-state fleet — most pods join EXISTING nodes,
+    # exercising the _try_nodes path, per-node usage tracking, and the
+    # emptiest-first/in-order scan at production-like node counts
+    n_existing = rng.randint(24, 64) if cluster else rng.randint(0, 6)
+    for i in range(n_existing):
         pool = rng.choice(pools).metadata.name
         labels = {wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux"}
         if topo and rng.random() < 0.3:
             labels["tier"] = rng.choice(TIERS)
+        if cluster:
+            size = rng.choice([("16", "64Gi"), ("16", "64Gi"), ("32", "128Gi"), ("8", "32Gi")])
+        else:
+            size = ("16", "64Gi")
         node = registered_node(
             name=f"existing-{i}",
             pool=pool,
             instance_type="s-4x-amd64-linux",
             zone=rng.choice(ZONES),
-            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            capacity={"cpu": size[0], "memory": size[1], "pods": "110"},
             labels=labels,
         )
         nodes.append(node)
+        if cluster and rng.random() < 0.7:
+            # seed partial usage so nodes present varied headroom
+            for j in range(rng.randint(1, 4)):
+                bp = unschedulable_pod(
+                    name=f"seed-{i}-{j}",
+                    requests={"cpu": rng.choice(["500m", "1", "2"])},
+                )
+                bp.metadata.uid = f"seed-uid-{i}-{j}"
+                bp.metadata.creation_timestamp = 0.0
+                bound.append(bind_pod(bp, node))
         if topo:
             # live pods seed domain counts (topology.go countDomains); some
             # carry required anti-affinity, creating INVERSE topology groups
@@ -564,9 +590,11 @@ def decisions(results):
     return claims, existing, errors
 
 
-def run_case(seed: int, topo: bool = False, reserved: bool = False):
+def run_case(
+    seed: int, topo: bool = False, reserved: bool = False, cluster: bool = False
+):
     """Returns (host_decisions, device_decisions, device_ran)."""
-    pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo, reserved)
+    pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo, reserved, cluster)
     catalog = reserved_catalog() if reserved else CATALOG
 
     def env(engine):
@@ -663,6 +691,15 @@ class TestDeviceParity:
         assert host == dev
         assert ran, "reserved+topo device path unexpectedly fell back"
 
+    @pytest.mark.parametrize("seed", range(15))
+    def test_large_existing_cluster_parity(self, seed):
+        """Steady-state fleet shape: 24-64 existing nodes with seeded usage;
+        most pods join existing capacity (the _try_nodes scan) rather than
+        opening claims — decisions must match the host exactly."""
+        host, dev, ran = run_case(seed, cluster=True)
+        assert host == dev
+        assert ran, "cluster-mode device path unexpectedly fell back"
+
     def test_device_solves_counter_never_regresses_to_fallback(self):
         """The production-shaped workload (≥64 plain pods, kwok catalog) must
         take the device path — guards against silent eligibility regressions."""
@@ -670,16 +707,19 @@ class TestDeviceParity:
         assert ran
 
 
-def main(n_cases: int, topo: bool = False, reserved: bool = False) -> int:
+def main(
+    n_cases: int, topo: bool = False, reserved: bool = False, cluster: bool = False
+) -> int:
     failures = 0
     fallbacks = 0
     label = (
         "reserved+topo"
         if topo and reserved
-        else "topo" if topo else "reserved" if reserved else "plain"
+        else "topo" if topo else "reserved" if reserved else
+        "cluster" if cluster else "plain"
     )
     for seed in range(n_cases):
-        host, dev, ran = run_case(seed, topo, reserved)
+        host, dev, ran = run_case(seed, topo, reserved, cluster)
         if host != dev:
             failures += 1
             print(f"{label} seed {seed}: DIVERGED")
@@ -707,4 +747,6 @@ if __name__ == "__main__":
         rc |= main(n, reserved=True)
     if mode in ("restopo", "all"):
         rc |= main(n, topo=True, reserved=True)
+    if mode in ("cluster", "all"):
+        rc |= main(n, cluster=True)
     sys.exit(rc)

@@ -299,3 +299,62 @@ class TestVolumeTopologyVariants:
         assert len(claims) == 1
         # only the valid pod is accounted on the claim
         assert claims[0].spec.resources.requests.get("cpu", 0) >= 1.0
+
+
+class TestSchedulingConsistency:
+    """provisioning/suite_test.go:459-530."""
+
+    def test_nodepool_hash_stable_across_mid_scheduling_change(self, env):
+        """:459 — the claim's nodepool-hash annotation reflects the pool AT
+        scheduling time, even if the pool mutates before create."""
+        clock, store, provider, cluster, informer, prov = env
+
+        pool = nodepool("default")
+        store.create(pool)
+        hash_before = pool.static_hash()
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        store.create(pod)
+        informer.flush()
+        prov.trigger(pod.metadata.uid)
+        clock.step(1.5)
+        # mutate the pool AFTER batching/scheduling begins
+        results = prov.reconcile()
+        assert results is not None
+        pool.spec.template.labels["new-label"] = "new-value"
+        store.update(pool)
+        assert pool.static_hash() != hash_before
+        [claim] = store.list("NodeClaim")
+        assert (
+            claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY]
+            == hash_before
+        )
+
+    def test_pods_pack_onto_replacement_when_node_deleting(self, env):
+        """:491 — pods from a deleting node batch together and land on ONE
+        replacement claim."""
+        from helpers import bind_pod, node_claim_pair
+
+        clock, store, provider, cluster, informer, prov = env
+        store.create(nodepool("default"))
+        node, claim = node_claim_pair("leaving-1")
+        node.metadata.deletion_timestamp = 5.0
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        claim.metadata.deletion_timestamp = 5.0
+        store.create(claim)
+        store.create(node)
+        pods = []
+        for i in range(3):
+            p = bind_pod(unschedulable_pod(requests={"cpu": "1"}), node)
+            store.create(p)
+            pods.append(p)
+        informer.flush()
+        for p in pods:
+            prov.trigger(p.metadata.uid)
+        clock.step(1.5)
+        assert prov.reconcile() is not None
+        replacements = [
+            c for c in store.list("NodeClaim") if c.metadata.name != claim.metadata.name
+        ]
+        assert len(replacements) == 1
+        # all three pods fit the single replacement's resource envelope
+        assert replacements[0].spec.resources.requests.get("cpu", 0) >= 3.0
