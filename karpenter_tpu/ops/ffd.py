@@ -30,11 +30,15 @@ reproducing the host loop's decisions bit-for-bit:
    narrowing groups) are evaluated host-side from the engine's cached row
    matrices — exact, no device round-trip on the sequential path.
 
-Eligibility is checked first (`eligible`): solves with reserved capacity,
-minValues, or PreferNoSchedule relaxation — and hostname-pinned pods —
-take the host path, which remains the semantics oracle. Topology-engaged
-solves and host-port/volume shapes run the topo-aware driver
-(ops/ffd_topo.py).
+Eligibility is checked first (`eligible`). Every scheduling construct runs
+on the device path: topology, host ports, volumes, hostname pins, strict
+minValues (per-join diversity gate), reserved capacity in BOTH offering
+modes (fallback bookkeeping per join; strict's scan-aborting errors on the
+all-volatile topo driver), and PreferNoSchedule relaxation. The host loop
+remains the semantics oracle; the one metered decline left is BestEffort
+minValues relaxation (it mutates requirement rows mid-solve).
+Topology-engaged, host-port/volume, hostname, PreferNoSchedule, and
+strict-reserved solves run the topo-aware driver (ops/ffd_topo.py).
 """
 
 from __future__ import annotations
@@ -124,13 +128,14 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     # toleration rung (preferences.go:133-145): every pod is potentially
     # relaxable, so those solves route straight to the topo driver (which
     # relaxes exactly like the host) — see solve_device.
-    # Reserved capacity: fallback mode (the default) is device-supported —
-    # reservation bookkeeping runs on every join exactly like the host's
+    # Reserved capacity is device-supported in BOTH modes. Fallback (the
+    # default): bookkeeping runs on every join exactly like the host's
     # can_add→Add cycle and never REJECTS a candidate, so the monotone
-    # machinery stays sound. Strict mode turns reservation exhaustion into
-    # non-monotone candidate rejections plus scan-aborting
-    # ReservedOfferingErrors (scheduler.go:519,574 short-circuits) — host
-    # path. The catalog scan is cached on the (immutable) engine catalog.
+    # machinery stays sound. Strict: reservation exhaustion raises
+    # scan-aborting ReservedOfferingErrors (scheduler.go:519,574
+    # short-circuits) — non-monotone, so those solves route to the topo
+    # driver with every shape volatile (see solve_device/_prepare_templates).
+    # The catalog scan is cached on the (immutable) engine catalog.
     if scheduler.reserved_capacity_enabled:
         has_reserved = getattr(scheduler.engine, "_kt_has_reserved", None)
         if has_reserved is None:
@@ -140,13 +145,6 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
                 for o in it.offerings
             )
             scheduler.engine._kt_has_reserved = has_reserved
-        if has_reserved:
-            from karpenter_tpu.scheduler.nodeclaim import (
-                RESERVED_OFFERING_MODE_FALLBACK,
-            )
-
-            if scheduler.reserved_offering_mode != RESERVED_OFFERING_MODE_FALLBACK:
-                return False
     dims = scheduler.engine.resource_dims
     for nct in scheduler.nodeclaim_templates:
         if nct.requirements.has_min_values():
@@ -166,6 +164,20 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
         if any(k not in dims for k in scheduler.daemon_overhead[nct]):
             return False
     return True
+
+
+def _strict_reserved(scheduler) -> bool:
+    """One predicate for strict-mode reserved routing — shared by
+    solve_device's driver selection and _DeviceSolve.strict_res so the two
+    can never disagree."""
+    if not (
+        scheduler.reserved_capacity_enabled
+        and getattr(scheduler.engine, "_kt_has_reserved", False)
+    ):
+        return False
+    from karpenter_tpu.scheduler.nodeclaim import RESERVED_OFFERING_MODE_STRICT
+
+    return scheduler.reserved_offering_mode == RESERVED_OFFERING_MODE_STRICT
 
 
 def _has_pod_affinity_terms(aff) -> bool:
@@ -709,11 +721,22 @@ class _DeviceSolve:
         # per-claim-index HostPortUsage; populated only by the topo driver
         # when host ports are in play (plain solves gate ports shapes out)
         self._claim_hp: dict[int, HostPortUsage] = {}
-        # set for real in _prepare_templates; abort() may run before that
-        # (e.g. an ineligible shape found during grouping)
+        # min_active is set for real in _prepare_templates; abort() may run
+        # before that (e.g. an ineligible shape found during grouping)
         self.min_active = False
-        self.res_active = False
         self._saved_rm: Optional[tuple] = None
+        # reserved-capacity flags are needed during grouping already (strict
+        # mode makes every shape volatile on the topo driver)
+        self.res_active = bool(
+            scheduler.reserved_capacity_enabled
+            and getattr(e, "_kt_has_reserved", False)
+        )
+        self.strict_res = _strict_reserved(scheduler)
+        # strict-mode paths evaluate reservations PRE-commit (the evaluation
+        # can raise at the host's can_add position) and stash the result
+        # here for the commit hook; fallback mode leaves it None (computed
+        # post-commit, identical by construction)
+        self._pending_reserved: Optional[list] = None
 
     def abort(self) -> None:
         """Undo external state mutations before a host fallback. The plain
@@ -731,39 +754,78 @@ class _DeviceSolve:
 
     # -- reserved offerings (fallback mode; nodeclaim.go:166-205,324-346) ----
 
-    def _reserved_for(self, c: "_Claim") -> list:
-        """The host's _offerings_to_reserve over the claim's current
-        surviving types: reserved offerings compatible with the claim's
-        requirements that can still be reserved for its hostname, in catalog
-        order. Fallback mode never rejects, so this runs only on successful
-        joins — exactly the host's can_add→Add cadence."""
-        surv_u = np.zeros(self.U, dtype=bool)
-        surv_u[c.u_ids] = True
-        final = c.type_mask & surv_u[self.uid_of_type]
+    def _reserved_eval(
+        self,
+        hostname: str,
+        reqs: Requirements,
+        final_types: np.ndarray,
+        fam: Optional[int] = None,
+        current_reserved: Sequence = (),
+    ) -> list:
+        """The host's _offerings_to_reserve (nodeclaim.go:166-205) over a
+        surviving-type mask: reserved offerings compatible with `reqs` that
+        can still be reserved for `hostname`, in catalog order. In STRICT
+        mode this raises the host's ReservedOfferingErrors — compatible but
+        unreservable, or updated constraints stripping every held option."""
         rm = self.s.reservation_manager
-        reqs = self.fam_reqs[c.fam]
+        has_compatible = False
         out = []
         for i, offs in self.res_offs:
-            if not final[i]:
+            if not final_types[i]:
                 continue
             for oi, o in enumerate(offs):
                 if not o.available:
                     continue
-                key = (c.fam, i, oi)
-                ok = self._res_compat.get(key)
-                if ok is None:
+                if fam is not None:
+                    key = (fam, i, oi)
+                    ok = self._res_compat.get(key)
+                    if ok is None:
+                        ok = reqs.is_compatible(
+                            o.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                        )
+                        self._res_compat[key] = ok
+                else:
                     ok = reqs.is_compatible(
                         o.requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
                     )
-                    self._res_compat[key] = ok
-                if ok and rm.can_reserve(c.hostname, o):
+                if not ok:
+                    continue
+                has_compatible = True
+                if rm.can_reserve(hostname, o):
                     out.append(o)
+        if self.strict_res:
+            from karpenter_tpu.scheduler.nodeclaim import ReservedOfferingError
+
+            if has_compatible and not out:
+                raise ReservedOfferingError(
+                    "one or more instance types with compatible reserved offerings "
+                    "are available, but could not be reserved"
+                )
+            if current_reserved and not out:
+                raise ReservedOfferingError(
+                    "satisfying updated nodeclaim constraints would remove all "
+                    "compatible reserved offering options"
+                )
         return out
 
-    def _apply_reserved(self, c: "_Claim") -> None:
+    def _final_types(self, type_mask: np.ndarray, u_ids: np.ndarray) -> np.ndarray:
+        surv_u = np.zeros(self.U, dtype=bool)
+        surv_u[u_ids] = True
+        return type_mask & surv_u[self.uid_of_type]
+
+    def _apply_reserved(self, c: "_Claim", updated: Optional[list] = None) -> None:
         """NodeClaim.add's reservation tail: reserve the fresh set, release
-        ids that dropped out (nodeclaim.go:337-346)."""
-        updated = self._reserved_for(c)
+        ids that dropped out (nodeclaim.go:337-346). Strict callers pass the
+        pre-commit-evaluated list (the evaluation may raise and must run at
+        the host's can_add position); fallback mode computes it here, on the
+        post-commit state — identical by construction."""
+        if updated is None:
+            updated = self._reserved_eval(
+                c.hostname,
+                self.fam_reqs[c.fam],
+                self._final_types(c.type_mask, c.u_ids),
+                fam=c.fam,
+            )
         rm = self.s.reservation_manager
         rm.reserve(c.hostname, *updated)
         updated_ids = {o.reservation_id for o in updated}
@@ -947,14 +1009,9 @@ class _DeviceSolve:
             for ti in range(T)
         ]
         self.min_active = any(self.tmpl_min)
-        # reserved-capacity bookkeeping (fallback mode): per-type reserved
-        # offerings in catalog order + a snapshot of the ReservationManager
-        # so a fallback abort leaves the host loop uncorrupted state
-        self.res_active = bool(
-            s.reserved_capacity_enabled
-            and getattr(e, "_kt_has_reserved", False)
-        )
-        self._saved_rm: Optional[tuple] = None
+        # reserved-capacity bookkeeping: per-type reserved offerings in
+        # catalog order + a snapshot of the ReservationManager so a
+        # fallback abort leaves the host loop uncorrupted state
         if self.res_active:
             self.res_offs: list[tuple[int, list]] = []
             for i, it in enumerate(e.instance_types):
@@ -1184,7 +1241,8 @@ class _DeviceSolve:
             self._joined = c
             self._order_hook_move(ci, (count, rank, ci), (c.count, c.rank, ci))
             if self.res_active:
-                self._apply_reserved(c)
+                self._apply_reserved(c, self._pending_reserved)
+                self._pending_reserved = None
             return True
         return False
 
@@ -1447,7 +1505,8 @@ class _DeviceSolve:
         self.claims.append(c)
         self._order_hook_add(len(self.claims) - 1)
         if self.res_active:
-            self._apply_reserved(c)
+            self._apply_reserved(c, self._pending_reserved)
+            self._pending_reserved = None
 
     def _limits_mask(self, remaining: dict) -> np.ndarray:
         """Types whose CAPACITY fits inside the nodepool's remaining limits
@@ -1695,12 +1754,16 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         _FALLBACKS_CTR.inc()
         return None
     topo = scheduler.topology
+    strict_reserved = _strict_reserved(scheduler)
     if (
         getattr(topo, "topology_groups", None)
         or getattr(topo, "inverse_topology_groups", None)
         # PreferNoSchedule pools: every pod may relax via the wildcard
         # toleration rung — only the topo driver drives the relax ladder
         or scheduler.preferences.tolerate_prefer_no_schedule
+        # strict reserved mode: reservation exhaustion rejects candidates
+        # non-monotonically and aborts pod scans — volatile paths only
+        or strict_reserved
     ):
         attempts = [ffd_topo._TopoSolve]
     else:

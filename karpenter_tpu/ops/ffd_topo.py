@@ -376,7 +376,17 @@ class _TopoSolve(_DeviceSolve):
             tg for tg in topo.inverse_topology_groups.values() if tg.selects(pod)
         ]
         self.g_volatile.append(
-            bool(owned or inv_matched or ports or has_volumes or has_hostname)
+            bool(
+                owned
+                or inv_matched
+                or ports
+                or has_volumes
+                or has_hostname
+                # strict reserved: every join runs the reservation gate at
+                # the host's can_add position, and its rejections are not
+                # monotone (capacity frees on release)
+                or self.strict_res
+            )
         )
         # host matching order: owned groups in dict order, then matching
         # inverse groups (topology.py _matching_topologies)
@@ -738,7 +748,8 @@ class _TopoSolve(_DeviceSolve):
         c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
         self._scan.move(ci, old_key, (c.count, c.rank, ci))
         if self.res_active:
-            self._apply_reserved(c)
+            self._apply_reserved(c, self._pending_reserved)
+            self._pending_reserved = None
 
     def _try_claims_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
         topo = self.topology
@@ -804,6 +815,20 @@ class _TopoSolve(_DeviceSolve):
                         and not self._min_join_ok(c, c.u_ids[fitrows])
                     ):
                         continue
+                    if self.strict_res:
+                        # host can_add position: a ReservedOfferingError here
+                        # rejects THIS candidate only — the inflight scan
+                        # swallows per-candidate errors (scheduler.go:519-534)
+                        try:
+                            self._pending_reserved = self._reserved_eval(
+                                c.hostname,
+                                self.fam_reqs[c.fam],
+                                self._final_types(c.type_mask, c.u_ids[fitrows]),
+                                fam=c.fam,
+                                current_reserved=c.reserved,
+                            )
+                        except ncmod.ReservedOfferingError:
+                            continue
                     self._commit_join(c, ci, pod, g, gi, fitrows)
                     self._apply_record_plan(gi, c)
                     if gp:
@@ -839,6 +864,19 @@ class _TopoSolve(_DeviceSolve):
                     and not self._min_join_ok(c, c.u_ids[fitrows])
                 ):
                     continue
+                if self.strict_res:
+                    try:
+                        # rows unchanged ⟹ content equals the fam's — the
+                        # (fam, offering) compat memo applies
+                        self._pending_reserved = self._reserved_eval(
+                            c.hostname,
+                            joint,
+                            self._final_types(c.type_mask, c.u_ids[fitrows]),
+                            fam=c.fam,
+                            current_reserved=c.reserved,
+                        )
+                    except ncmod.ReservedOfferingError:
+                        continue
             else:
                 compat_v, offer_v = self._joint_masks(final_rows, joint)
                 new_mask = c.type_mask & compat_v & offer_v
@@ -852,6 +890,16 @@ class _TopoSolve(_DeviceSolve):
                     c, c.u_ids[fitrows], new_mask
                 ):
                     continue
+                if self.strict_res:
+                    try:
+                        self._pending_reserved = self._reserved_eval(
+                            c.hostname,
+                            joint,
+                            self._final_types(new_mask, c.u_ids[fitrows]),
+                            current_reserved=c.reserved,
+                        )
+                    except ncmod.ReservedOfferingError:
+                        continue
                 c.type_mask = new_mask
                 c.rem = c.rem[keep]
                 c.u_ids = c.u_ids[keep]
@@ -972,6 +1020,22 @@ class _TopoSolve(_DeviceSolve):
                     err.min_values_incompatible = msg
                     errs.append(err)
                     continue
+            if self.strict_res:
+                surv_u = np.zeros(self.U, dtype=bool)
+                surv_u[cand_u[fitrows]] = True
+                try:
+                    self._pending_reserved = self._reserved_eval(
+                        hostname,
+                        joint,
+                        candidate & surv_u[self.uid_of_type],
+                    )
+                except ncmod.ReservedOfferingError as e:
+                    # earliest-index-wins: the reserved error preempts later
+                    # templates AND any collected errors (scheduler.go:574,
+                    # 486-490 tail)
+                    return e
+            elif self.res_active:
+                self._pending_reserved = None
             fam = self._intern_fam(final_rows, self._sans_hostname(joint))
             u_ids = cand_u[fitrows]
             self._open_claim(
@@ -1039,6 +1103,14 @@ class _TopoSolve(_DeviceSolve):
             err = self._try_once(p, pgi)
             if err is None:
                 return None
+            if isinstance(err, ncmod.ReservedOfferingError):
+                # a new-claim reserved error preempts relaxation —
+                # _try_schedule re-raises it (scheduler.go:374-375)
+                if relaxed_any:
+                    self.topology.update(pod)
+                    s.update_cached_pod_data(pod)
+                    self._relax_restore.pop(pod.metadata.uid, None)
+                return err
             if not self.g_relaxable[pgi]:
                 if relaxed_any:
                     self.topology.update(pod)

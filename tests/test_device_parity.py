@@ -591,11 +591,17 @@ def decisions(results):
 
 
 def run_case(
-    seed: int, topo: bool = False, reserved: bool = False, cluster: bool = False
+    seed: int,
+    topo: bool = False,
+    reserved: bool = False,
+    cluster: bool = False,
+    strict: bool = False,
 ):
     """Returns (host_decisions, device_decisions, device_ran)."""
+    reserved = reserved or strict
     pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo, reserved, cluster)
     catalog = reserved_catalog() if reserved else CATALOG
+    extra = {"reserved_offering_mode": "Strict"} if strict else {}
 
     def env(engine):
         import copy
@@ -607,6 +613,7 @@ def run_case(
             daemonset_pods=copy.deepcopy(ds_pods),
             catalog=catalog,
             engine=engine,
+            **extra,
         )
 
     # hostname placeholder strings are decision-relevant under topology
@@ -692,6 +699,16 @@ class TestDeviceParity:
         assert ran, "reserved+topo device path unexpectedly fell back"
 
     @pytest.mark.parametrize("seed", range(15))
+    def test_strict_reserved_decision_parity(self, seed):
+        """Strict-mode reserved capacity on the all-volatile topo driver:
+        pre-commit reservation gates, scan-aborting ReservedOfferingErrors,
+        and capacity exhaustion across claims must match the host exactly
+        (same workloads as the fallback-mode reserved seeds)."""
+        host, dev, ran = run_case(seed, strict=True)
+        assert host == dev
+        assert ran, "strict-reserved device path unexpectedly fell back"
+
+    @pytest.mark.parametrize("seed", range(15))
     def test_large_existing_cluster_parity(self, seed):
         """Steady-state fleet shape: 24-64 existing nodes with seeded usage;
         most pods join existing capacity (the _try_nodes scan) rather than
@@ -708,18 +725,24 @@ class TestDeviceParity:
 
 
 def main(
-    n_cases: int, topo: bool = False, reserved: bool = False, cluster: bool = False
+    n_cases: int,
+    topo: bool = False,
+    reserved: bool = False,
+    cluster: bool = False,
+    strict: bool = False,
 ) -> int:
     failures = 0
     fallbacks = 0
     label = (
-        "reserved+topo"
+        "strict-reserved"
+        if strict
+        else "reserved+topo"
         if topo and reserved
         else "topo" if topo else "reserved" if reserved else
         "cluster" if cluster else "plain"
     )
     for seed in range(n_cases):
-        host, dev, ran = run_case(seed, topo, reserved, cluster)
+        host, dev, ran = run_case(seed, topo, reserved, cluster, strict)
         if host != dev:
             failures += 1
             print(f"{label} seed {seed}: DIVERGED")
@@ -749,4 +772,6 @@ if __name__ == "__main__":
         rc |= main(n, topo=True, reserved=True)
     if mode in ("cluster", "all"):
         rc |= main(n, cluster=True)
+    if mode in ("strictres", "all"):
+        rc |= main(n, strict=True)
     sys.exit(rc)

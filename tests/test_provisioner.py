@@ -358,3 +358,61 @@ class TestSchedulingConsistency:
         assert len(replacements) == 1
         # all three pods fit the single replacement's resource envelope
         assert replacements[0].spec.resources.requests.get("cpu", 0) >= 3.0
+
+    def test_volume_zone_not_relaxed_away_with_multiple_terms(self, env):
+        """:2101 — the volume-derived zone requirement is injected into ALL
+        OR'd node-affinity terms, so relaxing the unsatisfiable first term
+        away cannot lose it."""
+        from karpenter_tpu.apis.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorTerm,
+            PersistentVolume,
+            PersistentVolumeClaim,
+            Volume,
+        )
+
+        clock, store, provider, cluster, informer, prov = env
+        store.create(nodepool("default"))
+        store.create(
+            PersistentVolume(
+                metadata=ObjectMeta(name="pv-z3"),
+                node_affinity_required=[
+                    NodeSelectorTerm(match_expressions=[
+                        {"key": wk.LABEL_TOPOLOGY_ZONE, "operator": "In",
+                         "values": ["kwok-zone-3"]}
+                    ])
+                ],
+            )
+        )
+        pvc = PersistentVolumeClaim(metadata=ObjectMeta(name="pvc-z3"))
+        pvc.volume_name = "pv-z3"
+        store.create(pvc)
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="pvc-z3")]
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(match_expressions=[
+                        {"key": "example.com/label", "operator": "In",
+                         "values": ["unsupported"]}
+                    ]),
+                    NodeSelectorTerm(match_expressions=[
+                        {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In",
+                         "values": [wk.CAPACITY_TYPE_ON_DEMAND]}
+                    ]),
+                ]
+            )
+        )
+        store.create(pod)
+        run_batch(clock, informer, prov, [pod])
+        [claim] = store.list("NodeClaim")
+        zone_req = next(
+            r for r in claim.spec.requirements if r["key"] == wk.LABEL_TOPOLOGY_ZONE
+        )
+        assert zone_req["values"] == ["kwok-zone-3"]
+        ct_req = next(
+            r for r in claim.spec.requirements
+            if r["key"] == wk.CAPACITY_TYPE_LABEL_KEY
+        )
+        assert ct_req["values"] == [wk.CAPACITY_TYPE_ON_DEMAND]

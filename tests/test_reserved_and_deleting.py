@@ -200,44 +200,43 @@ class TestDeletingNodeRescheduling:
 
 
 class TestStrictReservedMode:
-    """Strict mode is an explicit device-path fallback (scan-aborting
-    ReservedOfferingErrors are non-monotone): on the device leg these run
-    the host loop and the fallback counter must advance."""
+    """Strict mode runs on the device path since round 4 (the all-volatile
+    topo driver evaluates the reservation gate at the host's can_add
+    position); both legs must agree, including the scan-aborting errors."""
 
     def _strict_env(self, capacity):
-        from karpenter_tpu.ops.catalog import CatalogEngine
-
-        catalog = reserved_catalog(reservation_capacity=capacity)
-        kwargs = {
-            "catalog": catalog,
-            "reserved_offering_mode": RESERVED_OFFERING_MODE_STRICT,
-        }
-        if Env is not HostEnv:
-            kwargs["engine"] = CatalogEngine(catalog)
-        return HostEnv(**kwargs)
-
-    def _schedule(self, env, pods):
-        from karpenter_tpu.ops import ffd
-
-        f0 = ffd.DEVICE_FALLBACKS
-        results = env.schedule(pods)
-        if Env is not HostEnv:
-            assert ffd.DEVICE_FALLBACKS > f0, "strict mode must decline the device path"
-        return results
+        return env_for(
+            reserved_catalog(reservation_capacity=capacity),
+            reserved_offering_mode=RESERVED_OFFERING_MODE_STRICT,
+        )
 
     def test_strict_mode_errors_instead_of_falling_back(self):
         """suite_test.go:3976 — with compatible reserved offerings that can't
         be reserved, strict mode surfaces ReservedOfferingError instead of
         silently falling back to on-demand."""
         env = self._strict_env(0)
-        results = self._schedule(env, [unschedulable_pod(requests={"cpu": "1"})])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
         assert not results.new_node_claims
         [err] = list(results.pod_errors.values())
         assert isinstance(err, ReservedOfferingError)
 
     def test_strict_mode_reserves_when_capacity_available(self):
         env = self._strict_env(1)
-        results = self._schedule(env, [unschedulable_pod(requests={"cpu": "1"})])
+        results = env.schedule([unschedulable_pod(requests={"cpu": "1"})])
         assert not results.pod_errors
         [nc] = results.new_node_claims
         assert nc.reserved_offerings
+
+    def test_strict_mode_capacity_exhausts_across_claims(self):
+        """Two claims' worth of pods against one reserved slot: the first
+        claim reserves, the second pod's scan aborts with the host's error."""
+        env = self._strict_env(1)
+        pods = [
+            unschedulable_pod(name=f"p-{i}", requests={"cpu": "3"})
+            for i in range(2)
+        ]
+        results = env.schedule(pods)
+        assert len(results.new_node_claims) == 1
+        [err] = list(results.pod_errors.values())
+        assert isinstance(err, ReservedOfferingError)
+        assert "could not be reserved" in str(err)

@@ -5,11 +5,11 @@ reference line cited per test class), each run against BOTH solver paths:
 - host:   the per-pod FFD loop (engine off)
 - device: the batched fast path (engine on, DEVICE_MIN_PODS patched to 1)
 
-Device runs assert DEVICE_SOLVES advanced; specs whose features the device
-path intentionally declines (strict reserved mode, BestEffort minValues
-relaxation) assert the fallback EXPLICITLY, so eligibility regressions can't
-hide. Hostname selectors, fallback-mode reserved capacity, and strict
-minValues all RUN on the device path since round 4.
+Device runs assert DEVICE_SOLVES advanced; the ONE feature the device path
+intentionally declines (BestEffort minValues relaxation) asserts the
+fallback EXPLICITLY, so eligibility regressions can't hide. Hostname
+selectors, reserved capacity in both offering modes, and strict minValues
+all RUN on the device path since round 4.
 Topology and preferred-affinity/relaxation specs run the topo-aware driver
 (ops/ffd_topo.py) and must match host decisions exactly. Deleting-node rescheduling specs
 (suite_test.go:3545-3699) live with the provisioner/e2e tests instead —
@@ -1154,9 +1154,11 @@ class TestExplicitDeviceFallbacks:
         )
         assert nc.requirements.get(RESERVATION_ID_LABEL).has("cr-1")
 
-    def test_strict_reserved_solve_falls_back(self, path):
-        """Strict mode turns reservation exhaustion into scan-aborting
-        errors (non-monotone) — the device path declines it by design."""
+    def test_strict_reserved_runs_on_device(self, path):
+        """Strict reserved mode runs on the all-volatile topo driver since
+        round 4: successful solves reserve, exhaustion raises the host's
+        scan-aborting ReservedOfferingError."""
+        from karpenter_tpu.cloudprovider.types import RESERVATION_ID_LABEL
         from karpenter_tpu.scheduler.nodeclaim import RESERVED_OFFERING_MODE_STRICT
 
         from test_reserved_and_deleting import reserved_catalog
@@ -1170,10 +1172,11 @@ class TestExplicitDeviceFallbacks:
             kwargs["engine"] = CatalogEngine(catalog)
         env = Env(**kwargs)
         results = schedule(
-            path, [unschedulable_pod(requests={"cpu": "1"})],
-            device_falls_back=True, env=env,
+            path, [unschedulable_pod(requests={"cpu": "1"})], env=env,
         )
         assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.requirements.get(RESERVATION_ID_LABEL).has("cr-1")
 
     def test_strict_min_values_runs_on_device(self, path):
         """Strict-policy minValues is device-supported since round 4 (the
