@@ -794,18 +794,11 @@ class _DeviceSolve:
                 if rm.can_reserve(hostname, o):
                     out.append(o)
         if self.strict_res:
-            from karpenter_tpu.scheduler.nodeclaim import ReservedOfferingError
+            from karpenter_tpu.scheduler.nodeclaim import (
+                raise_strict_reserved_errors,
+            )
 
-            if has_compatible and not out:
-                raise ReservedOfferingError(
-                    "one or more instance types with compatible reserved offerings "
-                    "are available, but could not be reserved"
-                )
-            if current_reserved and not out:
-                raise ReservedOfferingError(
-                    "satisfying updated nodeclaim constraints would remove all "
-                    "compatible reserved offering options"
-                )
+            raise_strict_reserved_errors(has_compatible, out, current_reserved)
         return out
 
     def _final_types(self, type_mask: np.ndarray, u_ids: np.ndarray) -> np.ndarray:

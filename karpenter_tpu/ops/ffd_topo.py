@@ -1011,23 +1011,19 @@ class _TopoSolve(_DeviceSolve):
             if not fitrows.any():
                 errs.append(self._filter_error(base, compat_v, offer_v, ti, g))
                 continue
+            u_ids = cand_u[fitrows]
+            final = self._final_types(candidate, u_ids)
             if self.min_active and self.tmpl_min[ti]:
-                surv_u = np.zeros(self.U, dtype=bool)
-                surv_u[cand_u[fitrows]] = True
-                msg = self._min_fail(ti, candidate & surv_u[self.uid_of_type])
+                msg = self._min_fail(ti, final)
                 if msg is not None:
                     err = self._filter_error(base, compat_v, offer_v, ti, g)
                     err.min_values_incompatible = msg
                     errs.append(err)
                     continue
             if self.strict_res:
-                surv_u = np.zeros(self.U, dtype=bool)
-                surv_u[cand_u[fitrows]] = True
                 try:
                     self._pending_reserved = self._reserved_eval(
-                        hostname,
-                        joint,
-                        candidate & surv_u[self.uid_of_type],
+                        hostname, joint, final
                     )
                 except ncmod.ReservedOfferingError as e:
                     # earliest-index-wins: the reserved error preempts later
@@ -1037,7 +1033,6 @@ class _TopoSolve(_DeviceSolve):
             elif self.res_active:
                 self._pending_reserved = None
             fam = self._intern_fam(final_rows, self._sans_hostname(joint))
-            u_ids = cand_u[fitrows]
             self._open_claim(
                 ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),
                 hostname=hostname,
@@ -1048,9 +1043,7 @@ class _TopoSolve(_DeviceSolve):
                     hp.add(pod, gp)
                 self._claim_hp[len(self.claims) - 1] = hp
             self._apply_record_plan(gi, self.claims[-1])
-            surv_u = np.zeros(self.U, dtype=bool)
-            surv_u[u_ids] = True
-            self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
+            self._subtract_max(nct, final)
             return None
         if not errs:
             errs.append(ValueError("no nodepool can host the pod"))
@@ -1059,6 +1052,13 @@ class _TopoSolve(_DeviceSolve):
             if len(errs) == 1
             else ValueError("; ".join(str(e) for e in errs))
         )
+
+    def _restore_relaxed(self, pod: Pod) -> None:
+        """Final-failure tail of a relax chain: restore the ORIGINAL pod's
+        topology ownership and cached data (scheduler.go:363-367)."""
+        self.topology.update(pod)
+        self.s.update_cached_pod_data(pod)
+        self._relax_restore.pop(pod.metadata.uid, None)
 
     # -- attempt / relax loop ------------------------------------------------
 
@@ -1107,22 +1107,16 @@ class _TopoSolve(_DeviceSolve):
                 # a new-claim reserved error preempts relaxation —
                 # _try_schedule re-raises it (scheduler.go:374-375)
                 if relaxed_any:
-                    self.topology.update(pod)
-                    s.update_cached_pod_data(pod)
-                    self._relax_restore.pop(pod.metadata.uid, None)
+                    self._restore_relaxed(pod)
                 return err
             if not self.g_relaxable[pgi]:
                 if relaxed_any:
-                    self.topology.update(pod)
-                    s.update_cached_pod_data(pod)
-                    self._relax_restore.pop(pod.metadata.uid, None)
+                    self._restore_relaxed(pod)
                 return err
             rc = copy.deepcopy(p) if p is pod else p
             if not s.preferences.relax(rc):
                 if relaxed_any:
-                    self.topology.update(pod)
-                    s.update_cached_pod_data(pod)
-                    self._relax_restore.pop(pod.metadata.uid, None)
+                    self._restore_relaxed(pod)
                 return err
             relaxed_any = True
             self._relax_restore.setdefault(pod.metadata.uid, pod)

@@ -47,6 +47,25 @@ ENGINE_MIN_CATALOG = 64
 _hostname_counter = itertools.count(1)
 
 
+def raise_strict_reserved_errors(
+    has_compatible: bool, reserved: Sequence, current_reserved: Sequence
+) -> None:
+    """Strict-mode reservation failures (nodeclaim.go:170-205) — the ONE
+    source of these conditions and message strings, shared by the host's
+    _offerings_to_reserve and the device solver's _reserved_eval so parity
+    can't drift."""
+    if has_compatible and not reserved:
+        raise ReservedOfferingError(
+            "one or more instance types with compatible reserved offerings "
+            "are available, but could not be reserved"
+        )
+    if current_reserved and not reserved:
+        raise ReservedOfferingError(
+            "satisfying updated nodeclaim constraints would remove all "
+            "compatible reserved offering options"
+        )
+
+
 class ReservedOfferingError(Exception):
     """Strict reserved-capacity failures that must not fall back
     (nodeclaim.go:51-67)."""
@@ -375,16 +394,9 @@ class NodeClaim:
                 if self.reservation_manager.can_reserve(self.hostname, o):
                     reserved.append(o)
         if self.reserved_offering_mode == RESERVED_OFFERING_MODE_STRICT:
-            if has_compatible and not reserved:
-                raise ReservedOfferingError(
-                    "one or more instance types with compatible reserved offerings "
-                    "are available, but could not be reserved"
-                )
-            if self.reserved_offerings and not reserved:
-                raise ReservedOfferingError(
-                    "satisfying updated nodeclaim constraints would remove all "
-                    "compatible reserved offering options"
-                )
+            raise_strict_reserved_errors(
+                has_compatible, reserved, self.reserved_offerings
+            )
         return reserved
 
     def finalize_scheduling(self) -> None:

@@ -487,3 +487,101 @@ class TestLeftoverTaintCleanup:
         env.controller.reconcile()
         node = env.store.get("Node", "active-1")
         assert any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+
+
+class TestSpotToSpotTruncation:
+    """consolidation_test.go:1217-1500 — the launch set is price-ordered and
+    sized max(15, minValues-needed) so the resulting spot node sticks."""
+
+    def _gated_env(self, pools=None, instance_types=None):
+        from karpenter_tpu.operator.options import FeatureGates
+
+        env = Env(
+            options=Options(
+                feature_gates=FeatureGates(spot_to_spot_consolidation=True)
+            ),
+            instance_types=instance_types,
+        )
+        for p in pools or [nodepool("default")]:
+            env.store.create(p)
+        return env
+
+    def _spot_candidate(self, env):
+        env.add_pair(
+            "spot-cand",
+            pods=[unschedulable_pod(requests={"cpu": "1"})],
+            instance_type="s-32x-amd64-linux",
+            capacity_type=wk.CAPACITY_TYPE_SPOT,
+            capacity={"cpu": "32", "memory": "128Gi", "pods": "110"},
+        )
+
+    def test_launch_set_is_the_cheapest_15(self):
+        """:1217 — options are price-ordered BEFORE the flexibility
+        truncation: the kept 15 are exactly the 15 cheapest spot options."""
+        env = self._gated_env()
+        self._spot_candidate(env)
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        claim = cmd.replacements[0].node_claim
+        kept = claim.instance_type_options
+        assert len(kept) == 15
+
+        def cheapest_spot(it):
+            return min(
+                o.price
+                for o in it.offerings
+                if o.available and o.capacity_type == wk.CAPACITY_TYPE_SPOT
+            )
+
+        kept_prices = [cheapest_spot(it) for it in kept]
+        # price-ordered within the kept set
+        assert kept_prices == sorted(kept_prices)
+        # no compatible option outside the kept set is cheaper than the
+        # most expensive kept one
+        kept_names = {it.name for it in kept}
+        outside = [
+            cheapest_spot(it)
+            for it in env.provider.instance_types
+            if it.name not in kept_names
+            and it.offerings.available().has_compatible(claim.requirements)
+            and it.requirements.intersects_ok(claim.requirements)
+        ]
+        assert all(p >= kept_prices[-1] for p in outside)
+
+    def test_min_values_expands_the_launch_set(self):
+        """:1327 — minValues needing more than 15 types wins the max()."""
+        pool = nodepool(
+            "default",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "Exists",
+                    "minValues": 25,
+                }
+            ],
+        )
+        env = self._gated_env(pools=[pool])
+        self._spot_candidate(env)
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        claim = cmd.replacements[0].node_claim
+        assert len(claim.instance_type_options) == 25
+
+    def test_small_min_values_keeps_default_truncation(self):
+        """:1447 — minValues satisfiable within 15 keeps the default cap."""
+        pool = nodepool(
+            "default",
+            requirements=[
+                {
+                    "key": wk.LABEL_INSTANCE_TYPE,
+                    "operator": "Exists",
+                    "minValues": 5,
+                }
+            ],
+        )
+        env = self._gated_env(pools=[pool])
+        self._spot_candidate(env)
+        assert env.reconcile() is True
+        [cmd] = env.queue.get_commands()
+        claim = cmd.replacements[0].node_claim
+        assert len(claim.instance_type_options) == 15
