@@ -416,3 +416,36 @@ class TestSchedulingConsistency:
             if r["key"] == wk.CAPACITY_TYPE_LABEL_KEY
         )
         assert ct_req["values"] == [wk.CAPACITY_TYPE_ON_DEMAND]
+
+    def test_restricted_domain_exception_selector_validates(self, env):
+        """suite_test.go:431-457 — pod selectors under the exception domains
+        (and their subdomains) pass the provisioner's restricted-label
+        validation and schedule when the NodePool defines them."""
+        clock, store, provider, cluster, informer, prov = env
+        store.create(
+            nodepool(
+                "default",
+                requirements=[
+                    {"key": "kops.k8s.io/gpu", "operator": "In", "values": ["1"]},
+                    {
+                        "key": "sub.node-restriction.kubernetes.io/team",
+                        "operator": "In",
+                        "values": ["infra"],
+                    },
+                ],
+            )
+        )
+        pod = store.create(
+            unschedulable_pod(
+                node_selector={
+                    "kops.k8s.io/gpu": "1",
+                    "sub.node-restriction.kubernetes.io/team": "infra",
+                }
+            )
+        )
+        run_batch(clock, informer, prov, [pod])
+        [claim] = store.list("NodeClaim")
+        gpu_req = next(
+            r for r in claim.spec.requirements if r["key"] == "kops.k8s.io/gpu"
+        )
+        assert gpu_req["values"] == ["1"]

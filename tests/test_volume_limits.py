@@ -23,28 +23,37 @@ path = both_paths_fixture(globals())
 DRIVER = "ebs.csi.example.com"
 
 
-def volume_env(attach_limit: int, **env_kwargs):
+def volume_env(
+    attach_limit: int,
+    provisioner: str = DRIVER,
+    csi_driver: str = DRIVER,
+    node_name: str = "vol-node-1",
+    sc_name: str = "fast",
+    **env_kwargs,
+):
     # CSINode must exist before the Node event is ingested: limits are read
     # when cluster state (re)builds the node (cluster.py CSINode lookup)
     env = Env(**env_kwargs)
-    env.store.create(StorageClass(metadata=ObjectMeta(name="fast"), provisioner=DRIVER))
+    env.store.create(
+        StorageClass(metadata=ObjectMeta(name=sc_name), provisioner=provisioner)
+    )
     env.store.create(
         CSINode(
-            metadata=ObjectMeta(name="vol-node-1"),
-            drivers=[CSINodeDriver(name=DRIVER, allocatable_count=attach_limit)],
+            metadata=ObjectMeta(name=node_name),
+            drivers=[CSINodeDriver(name=csi_driver, allocatable_count=attach_limit)],
         )
     )
-    node, claim = node_claim_pair("vol-node-1")
+    node, claim = node_claim_pair(node_name)
     env.store.create(node)
     env.store.create(claim)
     env.informer.flush()
     return env
 
 
-def pvc_pod(env, pvc_name):
+def pvc_pod(env, pvc_name, sc_name: str = "fast"):
     env.store.try_get("PersistentVolumeClaim", pvc_name) or env.store.create(
         PersistentVolumeClaim(
-            metadata=ObjectMeta(name=pvc_name), storage_class_name="fast"
+            metadata=ObjectMeta(name=pvc_name), storage_class_name=sc_name
         )
     )
     return unschedulable_pod(
@@ -78,3 +87,28 @@ class TestVolumeLimits:
         results = env.schedule(pods)
         assert not results.pod_errors
         assert not results.new_node_claims
+
+
+class TestCSIMigration:
+    """suite_test.go:3384 — in-tree provisioners count against the MIGRATED
+    CSI driver's attach limits (volumeusage.py's plugin translation)."""
+
+    def test_in_tree_provisioner_counts_against_migrated_driver(self):
+        env = volume_env(
+            attach_limit=1,
+            provisioner="kubernetes.io/aws-ebs",
+            csi_driver="ebs.csi.aws.com",
+            node_name="mig-node-1",
+            sc_name="in-tree-sc",
+        )
+        pods = [
+            pvc_pod(env, "mig-a", sc_name="in-tree-sc"),
+            pvc_pod(env, "mig-b", sc_name="in-tree-sc"),
+        ]
+        results = env.schedule(pods)
+        assert not results.pod_errors
+        # limit 1 on the migrated driver: exactly one pod fits the existing
+        # node, the other overflows to a new claim
+        on_node = [p for en in results.existing_nodes for p in en.pods]
+        assert len(on_node) == 1
+        assert len(results.new_node_claims) == 1
