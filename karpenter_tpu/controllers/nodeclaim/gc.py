@@ -17,12 +17,15 @@ from karpenter_tpu.apis.nodeclaim import (
     CONDITION_REGISTERED,
     NodeClaim,
 )
-from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.cloudprovider.types import CloudProvider, NodeClaimNotFoundError
 from karpenter_tpu.events.recorder import Event, Recorder
 from karpenter_tpu.metrics import global_registry
 from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils.clock import Clock
+
+_log = klog.logger("nodeclaim.garbagecollection")
 
 GC_PERIOD = 120.0  # garbagecollection/controller.go: every 2m
 # podevents dedupes rapid event storms to one status write per 10s window
@@ -68,14 +71,31 @@ class ExpirationController:
         self.store.delete(claim)
 
 
+_GC_DELETE_ERRORS = global_registry.counter(
+    "karpenter_nodeclaims_gc_delete_errors_total",
+    "orphaned cloud instances whose deletion failed during garbage collection",
+)
+
+
 class GarbageCollectionController:
     """Reconcile cloud instances vs claims both ways
-    (garbagecollection/controller.go:51-124)."""
+    (garbagecollection/controller.go:51-124). Orphan-delete failures are
+    never silent: the reference logs each one and relies on the 2m
+    requeue to retry (garbagecollection/controller.go:93-116) — here each
+    failure logs, counts, and emits a Warning event so a persistently
+    undeletable instance (= invisible cost leakage) shows up."""
 
-    def __init__(self, store: Store, cloud_provider: CloudProvider, clock: Clock):
+    def __init__(
+        self,
+        store: Store,
+        cloud_provider: CloudProvider,
+        clock: Clock,
+        recorder: Recorder | None = None,
+    ):
         self.store = store
         self.cloud_provider = cloud_provider
         self.clock = clock
+        self.recorder = recorder
         self._last_run = -GC_PERIOD
 
     def reconcile(self) -> None:
@@ -92,8 +112,24 @@ class GarbageCollectionController:
             if pid not in store_pids:
                 try:
                     self.cloud_provider.delete(cloud_claim)
-                except Exception:  # noqa: BLE001
-                    pass
+                except NodeClaimNotFoundError:
+                    pass  # terminated out-of-band between list() and delete()
+                except Exception as e:  # noqa: BLE001 — retried next GC period
+                    _GC_DELETE_ERRORS.inc()
+                    _log.error(
+                        "failed to garbage-collect orphaned instance",
+                        provider_id=pid,
+                        error=str(e),
+                    )
+                    if self.recorder is not None:
+                        self.recorder.publish(
+                            Event(
+                                cloud_claim,
+                                "Warning",
+                                "FailedGarbageCollection",
+                                f"deleting orphaned instance {pid}: {e}",
+                            )
+                        )
         # Claims whose instance disappeared underneath them
         for claim in store_claims:
             if (

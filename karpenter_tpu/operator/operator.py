@@ -105,7 +105,9 @@ class Operator:
         )
         self.nc_disruption = NCDisruption(store, cloud_provider, self.clock)
         self.expiration = ExpirationController(store, self.clock, self.recorder)
-        self.gc = GarbageCollectionController(store, cloud_provider, self.clock)
+        self.gc = GarbageCollectionController(
+            store, cloud_provider, self.clock, recorder=self.recorder
+        )
         self.consistency = ConsistencyController(store, self.recorder, self.clock)
         self.podevents = PodEventsController(store, self.clock)
         self.hydration = HydrationController(store)

@@ -47,7 +47,7 @@ def _profile_sample(seconds: float, interval: float = 0.01) -> str:
     stack_counts: collections.Counter = collections.Counter()
     samples = 0
     while time.monotonic() < deadline:
-        for thread_id, frame in sys_current_frames().items():
+        for thread_id, frame in sys._current_frames().items():
             if thread_id == me:
                 continue
             stack = []

@@ -492,6 +492,47 @@ class TestGCAndExpiration:
         ctrl.reconcile()
         assert provider.created == {}
 
+    def test_gc_delete_failure_is_visible(self, env):
+        """A provider delete failure on an orphan must log, count, and
+        emit a Warning event — never a silent pass (the orphan is real
+        cost leaking until the 2m requeue retries it)."""
+        from karpenter_tpu.controllers.nodeclaim.gc import _GC_DELETE_ERRORS
+
+        clock, store, provider, recorder = env
+        orphan = NodeClaim(metadata=ObjectMeta(name="orphan"))
+        orphan.status.provider_id = "fake://orphan-err"
+        provider.created["fake://orphan-err"] = orphan
+        provider.next_delete_err = RuntimeError("api throttled")
+        ctrl = GarbageCollectionController(store, provider, clock, recorder=recorder)
+        before = _GC_DELETE_ERRORS.value()
+        clock.step(121.0)
+        ctrl.reconcile()
+        assert provider.created, "failed delete leaves the orphan for retry"
+        assert _GC_DELETE_ERRORS.value() == before + 1
+        assert recorder.calls("FailedGarbageCollection") == 1
+        # next GC period retries and succeeds
+        clock.step(121.0)
+        ctrl.reconcile()
+        assert provider.created == {}
+
+    def test_gc_already_gone_is_not_an_error(self, env):
+        """NodeClaimNotFoundError from delete means the instance vanished
+        between list() and delete() — success, not cost leakage."""
+        from karpenter_tpu.cloudprovider.types import NodeClaimNotFoundError
+        from karpenter_tpu.controllers.nodeclaim.gc import _GC_DELETE_ERRORS
+
+        clock, store, provider, recorder = env
+        orphan = NodeClaim(metadata=ObjectMeta(name="orphan"))
+        orphan.status.provider_id = "fake://orphan-gone"
+        provider.created["fake://orphan-gone"] = orphan
+        provider.next_delete_err = NodeClaimNotFoundError("already gone")
+        ctrl = GarbageCollectionController(store, provider, clock, recorder=recorder)
+        before = _GC_DELETE_ERRORS.value()
+        clock.step(121.0)
+        ctrl.reconcile()
+        assert _GC_DELETE_ERRORS.value() == before
+        assert recorder.calls("FailedGarbageCollection") == 0
+
     def test_gc_claim_without_instance(self, env):
         clock, store, provider, recorder = env
         node, claim = node_claim_pair("gone-1")
