@@ -38,6 +38,11 @@ _NODEPOOL_LIMIT = global_registry.gauge(
 _NODEPOOL_USAGE = global_registry.gauge(
     "karpenter_nodepools_usage", "nodepool usage", labels=["nodepool", "resource_type"]
 )
+_CONDITION_COUNT = global_registry.gauge(
+    "karpenter_status_condition_count",
+    "objects currently holding each status-condition state",
+    labels=["kind", "type", "status", "reason"],
+)
 
 
 class PodMetricsController:
@@ -102,6 +107,40 @@ class NodeMetricsController:
                     )
                 )
             self.metric_store.update(f"node/{sn.name()}", series)
+
+
+class StatusConditionMetricsController:
+    """Condition-count gauges per CRD — the TPU-native stand-in for the
+    three operatorpkg status controllers the reference registry wires
+    (controllers.go:102-120). Each reconcile rebuilds the whole family
+    atomically, so conditions that disappear (object deleted, condition
+    cleared) drop their series. Transition totals/durations are emitted
+    at the set_condition chokepoint (apis/conditions.py)."""
+
+    KINDS = ("NodeClaim", "NodePool", "NodeOverlay")
+
+    def __init__(self, store: Store):
+        self.store = store
+        self.metric_store = MetricStore()
+
+    def reconcile(self) -> None:
+        counts: dict[tuple[str, str, str, str], int] = {}
+        for kind in self.KINDS:
+            for obj in self.store.list(kind):
+                for c in obj.status.conditions:
+                    key = (kind, c.type, c.status, c.reason)
+                    counts[key] = counts.get(key, 0) + 1
+        self.metric_store.update(
+            "status-conditions",
+            [
+                (
+                    _CONDITION_COUNT,
+                    {"kind": k, "type": t, "status": s, "reason": r},
+                    float(n),
+                )
+                for (k, t, s, r), n in counts.items()
+            ],
+        )
 
 
 class NodePoolMetricsController:

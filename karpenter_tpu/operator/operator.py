@@ -21,6 +21,7 @@ from karpenter_tpu.controllers.metrics_controllers import (
     NodeMetricsController,
     NodePoolMetricsController,
     PodMetricsController,
+    StatusConditionMetricsController,
 )
 from karpenter_tpu.controllers.node.health import HealthController
 from karpenter_tpu.controllers.node.termination import (
@@ -138,6 +139,7 @@ class Operator:
         self.pod_metrics = PodMetricsController(store, self.cluster, self.clock)
         self.node_metrics = NodeMetricsController(self.cluster)
         self.nodepool_metrics = NodePoolMetricsController(store, self.cluster)
+        self.condition_metrics = StatusConditionMetricsController(store)
 
         self._dispatch_watch = store.watch(
             ["Pod", "Node", "NodeClaim", "NodePool"]
@@ -191,6 +193,7 @@ class Operator:
         self.pod_metrics.reconcile()
         self.node_metrics.reconcile()
         self.nodepool_metrics.reconcile()
+        self.condition_metrics.reconcile()
 
     def run(self, passes: int = 1) -> None:
         for _ in range(passes):

@@ -64,3 +64,71 @@ class TestMetricsCloudProvider:
         text = global_registry.expose()
         assert "karpenter_cloudprovider_duration_seconds" in text
         assert 'method="create"' in text or "method=\"create\"" in text
+
+
+class TestStatusConditionMetrics:
+    """Per-CRD status-condition series, matching the operatorpkg status
+    controllers the reference auto-registers (controllers.go:102-120)."""
+
+    def _run_operator(self):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        for _ in range(10):
+            clock.step(2.0)
+            op.run_once()
+        return clock, store, op
+
+    def test_transition_duration_recorded(self):
+        """Launch sets Registered=Unknown; registration flips it True some
+        clock-time later — that held-for duration lands in the histogram."""
+        from karpenter_tpu.apis.conditions import CONDITION_TRANSITION_SECONDS
+
+        labels = {"kind": "NodeClaim", "type": "Registered", "status": "True"}
+        before_n = CONDITION_TRANSITION_SECONDS.count(labels)
+        before_sum = CONDITION_TRANSITION_SECONDS.sum(labels)
+        clock, store, op = self._run_operator()
+        claims = store.list("NodeClaim")
+        assert claims and claims[0].condition_is_true("Registered")
+        assert CONDITION_TRANSITION_SECONDS.count(labels) == before_n + 1
+        # kwok registration delay is nonzero on the fake clock
+        assert CONDITION_TRANSITION_SECONDS.sum(labels) > before_sum
+
+    def test_transitions_counted(self):
+        from karpenter_tpu.apis.conditions import CONDITION_TRANSITIONS_TOTAL
+
+        labels = {"kind": "NodeClaim", "type": "Launched", "status": "True"}
+        before = CONDITION_TRANSITIONS_TOTAL.value(labels)
+        self._run_operator()
+        assert CONDITION_TRANSITIONS_TOTAL.value(labels) == before + 1
+
+    def test_condition_count_gauge_exposed_and_pruned(self):
+        clock, store, op = self._run_operator()
+        text = op.metrics_text()
+        assert "karpenter_status_condition_count" in text
+        assert "karpenter_status_condition_transitions_total" in text
+        assert "karpenter_status_condition_transition_seconds" in text
+        from karpenter_tpu.controllers.metrics_controllers import _CONDITION_COUNT
+
+        labels = {
+            "kind": "NodeClaim", "type": "Registered",
+            "status": "True", "reason": "",
+        }
+        assert _CONDITION_COUNT.value(labels) == 1.0
+        # NodePool conditions counted too
+        assert any(
+            k == "NodePool"
+            for key, _ in _CONDITION_COUNT.series().items()
+            for lk, k in key
+            if lk == "kind"
+        )
+        # deleting the claim prunes its series on the next reconcile
+        # (finalizers stripped: we want the object fully gone, not Terminating)
+        for claim in store.list("NodeClaim"):
+            claim.metadata.finalizers = []
+            store.apply(claim)
+            store.delete(claim)
+        op.condition_metrics.reconcile()
+        assert _CONDITION_COUNT.value(labels) == 0.0
