@@ -24,7 +24,8 @@ from karpenter_tpu.utils.clock import Clock
 
 def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -> int:
     options = Options.parse(argv)
-    klog.configure(options.log_level)
+    base = {"cluster": options.cluster_name} if options.cluster_name else {}
+    klog.configure(options.log_level, **base)
     log = klog.logger("operator")
 
     clock = Clock()
@@ -82,6 +83,7 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
         delay = pass_interval - (time.monotonic() - started)
         if delay > 0 and not stop["requested"]:
             time.sleep(delay)
+    operator.shutdown()
     log.info("operator stopped", passes=passes)
     for server in servers:
         server.stop()

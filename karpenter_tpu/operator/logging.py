@@ -44,6 +44,8 @@ class _JSONFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
         }
+        if _base_fields:
+            entry.update(_base_fields)
         extra = getattr(record, "kv", None)
         if extra:
             entry.update(extra)
@@ -80,13 +82,20 @@ class Logger:
 
 _ROOT = "karpenter"
 _configured = False
+# global structured fields stamped on every entry (e.g. cluster name from
+# --cluster-name, matching the reference's zap base fields)
+_base_fields: dict = {}
 
 
-def configure(level: str = "info", stream=None) -> None:
+def configure(level: str = "info", stream=None, **base_fields) -> None:
     """Install the JSON handler on the karpenter root logger (idempotent;
     repeat calls adjust the level, and replace the stream only when one is
-    explicitly given — so a harness-configured sink survives startup)."""
+    explicitly given — so a harness-configured sink survives startup).
+    Keyword base_fields are stamped on every subsequent entry; each
+    configure() call replaces the full set (omitting them clears)."""
     global _configured
+    _base_fields.clear()
+    _base_fields.update(base_fields)
     root = logging.getLogger(_ROOT)
     root.setLevel(_LEVELS.get(level.lower(), logging.INFO))
     if stream is None and _configured and root.handlers:

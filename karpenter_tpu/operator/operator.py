@@ -48,6 +48,7 @@ from karpenter_tpu.controllers.nodepool_controllers import (
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.events.recorder import Recorder
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.operator.leaderelection import LeaderElector
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.runtime.store import DELETED, Store
 from karpenter_tpu.state.cluster import Cluster
@@ -68,6 +69,12 @@ class Operator:
         self.clock = clock or Clock()
         self.store = store
         self.options = options or Options()
+        # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
+        # here it bounds the solver's interning/memo caches. Called
+        # unconditionally so the unlimited default restores full caps.
+        from karpenter_tpu.ops.ffd import set_memory_budget
+
+        set_memory_budget(self.options.memory_limit)
         if self.options.feature_gates.node_overlay:
             from karpenter_tpu.cloudprovider.overlay import OverlayedCloudProvider
 
@@ -144,6 +151,14 @@ class Operator:
         self._dispatch_watch = store.watch(
             ["Pod", "Node", "NodeClaim", "NodePool"]
         )
+        # identity prefix = --karpenter-service, the name identifying this
+        # deployment (the reference uses it the same way for its lock id)
+        self.elector = LeaderElector(
+            store,
+            self.clock,
+            identity_prefix=self.options.service_name or "karpenter",
+            enabled=not self.options.disable_leader_election,
+        )
 
     # -- the loop -----------------------------------------------------------
 
@@ -151,7 +166,27 @@ class Operator:
         """One cooperative pass: ingest watches, dispatch object events,
         tick singletons. Controllers re-emit store writes which the next
         pass ingests — level-triggered, idempotent, resumable (SURVEY.md §5
-        'Checkpoint / resume')."""
+        'Checkpoint / resume'). Only the leader writes: a standby replica
+        keeps its informer warm and otherwise no-ops until the incumbent's
+        lease goes stale (reference operator.go:144-151)."""
+        if not self.elector.try_acquire_or_renew():
+            self._was_leader = False
+            self.informer.flush()
+            # keep local metric series hygiene; dropped events are replayed
+            # by the full resync on the first leader pass
+            for event in self._dispatch_watch.drain():
+                if event.kind == "Pod" and event.type == DELETED:
+                    self.pod_metrics.on_delete(
+                        event.obj.metadata.namespace, event.obj.metadata.name
+                    )
+            return
+        if not getattr(self, "_was_leader", False):
+            # just took over (or first pass): events dropped while standing
+            # by are gone, and several controllers are event-driven only —
+            # reconcile everything once, like the reference's informer
+            # resync on leader start
+            self._was_leader = True
+            self._resync()
         self.informer.flush()
         self._dispatch()
         # kwok fake kubelet fabricates due nodes before controllers run
@@ -199,6 +234,28 @@ class Operator:
         for _ in range(passes):
             self.run_once()
 
+    def _resync(self) -> None:
+        """Reconcile every object whose controllers are event-driven only —
+        run on leadership acquisition, when watch events may have been
+        dropped while standing by."""
+        self.informer.flush()
+        for pool in self.store.list("NodePool"):
+            self.np_hash.reconcile(pool)
+            self.np_validation.reconcile(pool)
+            self.np_readiness.reconcile(pool)
+            self.np_registration_health.reconcile(pool)
+            self.np_counter.reconcile(pool)
+        for node in self.store.list("Node"):
+            if node.metadata.deletion_timestamp is None:
+                self.health.reconcile(node)
+                self.hydration.reconcile_node(node)
+        for claim in self.store.list("NodeClaim"):
+            self.consistency.reconcile(claim)
+            self.hydration.reconcile_claim(claim)
+        # podevents deliberately NOT resynced: stamping lastPodEventTime
+        # for every existing pod would reset consolidateAfter windows; a
+        # missed event only delays consolidation, which is the safe side.
+
     def _dispatch(self) -> None:
         for event in self._dispatch_watch.drain():
             obj = event.obj
@@ -245,6 +302,11 @@ class Operator:
                 self.np_readiness.reconcile(live)
                 self.np_registration_health.reconcile(live)
                 self.np_counter.reconcile(live)
+
+    def shutdown(self) -> None:
+        """Clean shutdown: release the leader lease so a standby replica
+        takes over immediately instead of waiting out the lease duration."""
+        self.elector.release()
 
     # -- observability ------------------------------------------------------
 

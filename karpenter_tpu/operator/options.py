@@ -47,11 +47,9 @@ class Options:
     service_name: str = ""
     metrics_port: int = 8080
     health_probe_port: int = 8081
-    kube_client_qps: float = 200.0
-    kube_client_burst: int = 300
     enable_profiling: bool = False
     disable_leader_election: bool = False
-    memory_limit: int = -1
+    memory_limit: int = -1  # MiB; bounds solver caches (ops/ffd.py)
     log_level: str = "info"
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
@@ -75,8 +73,17 @@ class Options:
         parser.add_argument("--karpenter-service", dest="service_name")
         parser.add_argument("--metrics-port", type=int)
         parser.add_argument("--health-probe-port", type=int)
-        parser.add_argument("--kube-client-qps", type=float)
-        parser.add_argument("--kube-client-burst", type=int)
+        # accepted-and-ignored for drop-in CLI compatibility: the reference
+        # throttles its rest.Config with these (options.go:73-74); this
+        # build's store is in-process, so there is no client to throttle
+        parser.add_argument(
+            "--kube-client-qps", type=float, dest="_ignored_qps",
+            help="ignored (no kube client in this build)",
+        )
+        parser.add_argument(
+            "--kube-client-burst", type=int, dest="_ignored_burst",
+            help="ignored (no kube client in this build)",
+        )
         parser.add_argument("--enable-profiling", action="store_true", default=None)
         parser.add_argument("--disable-leader-election", action="store_true", default=None)
         parser.add_argument("--memory-limit", type=int)
@@ -96,8 +103,6 @@ class Options:
             "service_name": "KARPENTER_SERVICE",
             "metrics_port": "METRICS_PORT",
             "health_probe_port": "HEALTH_PROBE_PORT",
-            "kube_client_qps": "KUBE_CLIENT_QPS",
-            "kube_client_burst": "KUBE_CLIENT_BURST",
             "log_level": "LOG_LEVEL",
             "batch_max_duration": "BATCH_MAX_DURATION",
             "batch_idle_duration": "BATCH_IDLE_DURATION",

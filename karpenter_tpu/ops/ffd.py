@@ -108,6 +108,29 @@ _placeholder_counter = itertools.count(1)
 _SIG_IDS: dict[tuple, int] = {}
 _SIG_NEXT = itertools.count()
 _SIG_CAP = 200_000
+# engine-shared cross-solve caches (joint requirement masks, family
+# transitions) share one cap; see set_memory_budget
+_ENGINE_CACHE_CAP = 100_000
+
+
+def set_memory_budget(limit_mib: int) -> None:
+    """Bound the solver's unbounded-by-default caches to a memory budget.
+
+    The reference wires --memory-limit into GOMEMLIMIT at 90%
+    (pkg/operator/operator.go:115-118) so the GC keeps the process under
+    its cgroup. Python has no GC ceiling; the operator's only unbounded
+    memory consumers are these interning/memo caches, so the budget
+    scales their clear-at caps instead. Sizing: a signature tuple runs
+    ~300B, a joint-mask entry ~1KiB — defaults (200k/100k) assume ~160MiB
+    of cache headroom; the caps scale linearly below that and never rise
+    above the defaults."""
+    global _SIG_CAP, _ENGINE_CACHE_CAP
+    if limit_mib is None or limit_mib <= 0:
+        _SIG_CAP, _ENGINE_CACHE_CAP = 200_000, 100_000
+        return
+    scale = min(1.0, limit_mib / 160.0)
+    _SIG_CAP = max(1_000, int(200_000 * scale))
+    _ENGINE_CACHE_CAP = max(1_000, int(100_000 * scale))
 
 
 # -- eligibility -------------------------------------------------------------
@@ -689,7 +712,7 @@ class _DeviceSolve:
         # Shared on the ENGINE across solves: steady-state provisioner
         # passes re-derive identical joints, and masks are pure content
         # functions (rows are interned per engine). Bounded below.
-        if len(e.solver_joint_cache) > 100_000:
+        if len(e.solver_joint_cache) > _ENGINE_CACHE_CAP:
             e.solver_joint_cache.clear()
         self.joint_cache = e.solver_joint_cache
         # requirement-set families: frozenset(row ids) -> id, plus the
@@ -1332,7 +1355,7 @@ class _DeviceSolve:
                     # is re-added by the consumers that need it. Shared
                     # read-only across solves — callers copy.
                     cached = (self._NARROW, rows, self._sans_hostname(joint))
-            if len(self.engine.solver_fam_trans) > 100_000:
+            if len(self.engine.solver_fam_trans) > _ENGINE_CACHE_CAP:
                 self.engine.solver_fam_trans.clear()
             self.engine.solver_fam_trans[ckey] = cached
         kind, rows, joint = cached

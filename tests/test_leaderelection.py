@@ -1,0 +1,179 @@
+"""Store-backed leader election (reference pkg/operator/operator.go:144-151):
+two operator replicas sharing one store must not both provision; failover
+happens when the incumbent's lease goes stale or is released."""
+
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.leaderelection import (
+    LEASE_DURATION,
+    LEASE_NAME,
+    LeaderElector,
+)
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+
+def _env():
+    clock = FakeClock()
+    store = Store(clock=clock)
+    return clock, store
+
+
+class TestLeaderElector:
+    def test_first_acquires_second_defers(self):
+        clock, store = _env()
+        a = LeaderElector(store, clock, identity="a")
+        b = LeaderElector(store, clock, identity="b")
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        assert a.is_leader() and not b.is_leader()
+        lease = store.get("Lease", LEASE_NAME)
+        assert lease.spec.holder_identity == "a"
+
+    def test_renewal_keeps_leadership(self):
+        clock, store = _env()
+        a = LeaderElector(store, clock, identity="a")
+        b = LeaderElector(store, clock, identity="b")
+        a.try_acquire_or_renew()
+        for _ in range(10):
+            clock.step(LEASE_DURATION / 2)
+            assert a.try_acquire_or_renew() is True
+            assert b.try_acquire_or_renew() is False
+
+    def test_stale_lease_taken_over(self):
+        clock, store = _env()
+        a = LeaderElector(store, clock, identity="a")
+        b = LeaderElector(store, clock, identity="b")
+        a.try_acquire_or_renew()
+        clock.step(LEASE_DURATION + 0.1)  # a stops renewing
+        assert b.try_acquire_or_renew() is True
+        assert store.get("Lease", LEASE_NAME).spec.holder_identity == "b"
+        # a comes back: it must observe it lost
+        assert a.try_acquire_or_renew() is False
+        assert not a.is_leader()
+
+    def test_release_hands_over_immediately(self):
+        clock, store = _env()
+        a = LeaderElector(store, clock, identity="a")
+        b = LeaderElector(store, clock, identity="b")
+        a.try_acquire_or_renew()
+        assert b.try_acquire_or_renew() is False
+        a.release()
+        # no lease-duration wait needed after a clean release
+        assert b.try_acquire_or_renew() is True
+
+    def test_disabled_always_leads_without_lease(self):
+        clock, store = _env()
+        a = LeaderElector(store, clock, identity="a", enabled=False)
+        b = LeaderElector(store, clock, identity="b", enabled=False)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is True
+        assert store.try_get("Lease", LEASE_NAME) is None
+
+
+class TestOperatorHA:
+    def _two_operators(self, disable=False):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = KwokCloudProvider(store, clock)
+        opts = Options(disable_leader_election=disable)
+        op1 = Operator(store, provider, clock=clock, options=opts)
+        op2 = Operator(store, provider, clock=clock, options=opts)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        return clock, store, op1, op2
+
+    def test_exactly_one_replica_provisions(self):
+        """Both replicas tick against one store; only the leader writes —
+        the pod lands on exactly one claim instead of two."""
+        clock, store, op1, op2 = self._two_operators()
+        for _ in range(10):
+            clock.step(2.0)
+            op1.run_once()
+            op2.run_once()
+        assert op1.elector.is_leader() and not op2.elector.is_leader()
+        claims = store.list("NodeClaim")
+        assert len(claims) == 1
+        pod = store.list("Pod")[0]
+        assert pod.spec.node_name, "leader must finish the provisioning flow"
+
+    def test_failover_after_lease_expiry(self):
+        """The incumbent stops ticking; the standby takes over once the
+        lease goes stale and finishes outstanding work."""
+        clock, store, op1, op2 = self._two_operators()
+        clock.step(2.0)
+        op1.run_once()
+        op2.run_once()
+        assert op1.elector.is_leader() and not op2.elector.is_leader()
+        # op1 crashes (stops renewing); op2 keeps ticking
+        clock.step(LEASE_DURATION + 0.1)
+        for _ in range(10):
+            clock.step(2.0)
+            op2.run_once()
+        assert op2.elector.is_leader()
+        claims = store.list("NodeClaim")
+        assert len(claims) == 1
+        assert claims[0].condition_is_true("Initialized")
+
+    def test_failover_resyncs_dropped_events(self):
+        """Watch events the standby drained-and-dropped must be re-derived
+        on its first leader pass: a NodePool spec change made while standing
+        by still gets its hash annotation updated after takeover."""
+        from karpenter_tpu.apis import labels as wk
+
+        clock, store, op1, op2 = self._two_operators()
+        clock.step(2.0)
+        op1.run_once()
+        op2.run_once()
+        pool = store.get("NodePool", "workers")
+        old_hash = pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY]
+        # spec change while op2 stands by: op2 drains+drops the event
+        pool.spec.template.spec.expire_after = 12345.0
+        store.update(pool)
+        clock.step(2.0)
+        op2.run_once()
+        # op1 crashes; op2 takes over after lease expiry
+        clock.step(LEASE_DURATION + 0.1)
+        op2.run_once()
+        assert op2.elector.is_leader()
+        new_hash = store.get("NodePool", "workers").metadata.annotations[
+            wk.NODEPOOL_HASH_ANNOTATION_KEY
+        ]
+        assert new_hash != old_hash, "resync must re-reconcile the NodePool"
+
+    def test_clean_shutdown_fails_over_without_wait(self):
+        clock, store, op1, op2 = self._two_operators()
+        clock.step(2.0)
+        op1.run_once()
+        op2.run_once()
+        op1.shutdown()
+        clock.step(2.0)  # far less than LEASE_DURATION
+        op2.run_once()
+        assert op2.elector.is_leader()
+
+    def test_disabled_both_run(self):
+        """--disable-leader-election: both replicas run their loops (and
+        demonstrably double-provision — the hazard the lease prevents)."""
+        clock, store, op1, op2 = self._two_operators(disable=True)
+        for _ in range(3):
+            clock.step(2.0)
+            op1.run_once()
+            op2.run_once()
+        assert op1.elector.is_leader() and op2.elector.is_leader()
+        assert store.try_get("Lease", LEASE_NAME) is None
+        assert len(store.list("NodeClaim")) >= 1
+
+    def test_master_status_metric_exposed(self):
+        clock, store, op1, op2 = self._two_operators()
+        clock.step(2.0)
+        op1.run_once()
+        op2.run_once()
+        text = op1.metrics_text()
+        assert "leader_election_master_status" in text
+        from karpenter_tpu.operator.leaderelection import _MASTER_STATUS
+
+        assert _MASTER_STATUS.value({"name": op1.elector.identity}) == 1.0
+        assert _MASTER_STATUS.value({"name": op2.elector.identity}) == 0.0
