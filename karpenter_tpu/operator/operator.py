@@ -70,11 +70,15 @@ class Operator:
         self.store = store
         self.options = options or Options()
         # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
-        # here it bounds the solver's interning/memo caches. Called
-        # unconditionally so the unlimited default restores full caps.
-        from karpenter_tpu.ops.ffd import set_memory_budget
+        # here it bounds the solver's interning/memo caches. The caps are
+        # process-global, so only an EXPLICIT setting mutates them: -1 (the
+        # unset default) touches nothing — a second Operator constructed
+        # with defaults (tests, HA standbys) must not clobber a configured
+        # budget — while 0 explicitly restores the unbounded defaults.
+        if self.options.memory_limit >= 0:
+            from karpenter_tpu.ops.ffd import set_memory_budget
 
-        set_memory_budget(self.options.memory_limit)
+            set_memory_budget(self.options.memory_limit)
         if self.options.feature_gates.node_overlay:
             from karpenter_tpu.cloudprovider.overlay import OverlayedCloudProvider
 

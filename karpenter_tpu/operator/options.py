@@ -49,7 +49,9 @@ class Options:
     health_probe_port: int = 8081
     enable_profiling: bool = False
     disable_leader_election: bool = False
-    memory_limit: int = -1  # MiB; bounds solver caches (ops/ffd.py)
+    # MiB; bounds solver caches (ops/ffd.py). -1 = unset (leave the
+    # process-global caps untouched); 0 = explicitly unbounded
+    memory_limit: int = -1
     log_level: str = "info"
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0

@@ -31,14 +31,14 @@ reproducing the host loop's decisions bit-for-bit:
    matrices — exact, no device round-trip on the sequential path.
 
 Eligibility is checked first (`eligible`). Every scheduling construct runs
-on the device path: topology, host ports, volumes, hostname pins, strict
-minValues (per-join diversity gate), reserved capacity in BOTH offering
-modes (fallback bookkeeping per join; strict's scan-aborting errors on the
+on the device path: topology, host ports, volumes, hostname pins, minValues
+in BOTH policies (Strict's per-join diversity gate; BestEffort's open-time
+relaxation into per-claim specs), reserved capacity in BOTH offering modes
+(fallback bookkeeping per join; strict's scan-aborting errors on the
 all-volatile topo driver), and PreferNoSchedule relaxation. The host loop
-remains the semantics oracle; the one metered decline left is BestEffort
-minValues relaxation (it mutates requirement rows mid-solve).
-Topology-engaged, host-port/volume, hostname, PreferNoSchedule, and
-strict-reserved solves run the topo-aware driver (ops/ffd_topo.py).
+remains the semantics oracle. Topology-engaged, host-port/volume, hostname,
+PreferNoSchedule, and strict-reserved solves run the topo-aware driver
+(ops/ffd_topo.py).
 """
 
 from __future__ import annotations
@@ -170,16 +170,12 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
             scheduler.engine._kt_has_reserved = has_reserved
     dims = scheduler.engine.resource_dims
     for nct in scheduler.nodeclaim_templates:
-        if nct.requirements.has_min_values():
-            # Strict policy is fully supported (monotone: narrowing only
-            # shrinks the distinct-value count, so rejections are permanent).
-            # BestEffort relaxation MUTATES requirement rows mid-solve
-            # (nodeclaim.go:425-436 minValues write-back), which would
-            # corrupt the interned family rows — host path.
-            from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_STRICT
-
-            if scheduler.min_values_policy != MIN_VALUES_POLICY_STRICT:
-                return False
+        # minValues is fully supported in BOTH policies. Strict: monotone
+        # (narrowing only shrinks the distinct-value count, so rejections
+        # are permanent). BestEffort: relaxation happens once per claim at
+        # OPEN (nodeclaim.go:425-436) into per-claim specs — interned family
+        # rows are never mutated, and joins gate on the relaxed values just
+        # like the host's max-merged claim requirements.
         # hostname-constrained templates would break family sharing (the
         # canonical family Requirements are hostname-free)
         if nct.requirements.has(wk.LABEL_HOSTNAME):
@@ -369,6 +365,7 @@ class _Claim:
     __slots__ = (
         "ti", "fam", "hostname", "type_mask", "u_ids", "rem", "count", "rank",
         "members", "group_counts", "gdrop", "gknown", "reserved",
+        "min_specs", "min_relaxed",
     )
 
     def __init__(self, ti, fam, hostname, type_mask, u_ids, rem, rank):
@@ -390,6 +387,13 @@ class _Claim:
         # reserved offerings currently held (nodeclaim.go:166-205), refreshed
         # on every successful join like the host's can_add→Add cycle
         self.reserved: list = []
+        # minValues specs governing this claim's joins. Strict: the
+        # template's. BestEffort: relaxed AT OPEN to the achievable distinct
+        # count (nodeclaim.go:425-436) — fixed thereafter, exactly like the
+        # host claim whose relaxed requirement min_values max-merge through
+        # every later join.
+        self.min_specs: list[tuple[str, int]] = []
+        self.min_relaxed = False
 
 
 class _Node:
@@ -726,13 +730,26 @@ class _DeviceSolve:
             name: dict(rl) for name, rl in scheduler.remaining_resources.items()
         }
         self.limits_version = 0
+        # per-pool limit-tracking versions: bumped by _subtract_max so the
+        # limits mask and claim-opening caches invalidate only for the pool
+        # whose remaining budget actually moved (8-pool solves would
+        # otherwise recompute every open from scratch)
+        self.pool_limits_ver: dict[str, int] = {}
+        self._limits_mask_cache: dict[str, tuple[int, np.ndarray]] = {}
+        # (ti, pool_ver) -> True (types remain) | the cached exhaustion error
+        self._limits_any: dict[tuple[int, int], object] = {}
+        # (ti, gi, id(limits_mask)) ->
+        # (candidate, row_sel, u_ids, min_specs, min_relaxed, min_msg, mask ref)
+        self._limited_open_cache: dict[tuple, tuple] = {}
         # per-group state
         self.gheaps: list[list] = []
         self.gsynced: list[int] = []
         self.nptr: list[int] = []
         self.gnewclaim_err: dict[int, tuple[int, Exception]] = {}
-        # (ti, gi) -> memoized claim-opening data, valid while no nodepool
-        # limits are in play (fam, candidate, u_ids, rem0) or (-1,...) = error
+        # (ti, gi) -> memoized LIMITLESS claim-opening data
+        # (fam, candidate0, u_ids0, rem0_fit0, min_specs, min_relaxed) or
+        # (-1,...) = permanent error; active nodepool limits are applied per
+        # open as a type-mask AND over the cached entry (_new_claim)
         self.open_cache: dict[tuple[int, int], tuple] = {}
         self._open_errs: dict[tuple[int, int], Exception] = {}
         # per-(template, group) static caches
@@ -747,6 +764,9 @@ class _DeviceSolve:
         # min_active is set for real in _prepare_templates; abort() may run
         # before that (e.g. an ineligible shape found during grouping)
         self.min_active = False
+        from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_STRICT
+
+        self.best_effort = scheduler.min_values_policy != MIN_VALUES_POLICY_STRICT
         self._saved_rm: Optional[tuple] = None
         # reserved-capacity flags are needed during grouping already (strict
         # mode makes every shape volatile on the topo driver)
@@ -1382,14 +1402,22 @@ class _DeviceSolve:
             remaining = self.remaining_resources.get(nct.nodepool_name)
             limits_mask = None
             if remaining:
-                limits_mask = self._limits_mask(remaining)
-                if not (limits_mask & self.tmpl_mask[ti]).any():
-                    errs.append(
-                        ValueError(
+                limits_mask = self._limits_mask(nct.nodepool_name, remaining)
+                # exhaustion check cached per (template, pool version): an
+                # exhausted pool costs one dict hit per scan, not an array
+                # reduction + fresh exception
+                akey = (ti, self.pool_limits_ver.get(nct.nodepool_name, 0))
+                hit = self._limits_any.get(akey)
+                if hit is None:
+                    hit = self._limits_any[akey] = (
+                        bool((limits_mask & self.tmpl_mask[ti]).any())
+                        or ValueError(
                             f"all available instance types exceed limits for "
                             f"nodepool {nct.nodepool_name!r}"
                         )
                     )
+                if hit is not True:
+                    errs.append(hit)
                     continue
             tol = self.tg_tol.get((ti, gi))
             if tol is None:
@@ -1414,54 +1442,108 @@ class _DeviceSolve:
                     )
                 )
                 continue
-            # without limits in play, every opening for (ti, gi) computes the
-            # same candidate set / headroom matrix — memoize it
-            cached_open = (
-                self.open_cache.get((ti, gi)) if limits_mask is None else None
-            )
-            if cached_open is not None:
-                fam, candidate, u_ids, rem0_fit = cached_open
-                if fam < 0:
-                    errs.append(self._open_errs[(ti, gi)])
-                    continue
+            # Memoized LIMITLESS opening per (ti, gi): candidate set, fitting
+            # unique-alloc rows, headroom matrix, and the no-limits minValues
+            # outcome. Limits are applied per open as a cheap type-mask AND —
+            # narrowing types never changes a surviving row's headroom, so
+            # the limited open is a row-subset of the limitless one.
+            okey = (ti, gi)
+            entry = self.open_cache.get(okey)
+            if entry is None:
+                joint_tg, rows = tg
+                compat_v, offer_v = self._joint_masks(rows, joint_tg)
+                base = self.tmpl_mask[ti]
+                candidate0 = base & compat_v & offer_v
+                cand_u = np.unique(self.uid_of_type[candidate0])
+                rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
+                fitrows = (rem0 >= -_EPS).all(axis=1)
+                if not fitrows.any():
+                    # no limits will ever fix an empty limitless set
+                    err = self._filter_error(base, compat_v, offer_v, ti, g)
+                    self.open_cache[okey] = entry = (-1, None, None, None, None, False)
+                    self._open_errs[okey] = err
+                else:
+                    min_specs0, min_relaxed0, msg = self.tmpl_min[ti], False, None
+                    if self.min_active and self.tmpl_min[ti]:
+                        surv_u = np.zeros(self.U, dtype=bool)
+                        surv_u[cand_u[fitrows]] = True
+                        min_specs0, min_relaxed0, msg = self._min_open(
+                            ti, candidate0 & surv_u[self.uid_of_type]
+                        )
+                    if msg is not None:
+                        # strict-policy failure on the FULL set is permanent
+                        err = self._filter_error(base, compat_v, offer_v, ti, g)
+                        err.min_values_incompatible = msg
+                        self.open_cache[okey] = entry = (
+                            -1, None, None, None, None, False,
+                        )
+                        self._open_errs[okey] = err
+                    else:
+                        fam = self._intern_fam(rows, joint_tg)
+                        self.open_cache[okey] = entry = (
+                            fam, candidate0, cand_u[fitrows], rem0[fitrows],
+                            min_specs0, min_relaxed0,
+                        )
+            fam, candidate0, u_ids0, rem0_fit0, min_specs, min_relaxed = entry
+            if fam < 0:
+                if limits_mask is None:
+                    errs.append(self._open_errs[okey])
+                else:
+                    # host diagnostics are over the LIMITED base; a limited
+                    # set is a subset of the failed limitless one, so it
+                    # still fails — recompute only the message bits
+                    errs.append(self._limited_open_error(ti, gi, g, limits_mask))
+                continue
+            if limits_mask is None:
                 self._open_claim(
-                    ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy(), reusable=True
+                    ti, fam, pod, gi, candidate0, u_ids0, rem0_fit0.copy(),
+                    reusable=True, min_specs=min_specs, min_relaxed=min_relaxed,
                 )
                 return None
-            joint_tg, rows = tg
-            compat_v, offer_v = self._joint_masks(rows, joint_tg)
-            base = self.tmpl_mask[ti]
-            if limits_mask is not None:
-                base = base & limits_mask
-            candidate = base & compat_v & offer_v
-            cand_u = np.unique(self.uid_of_type[candidate])
-            rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
-            fitrows = (rem0 >= -_EPS).all(axis=1)
-            if not fitrows.any():
-                err = self._filter_error(base, compat_v, offer_v, ti, g)
-                if limits_mask is None:
-                    self.open_cache[(ti, gi)] = (-1, None, None, None)
-                    self._open_errs[(ti, gi)] = err
+            # derived limited opening, cached per (entry, mask identity):
+            # the mask object is stable while the pool's budget stays
+            # within one capacity threshold (see _limits_mask), so most
+            # opens of a limited pool reuse one derived set — and the
+            # arrays stay alive here, keeping native packings id-safe
+            dkey = (ti, gi, id(limits_mask))
+            derived = self._limited_open_cache.get(dkey)
+            if derived is None:
+                candidate = candidate0 & limits_mask
+                live = np.zeros(self.U, dtype=bool)
+                live[self.uid_of_type[candidate]] = True
+                sel = live[u_ids0]
+                u_ids = u_ids0[sel]
+                # the minValues gate is fully determined by the derived set —
+                # evaluate once per dkey, not per open
+                mspecs, mrelax, mmsg = min_specs, min_relaxed, None
+                if u_ids.size and self.min_active and self.tmpl_min[ti]:
+                    surv_u = np.zeros(self.U, dtype=bool)
+                    surv_u[u_ids] = True
+                    mspecs, mrelax, mmsg = self._min_open(
+                        ti, candidate & surv_u[self.uid_of_type]
+                    )
+                derived = (candidate, sel, u_ids, mspecs, mrelax, mmsg, limits_mask)
+                self._limited_open_cache[dkey] = derived
+            candidate, sel, u_ids, min_specs, min_relaxed, min_msg, _alive = derived
+            if u_ids.size == 0:
+                # limited set empty: recompute the host's exact diagnostics
+                joint_tg, rows = tg
+                compat_v, offer_v = self._joint_masks(rows, joint_tg)
+                errs.append(
+                    self._filter_error(
+                        self.tmpl_mask[ti] & limits_mask, compat_v, offer_v, ti, g
+                    )
+                )
+                continue
+            if min_msg is not None:
+                joint_tg, rows = tg
+                compat_v, offer_v = self._joint_masks(rows, joint_tg)
+                err = self._filter_error(
+                    self.tmpl_mask[ti] & limits_mask, compat_v, offer_v, ti, g
+                )
+                err.min_values_incompatible = min_msg
                 errs.append(err)
                 continue
-            if self.min_active and self.tmpl_min[ti]:
-                surv_u = np.zeros(self.U, dtype=bool)
-                surv_u[cand_u[fitrows]] = True
-                msg = self._min_fail(ti, candidate & surv_u[self.uid_of_type])
-                if msg is not None:
-                    err = self._filter_error(base, compat_v, offer_v, ti, g)
-                    err.min_values_incompatible = msg
-                    if limits_mask is None:
-                        self.open_cache[(ti, gi)] = (-1, None, None, None)
-                        self._open_errs[(ti, gi)] = err
-                    errs.append(err)
-                    continue
-            # success: open the claim
-            fam = self._intern_fam(rows, joint_tg)
-            u_ids = cand_u[fitrows]
-            rem0_fit = rem0[fitrows]
-            if limits_mask is None:
-                self.open_cache[(ti, gi)] = (fam, candidate, u_ids, rem0_fit)
             self._open_claim(
                 ti,
                 fam,
@@ -1469,8 +1551,10 @@ class _DeviceSolve:
                 gi,
                 candidate,
                 u_ids,
-                rem0_fit.copy(),
-                reusable=limits_mask is None,
+                rem0_fit0[sel].copy(),
+                reusable=True,
+                min_specs=min_specs,
+                min_relaxed=min_relaxed,
             )
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[u_ids] = True
@@ -1497,6 +1581,8 @@ class _DeviceSolve:
         rem: np.ndarray,
         reusable: bool = False,
         hostname: Optional[str] = None,
+        min_specs: Optional[list] = None,
+        min_relaxed: bool = False,
     ) -> None:
         """Register a freshly opened claim with the active driver (Python
         loop or native kernel); the opening pod is its first member.
@@ -1514,6 +1600,8 @@ class _DeviceSolve:
             return
         self.seq += 1
         c = _Claim(ti, fam, hostname, candidate, u_ids, rem, self.seq)
+        c.min_specs = self.tmpl_min[ti] if min_specs is None else min_specs
+        c.min_relaxed = min_relaxed
         c.count = 1
         c.members.append(pod)
         c.group_counts[gi] = 1
@@ -1524,9 +1612,37 @@ class _DeviceSolve:
             self._apply_reserved(c, self._pending_reserved)
             self._pending_reserved = None
 
-    def _limits_mask(self, remaining: dict) -> np.ndarray:
+    def _limited_open_error(
+        self, ti: int, gi: int, g: _Group, limits_mask: np.ndarray
+    ) -> Exception:
+        """Host-identical opening failure over the LIMITS-NARROWED base —
+        the slow path for the rare template whose limitless opening already
+        failed (the limited subset fails too; only the diagnostic bits can
+        differ)."""
+        joint_tg, rows = self._tg(ti, gi)
+        compat_v, offer_v = self._joint_masks(rows, joint_tg)
+        base = self.tmpl_mask[ti] & limits_mask
+        candidate = base & compat_v & offer_v
+        cand_u = np.unique(self.uid_of_type[candidate])
+        rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
+        fitrows = (rem0 >= -_EPS).all(axis=1)
+        err = self._filter_error(base, compat_v, offer_v, ti, g)
+        if fitrows.any() and self.min_active and self.tmpl_min[ti]:
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[cand_u[fitrows]] = True
+            _, _, msg = self._min_open(ti, candidate & surv_u[self.uid_of_type])
+            if msg is not None:
+                err.min_values_incompatible = msg
+        return err
+
+    def _limits_mask(self, pool_name: str, remaining: dict) -> np.ndarray:
         """Types whose CAPACITY fits inside the nodepool's remaining limits
-        (scheduler.go:670-686; _filter_by_remaining_resources)."""
+        (scheduler.go:670-686; _filter_by_remaining_resources). Cached per
+        pool until _subtract_max moves that pool's budget."""
+        ver = self.pool_limits_ver.get(pool_name, 0)
+        hit = self._limits_mask_cache.get(pool_name)
+        if hit is not None and hit[0] == ver:
+            return hit[1]
         mask = np.ones(self.I, dtype=bool)
         for name, limit in remaining.items():
             d = self.dims.get(name)
@@ -1535,6 +1651,12 @@ class _DeviceSolve:
                     mask[:] = False
             else:
                 mask &= self.cap_f[:, d] <= limit + _EPS
+        if hit is not None and np.array_equal(hit[1], mask):
+            # content unchanged (budget moved without crossing a capacity
+            # threshold): keep the OLD array object so identity-keyed
+            # downstream caches (derived opens, native packings) stay hot
+            mask = hit[1]
+        self._limits_mask_cache[pool_name] = (ver, mask)
         return mask
 
     def _subtract_max(self, nct, types_mask: np.ndarray) -> None:
@@ -1552,39 +1674,72 @@ class _DeviceSolve:
             for k, v in remaining.items()
         }
         self.limits_version += 1
+        self.pool_limits_ver[nct.nodepool_name] = (
+            self.pool_limits_ver.get(nct.nodepool_name, 0) + 1
+        )
 
     # -- minValues (nodeclaim.go:425-436, types.go:190-224) ------------------
 
-    def _min_fail(self, ti: int, surv_types: np.ndarray) -> Optional[str]:
-        """The host's strict minValues gate over a surviving-type mask:
-        None when every template minValues key counts enough distinct
-        type-declared values, else the host's error message. The host skips
-        the check entirely when `remaining` is empty (satisfies_min_values
-        returns no error for zero types) — callers only reach here with a
-        non-empty surviving set."""
-        bad = []
-        for key, needed in self.tmpl_min[ti]:
+    def _min_counts(
+        self, specs: list[tuple[str, int]], surv_types: np.ndarray
+    ) -> list[tuple[str, int, int]]:
+        """(key, needed, distinct type-declared value count) per spec over a
+        surviving-type mask (types.go:190-224 counting)."""
+        out = []
+        for key, needed in specs:
             M = self.engine.value_matrix(key)
             count = int(M[:, surv_types].any(axis=1).sum()) if M.size else 0
-            if count < needed:
-                bad.append(key)
+            out.append((key, needed, count))
+        return out
+
+    def _min_fail(
+        self, specs: list[tuple[str, int]], surv_types: np.ndarray
+    ) -> Optional[str]:
+        """The host's strict minValues gate over a surviving-type mask:
+        None when every minValues key counts enough distinct type-declared
+        values, else the host's error message. The host skips the check
+        entirely when `remaining` is empty (satisfies_min_values returns no
+        error for zero types) — callers only reach here with a non-empty
+        surviving set."""
+        bad = [k for k, needed, count in self._min_counts(specs, surv_types)
+               if count < needed]
         if bad:
             from karpenter_tpu.cloudprovider.types import min_values_error
 
             return min_values_error(bad)
         return None
 
+    def _min_open(
+        self, ti: int, surv_types: np.ndarray
+    ) -> tuple[list[tuple[str, int]], bool, Optional[str]]:
+        """MinValues at claim open: (claim specs, relaxed?, error). Strict
+        policy rejects when the count falls short; BestEffort instead writes
+        the spec down to the achievable count (nodeclaim.go:425-436) so the
+        open always succeeds and later joins gate on the relaxed value."""
+        counted = self._min_counts(self.tmpl_min[ti], surv_types)
+        if not self.best_effort:
+            bad = [k for k, needed, count in counted if count < needed]
+            if bad:
+                from karpenter_tpu.cloudprovider.types import min_values_error
+
+                return self.tmpl_min[ti], False, min_values_error(bad)
+            return self.tmpl_min[ti], False, None
+        specs = [(k, min(needed, count)) for k, needed, count in counted]
+        relaxed = any(count < needed for _, needed, count in counted)
+        return specs, relaxed, None
+
     def _min_join_ok(self, c: "_Claim", new_u: np.ndarray, new_mask=None) -> bool:
-        """Would claim c still satisfy its template's minValues after a join
-        that leaves unique-alloc rows `new_u` (and optionally narrows the
-        type mask)? Monotone: once False for a (claim, group) pair it stays
-        False — callers may reject permanently."""
-        if not self.tmpl_min[c.ti]:
+        """Would claim c still satisfy its (possibly open-relaxed) minValues
+        after a join that leaves unique-alloc rows `new_u` (and optionally
+        narrows the type mask)? Monotone: the specs are fixed at open and
+        narrowing only shrinks counts, so once False for a (claim, group)
+        pair it stays False — callers may reject permanently."""
+        if not c.min_specs:
             return True
         mask = c.type_mask if new_mask is None else new_mask
         surv_u = np.zeros(self.U, dtype=bool)
         surv_u[new_u] = True
-        return self._min_fail(c.ti, mask & surv_u[self.uid_of_type]) is None
+        return self._min_fail(c.min_specs, mask & surv_u[self.uid_of_type]) is None
 
     def _filter_error(
         self,
@@ -1717,7 +1872,27 @@ class _DeviceSolve:
                 tmpl_opts[j]
                 for j in np.nonzero(final_types[opt_index_arr[c.ti]])[0]
             ]
-            reqs = Requirements(*self.fam_reqs[c.fam].values())
+            fam_vals = self.fam_reqs[c.fam].values()
+            if c.min_relaxed:
+                # BestEffort wrote the claim's minValues down to the
+                # achievable counts at open (nodeclaim.go:425-436). Family
+                # Requirement objects are shared across claims — substitute
+                # per-claim copies rather than mutating interned rows.
+                # (Substitution, not add(): add() max-merges min_values.)
+                relaxed_vals = dict(c.min_specs)
+                out = []
+                for r in fam_vals:
+                    rv = relaxed_vals.get(r.key)
+                    if (
+                        rv is not None
+                        and r.min_values is not None
+                        and rv < r.min_values
+                    ):
+                        r = _copy.copy(r)
+                        r.min_values = rv
+                    out.append(r)
+                fam_vals = out
+            reqs = Requirements(*fam_vals)
             reqs.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
             requests = dict(s.daemon_overhead[nct])
             for gi, count in c.group_counts.items():
@@ -1744,7 +1919,9 @@ class _DeviceSolve:
                 list(c.members),
                 requests,
             )
-            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] = "false"
+            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] = (
+                "true" if c.min_relaxed else "false"
+            )
             if self.res_active and c.reserved:
                 # reservations were already applied to the shared manager at
                 # join time; finalize_scheduling pins capacity-type +

@@ -925,7 +925,7 @@ class _TopoSolve(_DeviceSolve):
             remaining = self.remaining_resources.get(nct.nodepool_name)
             limits_mask = None
             if remaining:
-                limits_mask = self._limits_mask(remaining)
+                limits_mask = self._limits_mask(nct.nodepool_name, remaining)
                 if not (limits_mask & self.tmpl_mask[ti]).any():
                     errs.append(
                         ValueError(
@@ -1013,8 +1013,9 @@ class _TopoSolve(_DeviceSolve):
                 continue
             u_ids = cand_u[fitrows]
             final = self._final_types(candidate, u_ids)
+            min_specs, min_relaxed = self.tmpl_min[ti], False
             if self.min_active and self.tmpl_min[ti]:
-                msg = self._min_fail(ti, final)
+                min_specs, min_relaxed, msg = self._min_open(ti, final)
                 if msg is not None:
                     err = self._filter_error(base, compat_v, offer_v, ti, g)
                     err.min_values_incompatible = msg
@@ -1035,7 +1036,7 @@ class _TopoSolve(_DeviceSolve):
             fam = self._intern_fam(final_rows, self._sans_hostname(joint))
             self._open_claim(
                 ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),
-                hostname=hostname,
+                hostname=hostname, min_specs=min_specs, min_relaxed=min_relaxed,
             )
             if self._any_ports:
                 hp = s.daemon_hostports[nct].copy()

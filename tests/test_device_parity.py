@@ -122,7 +122,9 @@ APPS = ["app-0", "app-1", "app-2"]
 TIERS = ["gold", "silver", "bronze"]
 
 
-def _random_nodepools(rng: random.Random, topo: bool = False):
+def _random_nodepools(
+    rng: random.Random, topo: bool = False, best_effort: bool = False
+):
     pools = []
     for i in range(rng.randint(1, 3)):
         requirements = []
@@ -148,16 +150,22 @@ def _random_nodepools(rng: random.Random, topo: bool = False):
                     "values": rng.sample(ZONES, rng.randint(1, 2)),
                 }
             )
-        if rng.random() < 0.25:
+        if rng.random() < (0.85 if best_effort else 0.25):
             # strict-policy minValues (device-supported since round 4):
-            # diversity gates reject joins as claims narrow
+            # diversity gates reject joins as claims narrow. BestEffort mode
+            # amps both frequency and magnitude so many opens actually
+            # relax (counts above the catalog's diversity force write-downs)
             requirements.append(
                 {
                     "key": rng.choice(
                         [wk.LABEL_INSTANCE_TYPE, "karpenter.kwok.sh/instance-family"]
                     ),
                     "operator": "Exists",
-                    "minValues": rng.choice([2, 3, 5, 12]),
+                    "minValues": rng.choice(
+                        [2, 3, 5, 12, 20, 150, 500]
+                        if best_effort
+                        else [2, 3, 5, 12]
+                    ),
                 }
             )
         taints = []
@@ -405,19 +413,27 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
 
 
 def build_case(
-    seed: int, topo: bool = False, reserved: bool = False, cluster: bool = False
+    seed: int,
+    topo: bool = False,
+    reserved: bool = False,
+    cluster: bool = False,
+    best_effort: bool = False,
 ):
     """(node_pools, state_nodes, bound_pods, daemonset_pods, build_pods)."""
     rng = random.Random(
         seed + 1_000_000
-        if topo
+        if topo and not best_effort
         else seed + 2_000_000
         if reserved
         else seed + 3_000_000
         if cluster
+        else seed + 4_000_000
+        if best_effort and not topo
+        else seed + 5_000_000
+        if best_effort
         else seed
     )
-    pools = _random_nodepools(rng, topo)
+    pools = _random_nodepools(rng, topo, best_effort)
     nodes = []
     bound = []
     # cluster mode: a steady-state fleet — most pods join EXISTING nodes,
@@ -572,10 +588,14 @@ def decisions(results):
                 tuple(sorted(p.metadata.name for p in nc.pods)),
                 tuple(
                     sorted(
-                        (r.key, tuple(sorted(r.values)), r.complement, r.greater_than, r.less_than)
+                        (
+                            r.key, tuple(sorted(r.values)), r.complement,
+                            r.greater_than, r.less_than, r.min_values,
+                        )
                         for r in nc.requirements
                     )
                 ),
+                nc.annotations.get(wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY),
             )
         )
     claims.sort()
@@ -596,12 +616,17 @@ def run_case(
     reserved: bool = False,
     cluster: bool = False,
     strict: bool = False,
+    best_effort: bool = False,
 ):
     """Returns (host_decisions, device_decisions, device_ran)."""
     reserved = reserved or strict
-    pools, nodes, bound, ds_pods, build_pods = build_case(seed, topo, reserved, cluster)
+    pools, nodes, bound, ds_pods, build_pods = build_case(
+        seed, topo, reserved, cluster, best_effort
+    )
     catalog = reserved_catalog() if reserved else CATALOG
     extra = {"reserved_offering_mode": "Strict"} if strict else {}
+    if best_effort:
+        extra["min_values_policy"] = "BestEffort"
 
     def env(engine):
         import copy
@@ -708,6 +733,24 @@ class TestDeviceParity:
         assert host == dev
         assert ran, "strict-reserved device path unexpectedly fell back"
 
+    @pytest.mark.parametrize("seed", range(20))
+    def test_best_effort_minvalues_decision_parity(self, seed):
+        """BestEffort minValues on the device path: open-time relaxation
+        into per-claim specs (nodeclaim.go:425-436) — relaxed counts,
+        annotations, and every decision must match the host exactly, with
+        no fallback (the last metered decline, retired round 5)."""
+        host, dev, ran = run_case(seed, best_effort=True)
+        assert host == dev
+        assert ran, "BestEffort device path unexpectedly fell back"
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_best_effort_with_topology_decision_parity(self, seed):
+        """BestEffort relaxation on the TOPO driver: volatile joins must
+        gate on the open-relaxed per-claim specs exactly like the host."""
+        host, dev, ran = run_case(seed, topo=True, best_effort=True)
+        assert host == dev
+        assert ran, "BestEffort+topo device path unexpectedly fell back"
+
     @pytest.mark.parametrize("seed", range(15))
     def test_large_existing_cluster_parity(self, seed):
         """Steady-state fleet shape: 24-64 existing nodes with seeded usage;
@@ -730,6 +773,7 @@ def main(
     reserved: bool = False,
     cluster: bool = False,
     strict: bool = False,
+    best_effort: bool = False,
 ) -> int:
     failures = 0
     fallbacks = 0
@@ -738,11 +782,15 @@ def main(
         if strict
         else "reserved+topo"
         if topo and reserved
+        else "besteffort+topo"
+        if topo and best_effort
+        else "besteffort"
+        if best_effort
         else "topo" if topo else "reserved" if reserved else
         "cluster" if cluster else "plain"
     )
     for seed in range(n_cases):
-        host, dev, ran = run_case(seed, topo, reserved, cluster, strict)
+        host, dev, ran = run_case(seed, topo, reserved, cluster, strict, best_effort)
         if host != dev:
             failures += 1
             print(f"{label} seed {seed}: DIVERGED")
@@ -774,4 +822,8 @@ if __name__ == "__main__":
         rc |= main(n, cluster=True)
     if mode in ("strictres", "all"):
         rc |= main(n, strict=True)
+    if mode in ("besteffort", "all"):
+        rc |= main(n, best_effort=True)
+    if mode in ("betopo", "all"):
+        rc |= main(n, topo=True, best_effort=True)
     sys.exit(rc)

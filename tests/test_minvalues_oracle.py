@@ -426,11 +426,12 @@ class TestMinValues:
         [claim] = store.list("NodeClaim")
         assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "light"
 
-    def test_best_effort_policy_falls_back_to_host(self, path):
-        """BestEffort minValues relaxation mutates requirement rows mid-solve
-        (nodeclaim.go:425-436) — the device path declines it by design. A
-        catalog with fewer types than the minimum schedules anyway under
-        BestEffort, with the claim annotated relaxed."""
+    def test_best_effort_policy_relaxes_on_both_paths(self, path):
+        """BestEffort minValues relaxation (nodeclaim.go:425-436) runs on the
+        device path: the open-time write-down lands in per-claim specs, so a
+        catalog with fewer types than the minimum schedules anyway, with the
+        claim annotated relaxed and its requirement recording the achievable
+        count — identically on host and device."""
         from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_BEST_EFFORT
 
         catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
@@ -442,16 +443,10 @@ class TestMinValues:
             "node_pools": pools,
             "min_values_policy": MIN_VALUES_POLICY_BEST_EFFORT,
         }
-        pods = [unschedulable_pod(name="p-0", requests={"cpu": "0.5"})]
         if Env is not HostEnv:
             kwargs["engine"] = CatalogEngine(catalog)
-            from karpenter_tpu.ops import ffd
-
-            f0 = ffd.DEVICE_FALLBACKS
-            results = HostEnv(**kwargs).schedule(pods)
-            assert ffd.DEVICE_FALLBACKS > f0, "BestEffort must decline the device path"
-        else:
-            results = Env(**kwargs).schedule(pods)
+        pods = [unschedulable_pod(name="p-0", requests={"cpu": "0.5"})]
+        results = Env(**kwargs).schedule(pods)
         assert not results.pod_errors
         [nc] = results.new_node_claims
         assert len(nc.instance_type_options) == 2
@@ -460,3 +455,82 @@ class TestMinValues:
         )
         # the relaxed requirement records the achievable count
         assert nc.requirements.get(wk.LABEL_INSTANCE_TYPE).min_values == 2
+
+    def test_best_effort_join_gates_on_relaxed_value(self, path):
+        """After open-time relaxation the claim's joins gate on the RELAXED
+        count: a later pod whose requirements would narrow the claim below
+        the achievable-at-open diversity opens a second claim instead of
+        joining (host can_add passes relax=False on joins)."""
+        from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_BEST_EFFORT
+
+        catalog = [
+            fake_it("instance-type-1", 16, 0.52, arch="arm64"),
+            fake_it("instance-type-2", 16, 1.0, arch="amd64"),
+        ]
+        pools = min_pool(
+            {"key": wk.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": 3}
+        )
+        kwargs = {
+            "catalog": catalog,
+            "node_pools": pools,
+            "min_values_policy": MIN_VALUES_POLICY_BEST_EFFORT,
+        }
+        if Env is not HostEnv:
+            kwargs["engine"] = CatalogEngine(catalog)
+        pods = [
+            unschedulable_pod(name="p-0", requests={"cpu": "1"}),
+            # node-selects arm64: joining p-0's claim would leave 1 < 2
+            unschedulable_pod(
+                name="p-1",
+                requests={"cpu": "0.5"},
+                node_selector={wk.LABEL_ARCH: "arm64"},
+            ),
+        ]
+        results = Env(**kwargs).schedule(pods)
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 2
+        by_pod = {
+            nc.pods[0].metadata.name: nc for nc in results.new_node_claims
+        }
+        # p-0's claim kept both types (relaxed 3 -> 2)
+        assert len(by_pod["p-0"].instance_type_options) == 2
+        assert by_pod["p-0"].requirements.get(wk.LABEL_INSTANCE_TYPE).min_values == 2
+        # p-1's own claim relaxed down to its single compatible type
+        assert len(by_pod["p-1"].instance_type_options) == 1
+        assert by_pod["p-1"].requirements.get(wk.LABEL_INSTANCE_TYPE).min_values == 1
+        for nc in results.new_node_claims:
+            assert (
+                nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY]
+                == "true"
+            )
+
+    def test_best_effort_satisfiable_keeps_strict_semantics(self, path):
+        """When the catalog satisfies minValues, BestEffort must behave
+        exactly like Strict: no relaxation, annotation false, original
+        min_values preserved."""
+        from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_BEST_EFFORT
+
+        catalog = [
+            fake_it("instance-type-1", 1, 0.52),
+            fake_it("instance-type-2", 4, 1.0),
+            fake_it("instance-type-3", 8, 2.0),
+        ]
+        pools = min_pool(
+            {"key": wk.LABEL_INSTANCE_TYPE, "operator": "Exists", "minValues": 3}
+        )
+        kwargs = {
+            "catalog": catalog,
+            "node_pools": pools,
+            "min_values_policy": MIN_VALUES_POLICY_BEST_EFFORT,
+        }
+        if Env is not HostEnv:
+            kwargs["engine"] = CatalogEngine(catalog)
+        pods = [unschedulable_pod(name="p-0", requests={"cpu": "0.5"})]
+        results = Env(**kwargs).schedule(pods)
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert len(nc.instance_type_options) == 3
+        assert (
+            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] == "false"
+        )
+        assert nc.requirements.get(wk.LABEL_INSTANCE_TYPE).min_values == 3

@@ -188,11 +188,17 @@ class TestOptions:
             )
             assert ffd._SIG_CAP == 20_000
             assert ffd._ENGINE_CACHE_CAP == 10_000
-            # constructing an unlimited operator restores the defaults
-            # (no leak of a prior operator's budget into this one)
+            # an operator with the UNSET default must not clobber the
+            # configured budget (HA standbys, test fixtures)
             Operator(
                 Store(clock=clock), FakeCloudProvider(), clock=clock,
                 options=Options.parse([], env={}),
+            )
+            assert ffd._SIG_CAP == 20_000
+            # an EXPLICIT --memory-limit 0 restores the unbounded defaults
+            Operator(
+                Store(clock=clock), FakeCloudProvider(), clock=clock,
+                options=Options.parse(["--memory-limit", "0"], env={}),
             )
             assert ffd._SIG_CAP == 200_000
             assert ffd._ENGINE_CACHE_CAP == 100_000
