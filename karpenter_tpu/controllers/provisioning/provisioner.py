@@ -262,11 +262,7 @@ class Provisioner:
         # NodeOverlay application happens at the provider boundary (operator
         # wraps the provider with OverlayedCloudProvider when the gate is on)
         # so every consumer prices instance types identically
-        instance_types = {}
-        for np in node_pools:
-            its = self.cloud_provider.get_instance_types(np)
-            if its:
-                instance_types[np.metadata.name] = its
+        instance_types = self._gather_instance_types(node_pools)
         for pod in pods:
             self.volume_topology.inject(pod)
         topology = Topology(
@@ -295,6 +291,35 @@ class Provisioner:
             reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             engine=engine,
         )
+
+    def _gather_instance_types(self, node_pools) -> dict:
+        """NodePool name -> instance types, the exact catalog the scheduler
+        sees — shared by new_scheduler and prewarm so the warmed engine's
+        cache key always matches the scheduled engine's."""
+        instance_types = {}
+        for np in node_pools:
+            its = self.cloud_provider.get_instance_types(np)
+            if its:
+                instance_types[np.metadata.name] = its
+        return instance_types
+
+    def prewarm(self) -> None:
+        """Build + warm the solver engine while the operator is idle: the
+        catalog is known as soon as nodepools exist, so the backend-init /
+        encode cold cost (the multi-second part — see CatalogEngine.warmup)
+        is paid before the first batch instead of inside the first
+        scheduling pass. Idempotent and cheap once warm (engines are
+        content-cached; warmup is a flag check)."""
+        if self.engine_factory is None:
+            return
+        instance_types = self._gather_instance_types(
+            nodepoolutil.list_managed(self.store, ready_only=True)
+        )
+        if not instance_types:
+            return
+        engine = self.engine_factory(instance_types)
+        if engine is not None:
+            engine.warmup()
 
     def schedule(self) -> Optional[Results]:
         """provisioner.go:281-383."""

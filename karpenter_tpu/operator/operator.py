@@ -221,6 +221,9 @@ class Operator:
         # stranded once their watch event is consumed.
         if self.overlay_validation is not None:
             self.overlay_validation.reconcile_all()
+        # pay the solver's encode/compile cold cost at idle, not inside the
+        # first batch (no-op once the engine for the current catalog is warm)
+        self.provisioner.prewarm()
         for pending in self.store.list("Pod", predicate=podutil.is_provisionable):
             self.provisioner.trigger(pending.metadata.uid)
         self.provisioner.reconcile()

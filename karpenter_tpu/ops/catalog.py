@@ -446,6 +446,29 @@ class CatalogEngine:
     def host_masks(self, reqs: Requirements) -> tuple[np.ndarray, np.ndarray]:
         return self.masks_for_rows(self.rows_for(reqs), [r.key for r in reqs])
 
+    def warmup(self) -> "CatalogEngine":
+        """Pay the DOMINANT cold costs before the first real batch: jax
+        backend initialization and the device RTT probe (seconds on a real
+        TPU — the bulk of the cold pass), plus the catalog's row/compat
+        bootstrap. Shape-specific kernel compiles are NOT prepaid — jit
+        executables are keyed by the batch's padded cube shape, which is
+        unknowable here — so the first batch still pays a few hundred ms
+        of residual compile; measured split in bench.py. Idempotent."""
+        if getattr(self, "_warmed", False):
+            return self
+        device_rtt_s()  # backend init + RTT probe: the multi-second part
+        probe = Requirements(
+            Requirement(wk.LABEL_OS, Operator.EXISTS),
+            Requirement(wk.LABEL_ARCH, Operator.EXISTS),
+        )
+        rows = self.rows_for(probe)
+        self._ensure_rows()
+        self.feasibility(
+            [rows], np.zeros((1, len(self.resource_dims)), dtype=np.float64)
+        )
+        self._warmed = True
+        return self
+
     def feasibility(
         self,
         row_sets: Sequence[Sequence[int]],

@@ -50,6 +50,29 @@ class TestProvisioner:
         )
         assert 0 < len(it_req["values"]) <= 60
 
+    def test_prewarm_builds_and_warms_engine_before_first_batch(self, env):
+        """The operator loop calls prewarm() at idle: once nodepools exist,
+        the engine for the current catalog is built and warmed so the first
+        batch doesn't pay the encode/compile cold cost (VERDICT r4 #5)."""
+        clock, store, provider, cluster, informer, prov = env
+        if prov.engine_factory is None:
+            pytest.skip("host-only solver configured")
+        store.create(nodepool("default"))
+        informer.flush()
+        prov.prewarm()
+        its = {
+            "default": provider.get_instance_types(store.get("NodePool", "default"))
+        }
+        engine = prov.engine_factory(its)
+        assert engine is not None and getattr(engine, "_warmed", False)
+        # idempotent: second call is a flag check, same engine object
+        prov.prewarm()
+        assert prov.engine_factory(its) is engine
+
+    def test_prewarm_without_nodepools_is_noop(self, env):
+        clock, store, provider, cluster, informer, prov = env
+        prov.prewarm()  # must not raise with an empty store
+
     def test_no_trigger_no_schedule(self, env):
         clock, store, provider, cluster, informer, prov = env
         store.create(nodepool("default"))
