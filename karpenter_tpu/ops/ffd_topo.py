@@ -58,6 +58,7 @@ from karpenter_tpu.ops.ffd import (
     _raw_sig,
 )
 from karpenter_tpu.scheduler import nodeclaim as ncmod
+from karpenter_tpu.scheduler.topology import TYPE_ANTI_AFFINITY
 from karpenter_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
     Operator,
@@ -240,6 +241,10 @@ class _ScanOrder:
 
 # sentinel domain in join/record plans: resolve to the claim's hostname
 _HOSTNAME_DOMAIN = object()
+# hostname ANTI-affinity collapses further: admission is one count lookup
+# (domains[claim.hostname] == 0), no Requirement objects touched at all —
+# the O(pods x claims) probe on anti-affinity-heavy solves
+_HOSTNAME_ANTI = object()
 
 
 class _TopoSolve(_DeviceSolve):
@@ -574,8 +579,6 @@ class _TopoSolve(_DeviceSolve):
     # compiles that once; applying it is a handful of dict increments.
 
     def _build_rec_plan(self, gi: int, ti: int, fam: int) -> tuple:
-        from karpenter_tpu.scheduler.topology import TYPE_ANTI_AFFINITY
-
         reqs = self.fam_reqs[fam]
         taints = self.s.nodeclaim_templates[ti].spec.taints
         entries: list[tuple] = []
@@ -715,7 +718,12 @@ class _TopoSolve(_DeviceSolve):
         for tg in self.g_matched[gi]:
             pod_dom = g.strict_reqs.get(tg.key)
             if tg.key == wk.LABEL_HOSTNAME:
-                plan.append((tg, pod_dom, _HOSTNAME_DOMAIN, None))
+                op = (
+                    _HOSTNAME_ANTI
+                    if tg.type == TYPE_ANTI_AFFINITY
+                    else _HOSTNAME_DOMAIN
+                )
+                plan.append((tg, pod_dom, op, None))
                 continue
             row = reqs.get(tg.key) if reqs.has(tg.key) else None
             if row is None or row.complement or len(row.values) != 1:
@@ -760,6 +768,13 @@ class _TopoSolve(_DeviceSolve):
         tg_tol = self.tg_tol
         fam_join = self.fam_join
         _MISS = self._MISSING
+        # call-local int-keyed memos: the probe loop runs O(pods x claims)
+        # on anti-affinity-heavy solves, and tuple-keyed global dict gets
+        # are its dominant constant — resolve each (ti|fam, gi) once per
+        # call and hit small int-keyed dicts thereafter
+        tol_by_ti: dict = {}
+        ent_by_fam: dict = {}
+        plan_by_fam: dict = {}
         i = 0
         n = len(cis)
         gp = self.g_ports[gi]
@@ -767,10 +782,13 @@ class _TopoSolve(_DeviceSolve):
             ci = cis[i]
             i += 1
             c = claims[ci]
-            tol = tg_tol.get((c.ti, gi))
+            tol = tol_by_ti.get(c.ti)
             if tol is None:
-                tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
-                tg_tol[(c.ti, gi)] = tol
+                tol = tg_tol.get((c.ti, gi))
+                if tol is None:
+                    tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
+                    tg_tol[(c.ti, gi)] = tol
+                tol_by_ti[c.ti] = tol
             if not tol:
                 continue
             # host ports (nodeclaim.go:280-283): conflicts against the
@@ -784,19 +802,32 @@ class _TopoSolve(_DeviceSolve):
             # In[real-node] rows never do)
             if g.has_hostname and not g.reqs.get(wk.LABEL_HOSTNAME).has(c.hostname):
                 continue
-            ent = fam_join.get((c.fam, gi))
+            ent = ent_by_fam.get(c.fam)
             if ent is None:
-                ent = self._build_fam_join(c.fam, gi)
+                ent = fam_join.get((c.fam, gi))
+                if ent is None:
+                    ent = self._build_fam_join(c.fam, gi)
+                ent_by_fam[c.fam] = ent
             if ent[0] == self._REJECT:
                 continue
             if ent[0] == self._SAME:
-                plan = join_plans.get((c.fam, gi), _MISS)
+                plan = plan_by_fam.get(c.fam, _MISS)
                 if plan is _MISS:
-                    plan = self._build_join_plan(c.fam, gi)
+                    plan = join_plans.get((c.fam, gi), _MISS)
+                    if plan is _MISS:
+                        plan = self._build_join_plan(c.fam, gi)
+                    plan_by_fam[c.fam] = plan
                 if plan is not None:
                     ok = True
                     for tg, pod_dom, expected, node_row in plan:
-                        if expected is _HOSTNAME_DOMAIN:
+                        if expected is _HOSTNAME_ANTI:
+                            # the host's anti-affinity hostname gate is
+                            # exactly "no matching pod on this host yet"
+                            # (topologygroup.go:380-387 fast path)
+                            if tg.domains.get(c.hostname, 0) != 0:
+                                ok = False
+                                break
+                        elif expected is _HOSTNAME_DOMAIN:
                             hn = self._hostname_req(ci, c)
                             if not tg.get(pod, pod_dom, hn).has(c.hostname):
                                 ok = False

@@ -173,6 +173,8 @@ class TopologyGroup:
         self.domains: dict[str, int] = {}
         self.empty_domains: set[str] = set()
         self._domain_reqs: dict[str, Requirement] = {}
+        self._anti_reqs: dict[str, Requirement] = {}
+        self._empty_anti: Optional[Requirement] = None
         domain_group.for_each_domain(pod, self.node_filter.taint_policy, self._seed)
 
     def _seed(self, domain: str) -> None:
@@ -269,8 +271,14 @@ class TopologyGroup:
 
         # Hostname fast path: a single-hostname target either satisfies skew
         # or the group forbids the key entirely (topologygroup.go:215-227).
-        if self.key == wk.LABEL_HOSTNAME and len(node_domains.values_list()) == 1:
-            hostname = node_domains.values_list()[0]
+        # Gated on a non-complement row like the reference's Operator==In
+        # check: a single-value NotIn names the EXCLUDED hostname.
+        if (
+            self.key == wk.LABEL_HOSTNAME
+            and not node_domains.complement
+            and len(node_domains.values) == 1
+        ):
+            hostname = next(iter(node_domains.values))
             count = self.domains.get(hostname, 0)
             if self_selecting:
                 count += 1
@@ -326,8 +334,12 @@ class TopologyGroup:
     ) -> Requirement:
         options = Requirement(self.key, Operator.DOES_NOT_EXIST)
 
-        if self.key == wk.LABEL_HOSTNAME and len(node_domains.values_list()) == 1:
-            hostname = node_domains.values_list()[0]
+        if (
+            self.key == wk.LABEL_HOSTNAME
+            and not node_domains.complement
+            and len(node_domains.values) == 1
+        ):
+            hostname = next(iter(node_domains.values))
             if not pod_domains.has(hostname):
                 return options
             if self.domains.get(hostname, 0) > 0:
@@ -378,13 +390,32 @@ class TopologyGroup:
     def _next_domain_anti_affinity(
         self, pod_domains: Requirement, node_domains: Requirement
     ) -> Requirement:
-        options = Requirement(self.key, Operator.DOES_NOT_EXIST)
+        # hostname fast path, allocation-free: this runs once per
+        # (pod, claim) probe — O(pods x claims) on anti-affinity-heavy
+        # solves — so the returned requirements are cached shared objects
+        # (callers never mutate returned requirements, as with
+        # _single_domain) and the sorted values_list() is avoided
+        if (
+            self.key == wk.LABEL_HOSTNAME
+            and not node_domains.complement
+            and len(node_domains.values) == 1
+        ):
+            hostname = next(iter(node_domains.values))
+            if self.domains.get(hostname, 0) != 0:
+                empty = self._empty_anti
+                if empty is None:
+                    empty = self._empty_anti = Requirement(
+                        self.key, Operator.DOES_NOT_EXIST
+                    )
+                return empty
+            req = self._anti_reqs.get(hostname)
+            if req is None:
+                req = Requirement(self.key, Operator.DOES_NOT_EXIST)
+                req.insert(hostname)
+                self._anti_reqs[hostname] = req
+            return req
 
-        if self.key == wk.LABEL_HOSTNAME and len(node_domains.values_list()) == 1:
-            hostname = node_domains.values_list()[0]
-            if self.domains.get(hostname, 0) == 0:
-                options.insert(hostname)
-            return options
+        options = Requirement(self.key, Operator.DOES_NOT_EXIST)
 
         if (
             node_domains.operator == Operator.IN
