@@ -66,6 +66,21 @@ _DURATION_HIST = global_registry.histogram(
 _UNSCHEDULABLE_GAUGE = global_registry.gauge(
     "karpenter_scheduler_unschedulable_pods_count", "pods that failed to schedule"
 )
+# live-solve introspection series (scheduling/metrics.go:47-72): the
+# reference updates them from a 1s ticker goroutine while Solve runs and
+# deletes them at the end; the host loop here refreshes on the same 1s
+# cadence from its injected clock, and both series vanish when the solve
+# finishes so no stale per-solve series outlive it
+_QUEUE_DEPTH = global_registry.gauge(
+    "karpenter_scheduler_queue_depth",
+    "pods currently waiting to be scheduled",
+    labels=["scheduling_id"],
+)
+_UNFINISHED_WORK = global_registry.gauge(
+    "karpenter_scheduler_unfinished_work_seconds",
+    "in-progress solve time not yet observed by scheduling_duration_seconds",
+    labels=["scheduling_id"],
+)
 
 
 @dataclass
@@ -304,11 +319,21 @@ class Scheduler:
     # -- solve (scheduler.go:346-429) ---------------------------------------
 
     def solve(self, pods: Sequence[Pod], timeout: Optional[float] = 60.0) -> Results:
-        with measure(_DURATION_HIST):
-            return self._solve(list(pods), timeout)
+        import uuid as _uuid
 
-    def _solve(self, pods: list[Pod], timeout: Optional[float]) -> Results:
+        sid = {"scheduling_id": _uuid.uuid4().hex[:8]}
+        try:
+            with measure(_DURATION_HIST):
+                return self._solve(list(pods), timeout, sid)
+        finally:
+            # per-solve series never outlive the solve (scheduler.go:391)
+            _QUEUE_DEPTH.delete(sid)
+            _UNFINISHED_WORK.delete(sid)
+
+    def _solve(self, pods: list[Pod], timeout: Optional[float], sid: dict) -> Results:
         pod_errors: dict[Pod, Exception] = {}
+        _QUEUE_DEPTH.set(float(len(pods)), sid)
+        _UNFINISHED_WORK.set(0.0, sid)
         # Device fast path: grouped FFD with the feasibility cube on the TPU
         # (ops/ffd.py). It computes pod data once per distinct pod shape.
         # Returns None when ineligible or when its final verification can't
@@ -325,11 +350,17 @@ class Scheduler:
             self.update_cached_pod_data(p)
         q = Queue(pods, self.cached_pod_data)
         start = self.clock.now()
+        last_tick = start
         timed_out = False
         while True:
             pod = q.pop()
             if pod is None:
                 break
+            now = self.clock.now()
+            if now - last_tick >= 1.0:  # the reference's 1s ticker cadence
+                last_tick = now
+                _QUEUE_DEPTH.set(float(len(q)), sid)
+                _UNFINISHED_WORK.set(now - start, sid)
             if timeout is not None and self.clock.now() - start > timeout:
                 # Surface the truncation: the popped pod and everything left
                 # in the queue were never attempted this round.

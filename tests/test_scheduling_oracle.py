@@ -766,6 +766,52 @@ class TestSchedulerMetrics:
         schedule("host", [unschedulable_pod(requests={"cpu": "9999"})])
         assert _UNSCHEDULABLE_GAUGE.value() == 1.0
 
+    def test_queue_depth_surfaced_while_solving(self, monkeypatch):
+        """suite_test.go 'should surface the queueDepth metric while
+        executing the scheduling loop': the gauge carries the live queue
+        size during the solve and its per-solve series is deleted after."""
+        from karpenter_tpu.scheduler import scheduler as schedmod
+
+        observed = []
+        real_set = schedmod._QUEUE_DEPTH.set
+        monkeypatch.setattr(
+            schedmod._QUEUE_DEPTH, "set",
+            lambda value, labels=None: (observed.append(value), real_set(value, labels)),
+        )
+        schedule("host", [unschedulable_pod() for _ in range(5)])
+        assert observed and observed[0] == 5.0
+        assert schedmod._QUEUE_DEPTH.series() == {}, "per-solve series must not leak"
+
+    def test_unfinished_work_seconds_surfaced_and_cleared(self, monkeypatch):
+        from karpenter_tpu.scheduler import scheduler as schedmod
+
+        observed = []
+        real_set = schedmod._UNFINISHED_WORK.set
+        monkeypatch.setattr(
+            schedmod._UNFINISHED_WORK, "set",
+            lambda value, labels=None: (observed.append(value), real_set(value, labels)),
+        )
+        schedule("host", [unschedulable_pod()])
+        assert observed == [0.0]
+        assert schedmod._UNFINISHED_WORK.series() == {}
+
+    def test_ignored_pods_count_surfaced(self):
+        """provisioning suite 'invalid pvc' spec: pods failing validation
+        count into karpenter_scheduler_ignored_pods_count
+        (provisioner.go:177)."""
+        from helpers import make_provisioner_harness
+        from karpenter_tpu.apis.core import Volume
+        from karpenter_tpu.controllers.provisioning.provisioner import _IGNORED_PODS
+
+        clock, store, provider, cluster, informer, prov = make_provisioner_harness()
+        store.create(nodepool("default"))
+        pod = unschedulable_pod()
+        pod.spec.volumes = [Volume(name="data", persistent_volume_claim="invalid")]
+        store.create(pod)
+        informer.flush()
+        assert prov.get_pending_pods() == []
+        assert _IGNORED_PODS.value() == 1.0
+
 
 class TestHostPortsBothPaths:
     """Host-port conflict semantics on BOTH paths (hostportusage.go:35-120;
