@@ -49,6 +49,62 @@ class TestEndToEnd:
                 t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints
             )
 
+    def test_anti_affinity_schrodinger_across_batches(self):
+        """topology_test.go:2512 'should not violate pod anti-affinity on
+        zone (Schrödinger)': a pod whose anti-affinity zone is undetermined
+        blocks its target in the SAME batch (it could land in any zone);
+        once node creation commits the zone, a later batch schedules the
+        target into a different zone."""
+        from karpenter_tpu.apis.core import (
+            Affinity,
+            LabelSelector,
+            PodAffinityTerm,
+            PodAntiAffinity,
+        )
+
+        clock, store, provider, op = make_operator()
+        store.create(nodepool("workers"))
+        anti = Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(
+                            match_labels={"security": "s2"}
+                        ),
+                    )
+                ]
+            )
+        )
+        zone_anywhere = store.create(
+            unschedulable_pod(
+                name="zone-anywhere", requests={"cpu": "2"}, affinity=anti
+            )
+        )
+        target = store.create(
+            unschedulable_pod(name="target", labels={"security": "s2"})
+        )
+        # batch 1: the anti pod opens a claim; the target CANNOT share the
+        # batch — the anti pod's zone is still undetermined
+        for _ in range(2):  # trigger pass + batch-window close
+            clock.step(2.0)
+            op.run_once()
+        assert store.list("NodeClaim"), "anti pod should open a claim"
+        assert store.get("Pod", "target").spec.node_name == ""
+        # nodes register, the zone commits, later batches admit the target
+        settle(clock, op)
+        bound_anti = store.get("Pod", "zone-anywhere")
+        bound_target = store.get("Pod", "target")
+        assert bound_anti.spec.node_name and bound_target.spec.node_name
+        zone_of = {
+            n.metadata.name: n.metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+            for n in store.list("Node")
+        }
+        assert (
+            zone_of[bound_anti.spec.node_name]
+            != zone_of[bound_target.spec.node_name]
+        )
+
     def test_node_selector_end_to_end(self):
         clock, store, provider, op = make_operator()
         store.create(nodepool("workers"))
