@@ -205,6 +205,29 @@ def eight_pool_bench(engine, catalog, pods, runs: int = 5) -> float:
     return float(np.percentile(times, 50))
 
 
+def hyperscale_bench(engine, catalog, runs: int = 3) -> float:
+    """BASELINE.json's top config, literally: 100k pods x 1k instance types
+    x 8 NodePools. Reuses the 8-pool workload with the pod set doubled."""
+    pods = build_pods()
+    doubled = []
+    from karpenter_tpu.apis.core import Condition, ObjectMeta, Pod, PodSpec
+
+    for i, p in enumerate(pods):
+        q = Pod(
+            metadata=ObjectMeta(name=f"x-{p.metadata.name}", uid=f"x-{p.metadata.uid}"),
+            spec=PodSpec(
+                node_selector=dict(p.spec.node_selector),
+                containers=p.spec.containers,
+            ),
+        )
+        q.metadata.creation_timestamp = float(i % 11)
+        q.status.conditions.append(
+            Condition(type="PodScheduled", status="False", reason="Unschedulable")
+        )
+        doubled.append(q)
+    return eight_pool_bench(engine, catalog, pods + doubled, runs=runs)
+
+
 def preference_bench(engine, n: int = 4000) -> tuple[float, float]:
     """The reference's preference-relaxation benchmark
     (scheduling_benchmark_test.go:104-109): n pods laden with preferred
@@ -579,6 +602,7 @@ def main() -> None:
 
     p50 = float(np.percentile(times, 50))
     pools8_ms = eight_pool_bench(engine, catalog, pods)
+    hyper_ms = hyperscale_bench(engine, catalog)
     respect_ms, ignore_ms = preference_bench(engine)
     consolidation_ms = consolidation_bench()
     topo_ms = topology_bench(engine)
@@ -592,7 +616,8 @@ def main() -> None:
                     f"{warmup_ms:.0f}ms at operator idle + first batch "
                     f"{cold_ms:.0f}ms (target <1000ms); decisions "
                     f"host-oracle-identical; 8 weighted NodePools @50k pods: "
-                    f"{pools8_ms:.0f}ms p50 (target <200ms); preference "
+                    f"{pools8_ms:.0f}ms p50 (target <200ms); hyperscale "
+                    f"100k pods x 8 pools: {hyper_ms:.0f}ms p50; preference "
                     f"relaxation @4k pods: Respect {respect_ms:.0f}ms / "
                     f"Ignore {ignore_ms:.0f}ms (ref "
                     f"scheduling_benchmark_test.go:104-109); multi-node "
