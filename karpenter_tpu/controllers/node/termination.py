@@ -33,6 +33,20 @@ _TERMINATION_DURATION = global_registry.histogram(
     "karpenter_nodes_termination_duration_seconds",
     "time from deletion to finalizer removal",
 )
+_NODES_DRAINED = global_registry.counter(
+    "karpenter_nodes_drained_total",
+    "nodes drained by karpenter",
+    labels=["nodepool"],
+)
+_NODE_LIFETIME = global_registry.histogram(
+    "karpenter_nodes_lifetime_duration_seconds",
+    "node lifetime since creation",
+    labels=["nodepool"],
+    buckets=(
+        300.0, 600.0, 1800.0, 3600.0, 21600.0, 43200.0, 86400.0,
+        172800.0, 604800.0, 2592000.0,
+    ),
+)
 
 SYSTEM_CRITICAL_PRIORITY = 2_000_000_000  # system-cluster-critical floor
 
@@ -180,12 +194,14 @@ class TerminationController:
         if claim is not None and not claim.condition_is_true(CONDITION_DRAINED):
             claim.set_condition(CONDITION_DRAINED, "True", now=self.clock.now())
             self.store.apply(claim)
+            # increment only on the False->True transition, claim present —
+            # the reference's double-count guard (controller.go:160-166)
+            _NODES_DRAINED.inc(
+                {"nodepool": node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
+            )
 
         # volumes: all VolumeAttachments for drainable volumes must detach
-        attachments = self.store.list(
-            "VolumeAttachment",
-            predicate=lambda va: va.node_name == node.metadata.name,
-        )
+        attachments = self._blocking_volume_attachments(node)
         if attachments and (
             grace_expiration is None or self.clock.now() < grace_expiration
         ):
@@ -214,15 +230,55 @@ class TerminationController:
                 pass
         self._finalize(node)
 
-    def _finalize(self, node: Node) -> None:
-        """Counter + duration histogram + finalizer removal — shared by the
-        drained path and the instance-gone fast path so the two metrics
-        never drift apart."""
-        _NODES_TERMINATED.inc(
-            {"nodepool": node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
+    def _blocking_volume_attachments(self, node: Node) -> list:
+        """VolumeAttachments that should block termination: attachments
+        whose volumes belong to UNDRAINABLE pods are excluded — those pods
+        stay on the node, so their volumes will never detach
+        (termination/controller.go:303-345 filterVolumeAttachments)."""
+        attachments = self.store.list(
+            "VolumeAttachment",
+            predicate=lambda va: va.node_name == node.metadata.name,
         )
+        if not attachments:
+            return attachments
+        undrainable_pvs: set[str] = set()
+        for pod in self.store.pods_on_node(node.metadata.name):
+            if podutil.is_drainable(pod, self.clock):
+                continue
+            for vol in pod.spec.volumes:
+                claim_name = vol.persistent_volume_claim
+                if claim_name is None and vol.ephemeral_storage_class is not None:
+                    # generic ephemeral volume: PVC named <pod>-<volume>
+                    # (volumeusage.py get_volumes uses the same convention)
+                    claim_name = f"{pod.metadata.name}-{vol.name}"
+                if not claim_name:
+                    continue
+                pvc = self.store.try_get(
+                    "PersistentVolumeClaim",
+                    claim_name,
+                    namespace=pod.metadata.namespace,
+                )
+                if pvc is not None and pvc.volume_name:
+                    undrainable_pvs.add(pvc.volume_name)
+        # attachments with no named PV can't be matched to a pod and are
+        # not waited on, per the reference's PersistentVolumeName filter
+        return [
+            va
+            for va in attachments
+            if va.pv_name and va.pv_name not in undrainable_pvs
+        ]
+
+    def _finalize(self, node: Node) -> None:
+        """Counter + duration/lifetime histograms + finalizer removal —
+        shared by the drained path and the instance-gone fast path so the
+        metrics never drift apart."""
+        pool = {"nodepool": node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
+        _NODES_TERMINATED.inc(pool)
         _TERMINATION_DURATION.observe(
             self.clock.now() - (node.metadata.deletion_timestamp or self.clock.now())
+        )
+        _NODE_LIFETIME.observe(
+            self.clock.now() - node.metadata.creation_timestamp, pool
         )
         self.store.remove_finalizer(node, wk.TERMINATION_FINALIZER)
 

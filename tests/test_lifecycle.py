@@ -234,14 +234,103 @@ class TestTermination:
         node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
         store.create(node)
         provider.created[claim.status.provider_id] = claim
-        store.create(VolumeAttachment(metadata=ObjectMeta(name="va-1"), node_name="term-2"))
+        store.create(
+            VolumeAttachment(
+                metadata=ObjectMeta(name="va-1"), node_name="term-2", pv_name="pv-1"
+            )
+        )
+        # a nameless-PV attachment is NOT waited on (the reference rejects
+        # nil PersistentVolumeName, controller.go:335-338)
+        store.create(
+            VolumeAttachment(metadata=ObjectMeta(name="va-inline"), node_name="term-2")
+        )
         store.delete(node)
         ctrl.reconcile(store.get("Node", "term-2"))
-        assert store.try_get("Node", "term-2") is not None  # blocked
+        assert store.try_get("Node", "term-2") is not None  # blocked by va-1
         store.delete(store.get("VolumeAttachment", "va-1"))
         ctrl.reconcile(store.get("Node", "term-2"))
         ctrl.reconcile(store.get("Node", "term-2"))
         assert store.try_get("Node", "term-2") is None
+
+    def test_volume_attachments_of_undrainable_pods_do_not_block(self, env):
+        """termination suite 'should only wait for volume attachments
+        associated with drainable pods': a volume used only by an
+        undrainable pod (here: node-owned/static) will never detach —
+        waiting on it would deadlock the finalizer."""
+        from karpenter_tpu.apis.core import (
+            OwnerReference,
+            PersistentVolumeClaim,
+            Volume,
+        )
+
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("term-3")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        store.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="static-pvc"), volume_name="pv-static"
+            )
+        )
+        static_pod = bind_pod(unschedulable_pod(name="static-1"), node)
+        static_pod.metadata.owner_references = [
+            OwnerReference(kind="Node", name="term-3", uid="u1", controller=True)
+        ]
+        static_pod.spec.volumes = [
+            Volume(name="data", persistent_volume_claim="static-pvc")
+        ]
+        store.create(static_pod)
+        store.create(
+            VolumeAttachment(
+                metadata=ObjectMeta(name="va-static"),
+                node_name="term-3",
+                pv_name="pv-static",
+            )
+        )
+        store.delete(node)
+        for _ in range(3):
+            live = store.try_get("Node", "term-3")
+            if live is None:
+                break
+            ctrl.reconcile(live)
+        assert store.try_get("Node", "term-3") is None, (
+            "static pod's attachment must not block termination"
+        )
+
+    def test_drained_total_and_lifetime_metrics(self, env):
+        """termination suite metric specs: drained counter increments once
+        per node (condition-transition guarded), and node lifetime lands in
+        the histogram at finalize."""
+        from karpenter_tpu.controllers.node.termination import (
+            _NODE_LIFETIME,
+            _NODES_DRAINED,
+        )
+
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("term-m")
+        node.metadata.creation_timestamp = clock.now()
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        clock.step(500.0)  # the node lives a while
+        pool_labels = {"nodepool": node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
+        drained0 = _NODES_DRAINED.value(pool_labels)
+        life0 = _NODE_LIFETIME.count(pool_labels)
+        store.delete(node)
+        for _ in range(4):
+            live = store.try_get("Node", "term-m")
+            if live is None:
+                break
+            ctrl.reconcile(live)
+        assert store.try_get("Node", "term-m") is None
+        assert _NODES_DRAINED.value(pool_labels) == drained0 + 1
+        assert _NODE_LIFETIME.count(pool_labels) == life0 + 1
+        assert _NODE_LIFETIME.sum(pool_labels) >= 500.0
 
     def test_deletes_node_without_nodeclaim(self, env):
         """termination suite:123 — node-only termination (no paired claim)
