@@ -92,9 +92,20 @@ class Terminator:
         self.queue = queue
         self.recorder = recorder
 
+    # kubernetes well-known label: service controllers drop labeled nodes
+    # from load-balancer target groups (terminator.go:67-74 — applied
+    # before draining so connections drain ahead of instance termination)
+    EXCLUDE_BALANCERS_LABEL = "node.kubernetes.io/exclude-from-external-load-balancers"
+
     def taint(self, node: Node) -> None:
+        changed = False
         if not any(t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in node.spec.taints):
             node.spec.taints = list(node.spec.taints) + [DISRUPTED_NO_SCHEDULE_TAINT]
+            changed = True
+        if node.metadata.labels.get(self.EXCLUDE_BALANCERS_LABEL) != "karpenter":
+            node.metadata.labels[self.EXCLUDE_BALANCERS_LABEL] = "karpenter"
+            changed = True
+        if changed:
             self.store.apply(node)
 
     def drain(self, node: Node, grace_expiration: Optional[float]) -> Optional[str]:

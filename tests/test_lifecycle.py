@@ -300,6 +300,44 @@ class TestTermination:
             "static pod's attachment must not block termination"
         )
 
+    def test_terminating_node_excluded_from_load_balancers(self, env):
+        """termination suite:197 — the exclude-from-external-load-balancers
+        label is applied with the disruption taint, BEFORE draining, so
+        connections drain ahead of instance termination."""
+        clock, store, provider, recorder = env
+        queue, terminator, ctrl = self.build(env)
+        node, claim = node_claim_pair("term-lb")
+        store.create(claim)
+        node.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(node)
+        provider.created[claim.status.provider_id] = claim
+        # a blocking pod keeps the node alive long enough to observe labels
+        blocked = bind_pod(unschedulable_pod(name="lb-pod"), node)
+        store.create(blocked)
+        from karpenter_tpu.apis.core import (
+            LabelSelector,
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+        )
+
+        store.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="block-all"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector(match_labels={}), max_unavailable=0
+                ),
+            )
+        )
+        store.delete(node)
+        ctrl.reconcile(store.get("Node", "term-lb"))
+        live = store.get("Node", "term-lb")
+        assert (
+            live.metadata.labels[
+                "node.kubernetes.io/exclude-from-external-load-balancers"
+            ]
+            == "karpenter"
+        )
+
     def test_drained_total_and_lifetime_metrics(self, env):
         """termination suite metric specs: drained counter increments once
         per node (condition-transition guarded), and node lifetime lands in
