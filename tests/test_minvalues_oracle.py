@@ -504,6 +504,98 @@ class TestMinValues:
                 == "true"
             )
 
+    def test_best_effort_relaxes_before_falling_back_to_other_pools(self, path):
+        """provisioning suite 'should relax minValues before falling back to
+        other nodepools': the higher-weight pool relaxes its minValues and
+        WINS — the solver must not skip to a lower-weight pool that would
+        satisfy without relaxation."""
+        from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_BEST_EFFORT
+
+        catalog = [fake_it("instance-type-1", 4, 0.52), fake_it("instance-type-2", 4, 0.52)]
+        pools = [
+            nodepool(
+                "default",
+                requirements=[
+                    {
+                        "key": wk.LABEL_INSTANCE_TYPE,
+                        "operator": "In",
+                        "values": [
+                            "instance-type-1", "instance-type-2", "instance-type-3",
+                        ],
+                        "minValues": 3,
+                    }
+                ],
+                weight=100,
+            ),
+            nodepool(
+                "no-min-values",
+                requirements=[
+                    {
+                        "key": wk.LABEL_INSTANCE_TYPE,
+                        "operator": "In",
+                        "values": [
+                            "instance-type-1", "instance-type-2", "instance-type-3",
+                        ],
+                    }
+                ],
+                weight=10,
+            ),
+        ]
+        kwargs = {
+            "catalog": catalog,
+            "node_pools": pools,
+            "min_values_policy": MIN_VALUES_POLICY_BEST_EFFORT,
+        }
+        if Env is not HostEnv:
+            kwargs["engine"] = CatalogEngine(catalog)
+        results = Env(**kwargs).schedule(
+            [unschedulable_pod(name="p-0", requests={"cpu": "0.9", "memory": "0.9Gi"})]
+        )
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "default"
+        assert (
+            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] == "true"
+        )
+        assert nc.requirements.get(wk.LABEL_INSTANCE_TYPE).min_values == 2
+        assert len(nc.instance_type_options) == 2
+
+    def test_best_effort_higher_weight_pool_wins_when_both_relax(self, path):
+        """provisioning suite 'should choose nodepool with higher weight when
+        relaxing minValues': both pools need relaxation; weight order
+        decides."""
+        from karpenter_tpu.scheduler.scheduler import MIN_VALUES_POLICY_BEST_EFFORT
+
+        catalog = [fake_it("instance-type-1", 4, 0.52), fake_it("instance-type-2", 4, 0.52)]
+        min_req = {
+            "key": wk.LABEL_INSTANCE_TYPE,
+            "operator": "In",
+            "values": ["instance-type-1", "instance-type-2", "instance-type-3"],
+            "minValues": 3,
+        }
+        # deliberately listed lowest-weight first: the harness must order by
+        # weight like the provisioner, or this assertion is vacuous
+        pools = [
+            nodepool("lower-weight", requirements=[dict(min_req)], weight=10),
+            nodepool("default", requirements=[dict(min_req)], weight=100),
+        ]
+        kwargs = {
+            "catalog": catalog,
+            "node_pools": pools,
+            "min_values_policy": MIN_VALUES_POLICY_BEST_EFFORT,
+        }
+        if Env is not HostEnv:
+            kwargs["engine"] = CatalogEngine(catalog)
+        results = Env(**kwargs).schedule(
+            [unschedulable_pod(name="p-0", requests={"cpu": "0.9", "memory": "0.9Gi"})]
+        )
+        assert not results.pod_errors
+        [nc] = results.new_node_claims
+        assert nc.nodepool_name == "default"
+        assert (
+            nc.annotations[wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY] == "true"
+        )
+
     def test_best_effort_satisfiable_keeps_strict_semantics(self, path):
         """When the catalog satisfies minValues, BestEffort must behave
         exactly like Strict: no relaxation, annotation false, original

@@ -49,7 +49,12 @@ class Env:
         self.cluster = Cluster(self.clock, self.store, cloud_provider=None)
         self.informer = StateInformer(self.store, self.cluster)
         self.recorder = Recorder(clock=self.clock)
-        self.node_pools = node_pools if node_pools is not None else [nodepool("default")]
+        # weight order, as the provisioner delivers pools to the scheduler
+        # (nodepoolutil.order_by_weight; stable for the default weight 0)
+        self.node_pools = sorted(
+            node_pools if node_pools is not None else [nodepool("default")],
+            key=lambda np: -(np.spec.weight or 0),
+        )
         for np in self.node_pools:
             self.store.create(np)
         for obj in state_nodes:
