@@ -24,6 +24,10 @@ from karpenter_tpu.scheduling.requirements import (
 
 # Launch truncation constant (nodeclaimtemplate.go:40)
 MAX_INSTANCE_TYPES = 60
+# Runtime default for NodeClaim terminationGracePeriod (seconds) when the
+# NodePool doesn't set one — nodeclaimtemplate.go:33-36
+# (DefaultTerminationGracePeriod); None = no default.
+DEFAULT_TERMINATION_GRACE_PERIOD: "float | None" = None
 
 
 def node_class_label_key(group: str, kind: str) -> str:
@@ -80,6 +84,10 @@ class NodeClaimTemplate:
             spec=copy.deepcopy(self.spec),
         )
         claim.spec.requirements = self.requirements.node_selector_requirements()
+        if claim.spec.termination_grace_period is None:
+            # runtime defaulting (nodeclaimtemplate.go:33-36,102): a
+            # process-level default applies when the NodePool doesn't set one
+            claim.spec.termination_grace_period = DEFAULT_TERMINATION_GRACE_PERIOD
         return claim
 
     def __repr__(self) -> str:

@@ -62,6 +62,39 @@ class TestNodeClaimCreation:
         [claim] = store.list("NodeClaim")
         assert claim.spec.termination_grace_period is None
 
+    def test_global_termination_grace_period_default(self, monkeypatch):
+        # suite_test.go:244 — the process-level default applies when the
+        # nodepool doesn't set one...
+        from karpenter_tpu.scheduler import nodeclaimtemplate as ncltmpl
+
+        monkeypatch.setattr(
+            ncltmpl, "DEFAULT_TERMINATION_GRACE_PERIOD", 98 * 3600.0
+        )
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        store.create(nodepool("default"))
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        [claim] = store.list("NodeClaim")
+        assert claim.spec.termination_grace_period == 98 * 3600.0
+
+    def test_nodepool_termination_grace_period_beats_global(self, monkeypatch):
+        # suite_test.go:232 — ...and the nodepool's own value wins over it
+        from karpenter_tpu.scheduler import nodeclaimtemplate as ncltmpl
+
+        monkeypatch.setattr(
+            ncltmpl, "DEFAULT_TERMINATION_GRACE_PERIOD", 98 * 3600.0
+        )
+        harness = make_provisioner_harness()
+        clock, store, provider, cluster, informer, prov = harness
+        pool = nodepool("default")
+        pool.spec.template.spec.termination_grace_period = 123.0
+        store.create(pool)
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        run_batch(harness, [pod])
+        [claim] = store.list("NodeClaim")
+        assert claim.spec.termination_grace_period == 123.0
+
     def test_deleting_nodepools_ignored(self):
         # suite_test.go:280
         harness = make_provisioner_harness()
