@@ -24,6 +24,47 @@ _POD_STARTUP = global_registry.histogram(
 _POD_UNBOUND = global_registry.histogram(
     "karpenter_pods_unbound_duration_seconds", "time pods spend unbound"
 )
+# the rest of the reference's pod metric family (metrics/pod/controller.go
+# :60-165): live per-pod gauges (deleted when the state resolves or the pod
+# goes away) + once-per-transition histograms, each with a provisioning_*
+# twin measured from the time karpenter first deemed the pod schedulable
+_POD_LABELS = ["name", "namespace"]
+_POD_UNSTARTED = global_registry.gauge(
+    "karpenter_pods_unstarted_time_seconds",
+    "time pods have spent not running since creation",
+    labels=_POD_LABELS,
+)
+_POD_BOUND_DURATION = global_registry.histogram(
+    "karpenter_pods_bound_duration_seconds", "time from pod creation to bound"
+)
+_POD_UNBOUND_TIME = global_registry.gauge(
+    "karpenter_pods_unbound_time_seconds",
+    "time pods have spent unbound since creation",
+    labels=_POD_LABELS,
+)
+_POD_PROV_BOUND = global_registry.histogram(
+    "karpenter_pods_provisioning_bound_duration_seconds",
+    "time from schedulability determination to bound",
+)
+_POD_PROV_UNBOUND = global_registry.gauge(
+    "karpenter_pods_provisioning_unbound_time_seconds",
+    "time provisioned pods have spent unbound since schedulability",
+    labels=_POD_LABELS,
+)
+_POD_PROV_STARTUP = global_registry.histogram(
+    "karpenter_pods_provisioning_startup_duration_seconds",
+    "time from schedulability determination to running",
+)
+_POD_PROV_UNSTARTED = global_registry.gauge(
+    "karpenter_pods_provisioning_unstarted_time_seconds",
+    "time provisioned pods have spent not running since schedulability",
+    labels=_POD_LABELS,
+)
+_POD_UNDECIDED = global_registry.gauge(
+    "karpenter_pods_scheduling_undecided_time_seconds",
+    "time since ack for pods with no scheduling decision yet",
+    labels=_POD_LABELS,
+)
 _NODE_ALLOCATABLE = global_registry.gauge(
     "karpenter_nodes_allocatable", "node allocatable",
     labels=["node_name", "nodepool", "resource_type"],
@@ -52,10 +93,14 @@ class PodMetricsController:
         self.clock = clock
         self.metric_store = MetricStore()
         self._started: set[str] = set()
+        self._bound: set[str] = set()
 
     def reconcile(self) -> None:
+        now = self.clock.now()
         for pod in self.store.list("Pod"):
             key = f"pod/{pod.metadata.namespace}/{pod.metadata.name}"
+            nn = (pod.metadata.namespace, pod.metadata.name)
+            plabels = {"name": pod.metadata.name, "namespace": pod.metadata.namespace}
             self.metric_store.update(
                 key,
                 [
@@ -71,14 +116,56 @@ class PodMetricsController:
                     )
                 ],
             )
+            # schedulable time: when karpenter first deemed this pod
+            # schedulable (zero if it never went through provisioning)
+            schedulable = self.cluster.pod_scheduling_success_time(nn)
+            bound = bool(pod.spec.node_name)
             if pod.status.phase == "Running" and pod.metadata.uid not in self._started:
                 self._started.add(pod.metadata.uid)
-                _POD_STARTUP.observe(
-                    self.clock.now() - pod.metadata.creation_timestamp
-                )
+                _POD_STARTUP.observe(now - pod.metadata.creation_timestamp)
+                if schedulable > 0.0:
+                    _POD_PROV_STARTUP.observe(now - schedulable)
+            if pod.metadata.uid in self._started or podutil.is_terminal(pod):
+                _POD_UNSTARTED.delete(plabels)
+                _POD_PROV_UNSTARTED.delete(plabels)
+            else:
+                _POD_UNSTARTED.set(now - pod.metadata.creation_timestamp, plabels)
+                if schedulable > 0.0:
+                    _POD_PROV_UNSTARTED.set(now - schedulable, plabels)
+            if bound:
+                if pod.metadata.uid not in self._bound:
+                    self._bound.add(pod.metadata.uid)
+                    _POD_BOUND_DURATION.observe(
+                        now - pod.metadata.creation_timestamp
+                    )
+                    if schedulable > 0.0:
+                        _POD_PROV_BOUND.observe(now - schedulable)
+                _POD_UNBOUND_TIME.delete(plabels)
+                _POD_PROV_UNBOUND.delete(plabels)
+            else:
+                _POD_UNBOUND_TIME.set(now - pod.metadata.creation_timestamp, plabels)
+                if schedulable > 0.0:
+                    _POD_PROV_UNBOUND.set(now - schedulable, plabels)
+            # undecided: ack'd by the provisioner but no decision recorded
+            # and not yet bound (metrics/pod/controller.go:263-284)
+            decision = self.cluster.pod_scheduling_decision_time(nn)
+            ack = self.cluster.pod_ack_time(nn)
+            if bound or decision > 0.0 or ack <= 0.0:
+                _POD_UNDECIDED.delete(plabels)
+            else:
+                _POD_UNDECIDED.set(now - ack, plabels)
 
     def on_delete(self, namespace: str, name: str) -> None:
         self.metric_store.delete(f"pod/{namespace}/{name}")
+        plabels = {"name": name, "namespace": namespace}
+        for gauge in (
+            _POD_UNSTARTED,
+            _POD_PROV_UNSTARTED,
+            _POD_UNBOUND_TIME,
+            _POD_PROV_UNBOUND,
+            _POD_UNDECIDED,
+        ):
+            gauge.delete(plabels)
 
 
 class NodeMetricsController:

@@ -66,6 +66,62 @@ class TestMetricsCloudProvider:
         assert 'method="create"' in text or "method=\"create\"" in text
 
 
+class TestPodMetricsFamily:
+    """The reference's full pod metric family (metrics/pod/controller.go:
+    60-165): live unstarted/unbound/undecided gauges that resolve away,
+    bound/startup histograms, and their provisioning_* twins measured from
+    the schedulability-determination time."""
+
+    def test_lifecycle_resolves_gauges_and_observes_histograms(self):
+        from karpenter_tpu.controllers import metrics_controllers as mc
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+        store.create(nodepool("workers"))
+        pod = store.create(unschedulable_pod(name="pm-1", requests={"cpu": "1"}))
+        plabels = {"name": "pm-1", "namespace": "default"}
+        bound0 = mc._POD_BOUND_DURATION.count()
+        pstart0 = mc._POD_PROV_STARTUP.count()
+        # first passes: pod pending/unbound — live gauges present
+        clock.step(2.0)
+        op.run_once()
+        assert mc._POD_UNBOUND_TIME.value(plabels) > 0.0
+        assert mc._POD_UNSTARTED.value(plabels) > 0.0
+        for _ in range(10):
+            clock.step(2.0)
+            op.run_once()
+        live = store.get("Pod", "pm-1")
+        assert live.spec.node_name, "pod should be bound by now"
+        # bound+running: THIS pod's live gauges resolved away (other tests'
+        # pods may have left series — assert only our labels)
+        key = tuple(sorted(plabels.items()))
+        assert key not in mc._POD_UNBOUND_TIME.series()
+        assert key not in mc._POD_UNSTARTED.series()
+        assert key not in mc._POD_UNDECIDED.series()
+        # ...and the histograms observed, including the provisioning twins
+        assert mc._POD_BOUND_DURATION.count() == bound0 + 1
+        assert mc._POD_PROV_STARTUP.count() == pstart0 + 1
+
+    def test_deleted_pod_drops_series(self):
+        from karpenter_tpu.controllers import metrics_controllers as mc
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+        # no nodepool: the pod stays pending with live gauges
+        pod = store.create(unschedulable_pod(name="pm-2", requests={"cpu": "1"}))
+        clock.step(2.0)
+        op.run_once()
+        plabels = {"name": "pm-2", "namespace": "default"}
+        assert mc._POD_UNBOUND_TIME.value(plabels) > 0.0
+        store.delete(pod)
+        clock.step(2.0)
+        op.run_once()
+        assert mc._POD_UNBOUND_TIME.value(plabels) == 0.0
+        assert mc._POD_UNSTARTED.value(plabels) == 0.0
+
+
 class TestStatusConditionMetrics:
     """Per-CRD status-condition series, matching the operatorpkg status
     controllers the reference auto-registers (controllers.go:102-120)."""
