@@ -430,3 +430,23 @@ def pod_resource_requests(pod: Pod) -> ResourceList:
         out = r.merge(out, pod.spec.overhead)
     out["pods"] = out.get("pods", 0.0) + 1.0
     return out
+
+
+def pod_resource_limits(pod: Pod) -> ResourceList:
+    """Effective pod resource limits under the same ceiling model as
+    requests (reference pkg/utils/resources PodLimits — resources without a
+    limit contribute nothing)."""
+    sidecar_sum: ResourceList = {}
+    init_ceiling: ResourceList = {}
+    for c in pod.spec.init_containers:
+        if c.restart_policy == "Always":
+            sidecar_sum = r.merge(sidecar_sum, c.limits)
+        else:
+            init_ceiling = r.max_resources(
+                init_ceiling, r.merge(c.limits, sidecar_sum)
+            )
+    main = r.merge(sidecar_sum, *(c.limits for c in pod.spec.containers))
+    out = r.max_resources(main, init_ceiling)
+    if pod.spec.overhead:
+        out = r.merge(out, pod.spec.overhead)
+    return out

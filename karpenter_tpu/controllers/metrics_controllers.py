@@ -65,13 +65,37 @@ _POD_UNDECIDED = global_registry.gauge(
     "time since ack for pods with no scheduling decision yet",
     labels=_POD_LABELS,
 )
+_NODE_LABELS = ["node_name", "nodepool", "resource_type"]
 _NODE_ALLOCATABLE = global_registry.gauge(
-    "karpenter_nodes_allocatable", "node allocatable",
-    labels=["node_name", "nodepool", "resource_type"],
+    "karpenter_nodes_allocatable", "node allocatable", labels=_NODE_LABELS
 )
 _NODE_UTILIZATION = global_registry.gauge(
-    "karpenter_nodes_total_pod_requests", "node pod requests",
-    labels=["node_name", "nodepool", "resource_type"],
+    "karpenter_nodes_total_pod_requests", "node pod requests", labels=_NODE_LABELS
+)
+# the rest of the reference's node series (metrics/node/controller.go:60-140)
+_NODE_POD_LIMITS = global_registry.gauge(
+    "karpenter_nodes_total_pod_limits", "node pod limits", labels=_NODE_LABELS
+)
+_NODE_DAEMON_REQUESTS = global_registry.gauge(
+    "karpenter_nodes_total_daemon_requests", "node daemonset requests",
+    labels=_NODE_LABELS,
+)
+_NODE_DAEMON_LIMITS = global_registry.gauge(
+    "karpenter_nodes_total_daemon_limits", "node daemonset limits",
+    labels=_NODE_LABELS,
+)
+_NODE_SYSTEM_OVERHEAD = global_registry.gauge(
+    "karpenter_nodes_system_overhead", "capacity minus allocatable",
+    labels=_NODE_LABELS,
+)
+_NODE_LIFETIME_GAUGE = global_registry.gauge(
+    "karpenter_nodes_current_lifetime_seconds", "node age",
+    labels=["node_name", "nodepool"],
+)
+_NODE_UTIL_PCT = global_registry.gauge(
+    "karpenter_nodes_utilization_percent",
+    "pod requests as a percentage of allocatable",
+    labels=_NODE_LABELS,
 )
 _NODEPOOL_LIMIT = global_registry.gauge(
     "karpenter_nodepools_limit", "nodepool limits", labels=["nodepool", "resource_type"]
@@ -169,31 +193,72 @@ class PodMetricsController:
 
 
 class NodeMetricsController:
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, store: Store = None, clock: Clock = None):
         self.cluster = cluster
+        self.store = store
+        self.clock = clock
         self.metric_store = MetricStore()
 
     def reconcile(self) -> None:
+        from karpenter_tpu.apis.core import pod_resource_limits
+        from karpenter_tpu.utils import resources as res
+        from karpenter_tpu.utils.pod import is_owned_by_daemon_set
+
         for sn in self.cluster.state_nodes():
             pool = sn.labels().get(wk.NODEPOOL_LABEL_KEY, "")
+            name = sn.name()
             series = []
-            for resource, value in sn.allocatable().items():
+
+            def rows(gauge, values):
+                for resource, value in values.items():
+                    series.append(
+                        (
+                            gauge,
+                            {
+                                "node_name": name,
+                                "nodepool": pool,
+                                "resource_type": resource,
+                            },
+                            value,
+                        )
+                    )
+
+            allocatable = sn.allocatable()
+            requests = sn.total_pod_requests()
+            rows(_NODE_ALLOCATABLE, allocatable)
+            rows(_NODE_UTILIZATION, requests)
+            rows(_NODE_DAEMON_REQUESTS, sn.total_daemonset_requests())
+            rows(
+                _NODE_SYSTEM_OVERHEAD,
+                res.subtract(sn.capacity(), allocatable),
+            )
+            rows(
+                _NODE_UTIL_PCT,
+                {
+                    k: 100.0 * v / allocatable[k]
+                    for k, v in requests.items()
+                    if allocatable.get(k, 0.0) > 0.0
+                },
+            )
+            if self.store is not None:
+                pod_limits: dict = {}
+                daemon_limits: dict = {}
+                for p in self.store.pods_on_node(name):
+                    limits = pod_resource_limits(p)
+                    pod_limits = res.merge(pod_limits, limits)
+                    if is_owned_by_daemon_set(p):
+                        daemon_limits = res.merge(daemon_limits, limits)
+                rows(_NODE_POD_LIMITS, pod_limits)
+                rows(_NODE_DAEMON_LIMITS, daemon_limits)
+            if self.clock is not None and sn.node is not None:
                 series.append(
                     (
-                        _NODE_ALLOCATABLE,
-                        {"node_name": sn.name(), "nodepool": pool, "resource_type": resource},
-                        value,
+                        _NODE_LIFETIME_GAUGE,
+                        {"node_name": name, "nodepool": pool},
+                        self.clock.now() - sn.node.metadata.creation_timestamp,
                     )
                 )
-            for resource, value in sn.total_pod_requests().items():
-                series.append(
-                    (
-                        _NODE_UTILIZATION,
-                        {"node_name": sn.name(), "nodepool": pool, "resource_type": resource},
-                        value,
-                    )
-                )
-            self.metric_store.update(f"node/{sn.name()}", series)
+            self.metric_store.update(f"node/{name}", series)
 
 
 class StatusConditionMetricsController:

@@ -148,7 +148,9 @@ class Operator:
                 store, self.clock
             )
         self.pod_metrics = PodMetricsController(store, self.cluster, self.clock)
-        self.node_metrics = NodeMetricsController(self.cluster)
+        self.node_metrics = NodeMetricsController(
+            self.cluster, store=store, clock=self.clock
+        )
         self.nodepool_metrics = NodePoolMetricsController(store, self.cluster)
         self.condition_metrics = StatusConditionMetricsController(store)
 

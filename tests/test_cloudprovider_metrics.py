@@ -103,6 +103,44 @@ class TestPodMetricsFamily:
         assert mc._POD_BOUND_DURATION.count() == bound0 + 1
         assert mc._POD_PROV_STARTUP.count() == pstart0 + 1
 
+    def test_node_metric_family_exposed(self):
+        """The reference's full node series (metrics/node/controller.go:
+        60-140): limits, daemon requests/limits, system overhead, lifetime,
+        utilization percent — all present for a provisioned node."""
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
+        store.create(nodepool("workers"))
+        pod = unschedulable_pod(name="nm-1", requests={"cpu": "1"})
+        pod.spec.containers[0].limits = {"cpu": 2.0}
+        store.create(pod)
+        for _ in range(10):
+            clock.step(2.0)
+            op.run_once()
+        text = op.metrics_text()
+        for series in (
+            "karpenter_nodes_total_pod_limits",
+            "karpenter_nodes_total_daemon_requests",
+            "karpenter_nodes_system_overhead",
+            "karpenter_nodes_current_lifetime_seconds",
+            "karpenter_nodes_utilization_percent",
+        ):
+            assert series in text, series
+        from karpenter_tpu.controllers import metrics_controllers as mc
+
+        [node] = store.list("Node")
+        labels = {
+            "node_name": node.metadata.name,
+            "nodepool": "workers",
+            "resource_type": "cpu",
+        }
+        assert mc._NODE_POD_LIMITS.value(labels) == 2.0
+        pct = mc._NODE_UTIL_PCT.value(labels)
+        assert 0.0 < pct <= 100.0
+        assert mc._NODE_LIFETIME_GAUGE.value(
+            {"node_name": node.metadata.name, "nodepool": "workers"}
+        ) > 0.0
+
     def test_deleted_pod_drops_series(self):
         from karpenter_tpu.controllers import metrics_controllers as mc
 
