@@ -49,6 +49,10 @@ _EVAL_DURATION = global_registry.histogram(
     "disruption method evaluation duration",
     labels=["reason", "consolidation_type"],
 )
+_FAILED_VALIDATIONS = global_registry.counter(
+    "karpenter_voluntary_disruption_failed_validations_total",
+    "disruption commands that failed their two-phase re-validation",
+)
 
 
 def new_methods(clock, cluster, store, provisioner, cloud_provider, recorder, queue):
@@ -140,6 +144,7 @@ class Controller:
         try:
             cmd = method.validator.validate(cmd)
         except ValidationError:
+            _FAILED_VALIDATIONS.inc()
             return False
         cmd.creation_timestamp = self.clock.now()
         cmd.method = method

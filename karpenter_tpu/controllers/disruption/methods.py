@@ -36,10 +36,17 @@ from karpenter_tpu.controllers.disruption.validation import (
     EmptinessValidator,
 )
 from karpenter_tpu.events.recorder import Event
+from karpenter_tpu.metrics import global_registry
 from karpenter_tpu.scheduling.requirements import Requirements
 
 MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:36
 SINGLE_NODE_CONSOLIDATION_TIMEOUT = 180.0  # singlenodeconsolidation.go:34
+
+_CONSOLIDATION_TIMEOUTS = global_registry.counter(
+    "karpenter_voluntary_disruption_consolidation_timeouts_total",
+    "consolidation computations that hit their timeout",
+    labels=["consolidation_type"],
+)
 MAX_PARALLEL_CONSOLIDATION = 100  # multinodeconsolidation.go:85-87
 
 
@@ -208,6 +215,7 @@ class MultiNodeConsolidation:
         deadline = self.c.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
         while lo_n <= hi_n:
             if self.c.clock.now() > deadline:
+                _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "multi"})
                 return last_saved
             mid = (lo_n + hi_n) // 2
             prefix = candidates[: mid + 1]
@@ -283,6 +291,7 @@ class SingleNodeConsolidation:
         unseen = {c.node_pool.metadata.name for c in candidates}
         for i, candidate in enumerate(candidates):
             if self.c.clock.now() > deadline:
+                _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "single"})
                 self.previously_unseen_nodepools = unseen
                 return Command()
             unseen.discard(candidate.node_pool.metadata.name)

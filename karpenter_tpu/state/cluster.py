@@ -40,6 +40,10 @@ _SYNCED_GAUGE = global_registry.gauge(
 _NODE_COUNT_GAUGE = global_registry.gauge(
     "karpenter_cluster_state_node_count", "nodes tracked in cluster state"
 )
+_UNSYNCED_TIME_GAUGE = global_registry.gauge(
+    "karpenter_cluster_state_unsynced_time_seconds",
+    "time cluster state has been continuously unsynced (0 when synced)",
+)
 _DECISION_HIST = global_registry.histogram(
     "karpenter_pods_scheduling_decision_duration_seconds",
     "time from pod ack to first scheduling decision",
@@ -70,6 +74,7 @@ class Cluster:
 
         self._consolidation_state = 0.0
         self._has_synced = False
+        self._unsynced_since: Optional[float] = None
 
     # -- sync barrier (cluster.go:113-207) ----------------------------------
 
@@ -79,19 +84,29 @@ class Cluster:
         this — they'd double-provision against a partial mirror."""
         if self._has_synced:
             ok = all(pid != "" for pid in self.node_claim_name_to_provider_id.values())
-            _SYNCED_GAUGE.set(1.0 if ok else 0.0)
-            return ok
+            return self._record_synced(ok)
         claims = {nc.metadata.name for nc in self.store.list("NodeClaim")}
         node_names = {n.metadata.name for n in self.store.list("Node")}
         if any(pid == "" for pid in self.node_claim_name_to_provider_id.values()):
-            _SYNCED_GAUGE.set(0.0)
-            return False
+            return self._record_synced(False)
         state_claims = set(self.node_claim_name_to_provider_id)
         state_nodes = set(self.node_name_to_provider_id)
         ok = state_claims >= claims and state_nodes >= node_names
         if ok:
             self._has_synced = True
+        return self._record_synced(ok)
+
+    def _record_synced(self, ok: bool) -> bool:
+        """Synced gauge + continuously-unsynced stopwatch
+        (state/metrics.go:47-62 unsynced_time_seconds)."""
         _SYNCED_GAUGE.set(1.0 if ok else 0.0)
+        if ok:
+            self._unsynced_since = None
+            _UNSYNCED_TIME_GAUGE.set(0.0)
+        else:
+            if self._unsynced_since is None:
+                self._unsynced_since = self.clock.now()
+            _UNSYNCED_TIME_GAUGE.set(self.clock.now() - self._unsynced_since)
         return ok
 
     # -- reads --------------------------------------------------------------

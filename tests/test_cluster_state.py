@@ -507,6 +507,27 @@ class TestSyncedVariants:
         informer.flush()
         assert cluster.synced() is True
 
+    def test_unsynced_time_stopwatch(self, env):
+        """state/metrics.go:57-62 — unsynced_time_seconds measures the
+        CONTINUOUS unsynced stretch and resets to zero once synced."""
+        from karpenter_tpu.state.cluster import _UNSYNCED_TIME_GAUGE
+
+        clock, store, cluster, informer = env
+        claim = make_claim()
+        claim.status.provider_id = ""
+        claim.set_condition("Launched", "True")
+        store.create(claim)
+        informer.flush()
+        assert cluster.synced() is False
+        clock.step(7.0)
+        assert cluster.synced() is False
+        assert _UNSYNCED_TIME_GAUGE.value() == 7.0
+        claim.status.provider_id = "kwok://node-1"
+        store.update(claim)
+        informer.flush()
+        assert cluster.synced() is True
+        assert _UNSYNCED_TIME_GAUGE.value() == 0.0
+
     def test_new_node_after_initial_sync_keeps_synced(self, env):
         """:1507 — ingestion keeps pace with additions."""
         clock, store, cluster, informer = env

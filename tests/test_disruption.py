@@ -108,6 +108,27 @@ class TestEmptiness:
         env.informer.flush()
         assert env.store.try_get("NodeClaim", "empty-1-claim") is not None
 
+    def test_failed_validation_counted(self):
+        """disruption/metrics.go:86 — abandoning a command at re-validation
+        increments failed_validations_total."""
+        from karpenter_tpu.controllers.disruption.controller import (
+            _FAILED_VALIDATIONS,
+        )
+
+        before = _FAILED_VALIDATIONS.value()
+        env = Env()
+        env.store.create(nodepool("default"))
+        node, claim = env.add_pair("empty-fv")
+        env.informer.flush()
+        assert env.controller.reconcile() is True
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        bind_pod(pod, node)
+        env.store.create(pod)
+        env.informer.flush()
+        env.clock.step(CONSOLIDATION_TTL + 0.1)
+        assert env.controller.reconcile() is False
+        assert _FAILED_VALIDATIONS.value() == before + 1
+
     def test_node_with_pods_not_empty(self):
         env = Env()
         env.store.create(nodepool("default"))
@@ -247,6 +268,46 @@ class TestMultiNodeConsolidation:
         # both candidates consolidated into <= 1 replacement
         assert len(cmd.candidates) == 2
         assert len(cmd.replacements) <= 1
+
+    def test_consolidation_timeout_counted(self, monkeypatch):
+        """disruption/metrics.go:76 — hitting the multi-node 60s deadline
+        mid-binary-search increments consolidation_timeouts_total and
+        returns the last saved command (the reference's deadline behavior,
+        multinodeconsolidation.go:117-170)."""
+        from karpenter_tpu.controllers.disruption import methods as dmethods
+
+        env = Env()
+        np = nodepool("default")
+        np.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np)
+        for i in range(3):
+            pod = unschedulable_pod(requests={"cpu": "1"})
+            env.add_pair(
+                f"to-{i}",
+                pods=[pod],
+                instance_type="s-16x-amd64-linux",
+                capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            )
+        before = dmethods._CONSOLIDATION_TIMEOUTS.value(
+            {"consolidation_type": "multi"}
+        )
+        # every simulation probe burns past the deadline
+        multi = next(
+            m for m in env.controller.methods
+            if isinstance(m, dmethods.MultiNodeConsolidation)
+        )
+        orig = multi.c.compute_consolidation
+
+        def slow_probe(*candidates):
+            env.clock.step(dmethods.MULTI_NODE_CONSOLIDATION_TIMEOUT + 1.0)
+            return orig(*candidates)
+
+        monkeypatch.setattr(multi.c, "compute_consolidation", slow_probe)
+        env.reconcile()
+        assert (
+            dmethods._CONSOLIDATION_TIMEOUTS.value({"consolidation_type": "multi"})
+            == before + 1
+        )
 
     def test_spot_to_spot_requires_feature_gate(self):
         env = Env()
