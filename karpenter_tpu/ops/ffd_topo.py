@@ -711,10 +711,17 @@ class _TopoSolve(_DeviceSolve):
     # raise). Anything else takes the slow path below, which mirrors
     # nodeclaim.go:114-163 verbatim.
 
-    def _build_join_plan(self, fam: int, gi: int) -> Optional[list]:
+    def _build_join_plan(self, fam: int, gi: int):
+        """Compiled plan split into FAM-LEVEL entries (single-valued family
+        rows — the tg.get() outcome is identical for every claim of the
+        family, so the probe loop evaluates them once per fam per scan) and
+        PER-CLAIM entries (hostname ops, which read the claim's own
+        hostname). Returns (fam_entries, claim_entries) or None."""
         reqs = self.fam_reqs[fam]
         g = self.groups[gi]
-        plan: Optional[list] = []
+        fam_entries: list[tuple] = []
+        claim_entries: list[tuple] = []
+        plan = (fam_entries, claim_entries)
         for tg in self.g_matched[gi]:
             pod_dom = g.strict_reqs.get(tg.key)
             if tg.key == wk.LABEL_HOSTNAME:
@@ -723,13 +730,13 @@ class _TopoSolve(_DeviceSolve):
                     if tg.type == TYPE_ANTI_AFFINITY
                     else _HOSTNAME_DOMAIN
                 )
-                plan.append((tg, pod_dom, op, None))
+                claim_entries.append((tg, pod_dom, op, None))
                 continue
             row = reqs.get(tg.key) if reqs.has(tg.key) else None
             if row is None or row.complement or len(row.values) != 1:
                 plan = None
                 break
-            plan.append((tg, pod_dom, next(iter(row.values)), row))
+            fam_entries.append((tg, pod_dom, next(iter(row.values)), row))
         self._join_plans[(fam, gi)] = plan
         return plan
 
@@ -775,6 +782,7 @@ class _TopoSolve(_DeviceSolve):
         tol_by_ti: dict = {}
         ent_by_fam: dict = {}
         plan_by_fam: dict = {}
+        fam_adm: dict = {}  # fam -> fam-level plan admission this scan
         i = 0
         n = len(cis)
         gp = self.g_ports[gi]
@@ -818,8 +826,22 @@ class _TopoSolve(_DeviceSolve):
                         plan = self._build_join_plan(c.fam, gi)
                     plan_by_fam[c.fam] = plan
                 if plan is not None:
+                    fam_entries, claim_entries = plan
+                    # fam-level entries: one evaluation per fam per scan —
+                    # every claim of the family shares the outcome
+                    if fam_entries:
+                        fam_ok = fam_adm.get(c.fam)
+                        if fam_ok is None:
+                            fam_ok = True
+                            for tg, pod_dom, expected, node_row in fam_entries:
+                                if not tg.get(pod, pod_dom, node_row).has(expected):
+                                    fam_ok = False
+                                    break
+                            fam_adm[c.fam] = fam_ok
+                        if not fam_ok:
+                            continue
                     ok = True
-                    for tg, pod_dom, expected, node_row in plan:
+                    for tg, pod_dom, expected, _node_row in claim_entries:
                         if expected is _HOSTNAME_ANTI:
                             # the host's anti-affinity hostname gate is
                             # exactly "no matching pod on this host yet"
@@ -827,14 +849,11 @@ class _TopoSolve(_DeviceSolve):
                             if tg.domains.get(c.hostname, 0) != 0:
                                 ok = False
                                 break
-                        elif expected is _HOSTNAME_DOMAIN:
+                        else:  # _HOSTNAME_DOMAIN
                             hn = self._hostname_req(ci, c)
                             if not tg.get(pod, pod_dom, hn).has(c.hostname):
                                 ok = False
                                 break
-                        elif not tg.get(pod, pod_dom, node_row).has(expected):
-                            ok = False
-                            break
                     if not ok:
                         continue
                     fitrows = (c.rem >= g.fit_floor).all(axis=1)
