@@ -257,6 +257,10 @@ class Requirement:
         return s
 
 
+_LABEL_REQ_CACHE: dict = {}
+_LABEL_REQ_CAP = 100_000
+
+
 class Requirements:
     """A key→Requirement map where `add` intersects same-key requirements.
 
@@ -271,7 +275,23 @@ class Requirements:
 
     @classmethod
     def from_labels(cls, labels: Mapping[str, str]) -> "Requirements":
-        return cls(*(Requirement(k, Operator.IN, [v]) for k, v in labels.items()))
+        # Single-value label requirements are interned process-wide: node
+        # re-ingestion and consolidation simulations rebuild the same
+        # (key, value) rows thousands of times per pass. Shared objects are
+        # safe — nothing mutates label-derived requirements (mutation sites
+        # are template minValues write-downs and topology DOES_NOT_EXIST
+        # options, both operating on their own objects).
+        reqs = []
+        for k, v in labels.items():
+            ck = (k, v)
+            r = _LABEL_REQ_CACHE.get(ck)
+            if r is None:
+                if len(_LABEL_REQ_CACHE) >= _LABEL_REQ_CAP:
+                    _LABEL_REQ_CACHE.clear()
+                r = Requirement(k, Operator.IN, [v])
+                _LABEL_REQ_CACHE[ck] = r
+            reqs.append(r)
+        return cls(*reqs)
 
     def copy(self) -> "Requirements":
         out = Requirements()
