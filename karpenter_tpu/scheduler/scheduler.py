@@ -279,14 +279,15 @@ class Scheduler:
         for node in state_nodes:
             taints = node.taints()
             daemons = []
-            for p in daemonset_pods:
-                if Taints(taints).tolerates_pod(p) is not None:
-                    continue
-                if not Requirements.from_labels(node.labels()).is_compatible(
-                    strict_pod_requirements(p)
-                ):
-                    continue
-                daemons.append(p)
+            if daemonset_pods:
+                node_taints = Taints(taints)
+                node_reqs = Requirements.from_labels(node.labels())
+                for p in daemonset_pods:
+                    if node_taints.tolerates_pod(p) is not None:
+                        continue
+                    if not node_reqs.is_compatible(strict_pod_requirements(p)):
+                        continue
+                    daemons.append(p)
             self.existing_nodes.append(
                 ExistingNode(
                     node,
