@@ -294,15 +294,9 @@ class TerminationController:
         self.store.remove_finalizer(node, wk.TERMINATION_FINALIZER)
 
     def _claim_for(self, node: Node) -> Optional[NodeClaim]:
-        return next(
-            iter(
-                self.store.list(
-                    "NodeClaim",
-                    predicate=lambda c: c.status.provider_id == node.spec.provider_id,
-                )
-            ),
-            None,
-        )
+        from karpenter_tpu.utils.node import claim_for_node
+
+        return claim_for_node(self.store, node)
 
     def _grace_expiration(self, claim: Optional[NodeClaim]) -> Optional[float]:
         if claim is None:

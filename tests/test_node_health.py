@@ -1,0 +1,203 @@
+"""Node auto-repair suite (reference node/health/suite_test.go, 14 specs):
+policy-matched unhealthy nodes force-delete their NodeClaims after the
+toleration window, with a per-NodePool 20%-rounded-up circuit breaker,
+forced (now-stamped) termination deadlines, and no regard for disruption
+budgets or do-not-disrupt."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import Condition, ObjectMeta
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.cloudprovider.types import RepairPolicy
+from karpenter_tpu.controllers.node.health import (
+    _DISRUPTED_TOTAL,
+    _REPAIRED_TOTAL,
+    HealthController,
+)
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import node_claim_pair, nodepool
+
+POLICY = RepairPolicy(
+    condition_type="BadNode", condition_status="True", toleration_duration=600.0
+)
+
+
+@pytest.fixture()
+def env():
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = FakeCloudProvider()
+    provider._repair_policies = [POLICY]
+    recorder = Recorder(clock=clock)
+    ctrl = HealthController(store, provider, recorder, clock, enabled=True)
+    store.create(nodepool("workers"))
+    return clock, store, provider, recorder, ctrl
+
+
+def add_node(store, clock, name, unhealthy=False, since=None, pool="workers",
+             condition_type="BadNode", condition_status="True"):
+    node, claim = node_claim_pair(name, pool=pool)
+    if unhealthy:
+        node.status.conditions.append(
+            Condition(
+                type=condition_type,
+                status=condition_status,
+                last_transition_time=clock.now() if since is None else since,
+            )
+        )
+    store.create(claim)
+    store.create(node)
+    return node, claim
+
+
+class TestNodeRepair:
+    def test_deletes_unhealthy_node_claim(self, env):
+        """'should delete nodes that are unhealthy by the cloud provider' —
+        the CLAIM is deleted (its finalizer pipeline handles the node), the
+        termination deadline is stamped to NOW, and both disruption
+        counters fire."""
+        clock, store, provider, recorder, ctrl = env
+        node, claim = add_node(store, clock, "sick-1", unhealthy=True)
+        labels = {"nodepool": "workers", "capacity_type": claim.metadata.labels.get(
+            wk.CAPACITY_TYPE_LABEL_KEY, "")}
+        repaired0 = _REPAIRED_TOTAL.value({"condition": "BadNode", **labels})
+        disrupted0 = _DISRUPTED_TOTAL.value({"reason": "unhealthy", **labels})
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        live = store.try_get("NodeClaim", "sick-1-claim")
+        assert live is None or live.metadata.deletion_timestamp is not None
+        assert _REPAIRED_TOTAL.value({"condition": "BadNode", **labels}) == repaired0 + 1
+        assert _DISRUPTED_TOTAL.value({"reason": "unhealthy", **labels}) == disrupted0 + 1
+        assert recorder.calls("NodeUnhealthy") == 1
+
+    def test_condition_type_mismatch_ignored(self, env):
+        clock, store, provider, recorder, ctrl = env
+        node, _ = add_node(
+            store, clock, "odd-1", unhealthy=True, condition_type="OtherProblem"
+        )
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        assert store.get("NodeClaim", "odd-1-claim").metadata.deletion_timestamp is None
+
+    def test_condition_status_mismatch_ignored(self, env):
+        clock, store, provider, recorder, ctrl = env
+        node, _ = add_node(
+            store, clock, "odd-2", unhealthy=True, condition_status="Unknown"
+        )
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        assert store.get("NodeClaim", "odd-2-claim").metadata.deletion_timestamp is None
+
+    def test_waits_out_toleration_duration(self, env):
+        clock, store, provider, recorder, ctrl = env
+        node, _ = add_node(store, clock, "sick-2", unhealthy=True)
+        clock.step(599.0)
+        ctrl.reconcile(node)
+        assert store.get("NodeClaim", "sick-2-claim").metadata.deletion_timestamp is None
+        clock.step(2.0)
+        ctrl.reconcile(node)
+        live = store.try_get("NodeClaim", "sick-2-claim")
+        assert live is None or live.metadata.deletion_timestamp is not None
+
+    def test_termination_deadline_stamped_to_now_ignoring_nodepool_tgp(self, env):
+        """'should set annotation termination grace period when force
+        termination is started' + 'should not respect TGP set on the
+        nodepool' — repair is forced."""
+        clock, store, provider, recorder, ctrl = env
+        node, claim = add_node(store, clock, "sick-3", unhealthy=True)
+        claim.spec.termination_grace_period = 86400.0  # repair must ignore it
+        claim.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.apply(claim)
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        live = store.get("NodeClaim", "sick-3-claim")
+        assert live.metadata.annotations[
+            wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
+        ] == str(clock.now())
+
+    def test_earlier_termination_deadline_preserved(self, env):
+        """'should not update termination grace period if set before the
+        current time'."""
+        clock, store, provider, recorder, ctrl = env
+        node, claim = add_node(store, clock, "sick-4", unhealthy=True)
+        claim.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        claim.metadata.annotations[
+            wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
+        ] = "5.0"
+        store.apply(claim)
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        live = store.get("NodeClaim", "sick-4-claim")
+        assert live.metadata.annotations[
+            wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
+        ] == "5.0"
+
+    def test_circuit_breaker_per_nodepool(self, env):
+        """'should ignore unhealthy nodes if more than 20% ... are
+        unhealthy' — scoped to the node's own NodePool."""
+        clock, store, provider, recorder, ctrl = env
+        sick = []
+        for i in range(5):
+            n, _ = add_node(
+                store, clock, f"cb-{i}", unhealthy=(i < 2)
+            )
+            if i < 2:
+                sick.append(n)
+        # 2 of 5 unhealthy > ceil(20% * 5) = 1 -> blocked
+        clock.step(601.0)
+        ctrl.reconcile(sick[0])
+        assert store.get("NodeClaim", "cb-0-claim").metadata.deletion_timestamp is None
+        assert recorder.calls("NodeRepairBlocked") == 1
+        # a DIFFERENT healthy pool is not affected by workers' sickness
+        store.create(nodepool("other"))
+        other_sick, _ = add_node(
+            store, clock, "ob-1", unhealthy=True, since=clock.now(), pool="other"
+        )
+        clock.step(601.0)
+        ctrl.reconcile(other_sick)
+        live = store.try_get("NodeClaim", "ob-1-claim")
+        assert live is None or live.metadata.deletion_timestamp is not None
+
+    def test_round_up_allows_one_unhealthy_in_small_pools(self, env):
+        """'should consider round up when there is a low number of nodes' —
+        4 nodes: threshold ceil(0.8) = 1, so ONE unhealthy node repairs."""
+        clock, store, provider, recorder, ctrl = env
+        sick_node = None
+        for i in range(4):
+            n, _ = add_node(store, clock, f"ru-{i}", unhealthy=(i == 0))
+            if i == 0:
+                sick_node = n
+        clock.step(601.0)
+        ctrl.reconcile(sick_node)
+        live = store.try_get("NodeClaim", "ru-0-claim")
+        assert live is None or live.metadata.deletion_timestamp is not None
+
+    def test_ignores_budgets_and_do_not_disrupt(self, env):
+        """'should ignore node disruption budgets' + 'should ignore
+        do-not-disrupt on a node' — auto-repair is not voluntary
+        disruption."""
+        from karpenter_tpu.apis.nodepool import Budget
+
+        clock, store, provider, recorder, ctrl = env
+        pool = store.get("NodePool", "workers")
+        pool.spec.disruption.budgets = [Budget(nodes="0")]
+        store.apply(pool)
+        node, _ = add_node(store, clock, "dnd-1", unhealthy=True)
+        node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        store.apply(node)
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        live = store.try_get("NodeClaim", "dnd-1-claim")
+        assert live is None or live.metadata.deletion_timestamp is not None
+
+    def test_disabled_without_feature_gate(self, env):
+        clock, store, provider, recorder, ctrl = env
+        ctrl.enabled = False
+        node, _ = add_node(store, clock, "off-1", unhealthy=True)
+        clock.step(601.0)
+        ctrl.reconcile(node)
+        assert store.get("NodeClaim", "off-1-claim").metadata.deletion_timestamp is None
