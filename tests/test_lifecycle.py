@@ -609,6 +609,105 @@ class TestGCAndExpiration:
         ctrl.reconcile(claim)
         assert store.try_get("NodeClaim", "x-1-claim") is None
 
+    def test_expiration_metric_and_no_double_expire(self, env):
+        """expiration suite — the disrupted counter fires with
+        reason=expired, and an already-deleting claim is not expired again."""
+        from karpenter_tpu.controllers.nodeclaim.gc import _EXPIRED_TOTAL
+
+        clock, store, provider, recorder = env
+        node, claim = node_claim_pair("exp-m")
+        claim.spec.expire_after = 100.0
+        claim.metadata.creation_timestamp = clock.now()
+        claim.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+        store.create(claim)
+        ctrl = ExpirationController(store, clock, recorder)
+        labels = {
+            "reason": "expired",
+            "nodepool": claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, ""),
+            "capacity_type": claim.metadata.labels.get(wk.CAPACITY_TYPE_LABEL_KEY, ""),
+        }
+        before = _EXPIRED_TOTAL.value(labels)
+        clock.step(101.0)
+        ctrl.reconcile(claim)
+        assert _EXPIRED_TOTAL.value(labels) == before + 1
+        # the claim is Terminating (finalizer); a second pass must not
+        # expire it again ('shouldn't expire the same NodeClaim multiple
+        # times')
+        live = store.get("NodeClaim", "exp-m-claim")
+        assert live.metadata.deletion_timestamp is not None
+        ctrl.reconcile(live)
+        assert _EXPIRED_TOTAL.value(labels) == before + 1
+
+    def test_expiration_disabled_when_unset(self, env):
+        clock, store, provider, recorder = env
+        node, claim = node_claim_pair("exp-off")
+        claim.spec.expire_after = None
+        claim.metadata.creation_timestamp = clock.now()
+        store.create(claim)
+        ctrl = ExpirationController(store, clock, recorder)
+        clock.step(1e9)
+        ctrl.reconcile(claim)
+        assert store.try_get("NodeClaim", "exp-off-claim") is not None
+
+
+class TestPodEvents:
+    """podevents suite — lastPodEvent stamping with the 10s dedupe window
+    (podevents/controller.go:54-120)."""
+
+    def _env(self, env):
+        from karpenter_tpu.controllers.nodeclaim.gc import PodEventsController
+
+        clock, store, provider, recorder = env
+        return clock, store, PodEventsController(store, clock)
+
+    def _pair(self, store, clock, name="pe-1"):
+        node, claim = node_claim_pair(name)
+        store.create(claim)
+        store.create(node)
+        pod = bind_pod(unschedulable_pod(name=f"{name}-pod"), node)
+        store.create(pod)
+        return node, claim, pod
+
+    def test_sets_last_pod_event(self, env):
+        clock, store, ctrl = self._env(env)
+        node, claim, pod = self._pair(store, clock)
+        ctrl.on_pod_event(pod)
+        assert store.get("NodeClaim", "pe-1-claim").status.last_pod_event_time == clock.now()
+
+    def test_node_missing_is_noop(self, env):
+        clock, store, ctrl = self._env(env)
+        node, claim, pod = self._pair(store, clock)
+        pod.spec.node_name = "no-such-node"
+        ctrl.on_pod_event(pod)  # must not raise
+        assert store.get("NodeClaim", "pe-1-claim").status.last_pod_event_time == 0.0
+
+    def test_claim_missing_is_noop(self, env):
+        clock, store, ctrl = self._env(env)
+        node, claim, pod = self._pair(store, clock)
+        claim.metadata.finalizers = []
+        store.apply(claim)
+        store.delete(claim)
+        ctrl.on_pod_event(pod)  # must not raise
+
+    def test_dedupes_within_window_then_updates(self, env):
+        from karpenter_tpu.controllers.nodeclaim.gc import POD_EVENT_DEDUPE
+
+        clock, store, ctrl = self._env(env)
+        node, claim, pod = self._pair(store, clock)
+        ctrl.on_pod_event(pod)
+        first = store.get("NodeClaim", "pe-1-claim").status.last_pod_event_time
+        clock.step(POD_EVENT_DEDUPE / 2)
+        ctrl.on_pod_event(pod)
+        assert store.get("NodeClaim", "pe-1-claim").status.last_pod_event_time == first
+        clock.step(POD_EVENT_DEDUPE)
+        ctrl.on_pod_event(pod)
+        assert (
+            store.get("NodeClaim", "pe-1-claim").status.last_pod_event_time
+            == clock.now()
+        )
+
+
+class TestGCContinued:
     def test_gc_orphaned_instance(self, env):
         clock, store, provider, recorder = env
         orphan = NodeClaim(metadata=ObjectMeta(name="orphan"))
