@@ -131,7 +131,13 @@ class HashController:
                 predicate=lambda c: c.metadata.labels.get(wk.NODEPOOL_LABEL_KEY)
                 == pool.metadata.name,
             ):
-                claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = current
+                # a claim already judged Drifted (either way) keeps its old
+                # hash: the algorithm changed, so its drift verdict can't be
+                # re-derived (hash/controller.go:108-114)
+                if claim.get_condition("Drifted") is None:
+                    claim.metadata.annotations[
+                        wk.NODEPOOL_HASH_ANNOTATION_KEY
+                    ] = current
                 claim.metadata.annotations[
                     wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
                 ] = NODEPOOL_HASH_VERSION

@@ -781,6 +781,69 @@ class TestNodePoolControllers:
         ReadinessController(store, clock).reconcile(pool)
         assert pool.condition_is_true("Ready")
 
+    def test_hash_static_vs_behavior_fields(self, env):
+        """hash suite — static template fields change the hash; behavior
+        fields (disruption settings, limits, weight) must not."""
+        clock, store, provider, recorder = env
+        pool = nodepool("h-1")
+        store.create(pool)
+        ctrl = HashController(store)
+        ctrl.reconcile(pool)
+        h0 = pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY]
+        pool.spec.disruption.consolidate_after = 300.0
+        pool.spec.weight = 50
+        ctrl.reconcile(pool)
+        assert pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == h0
+        pool.spec.template.labels["team"] = "infra"
+        ctrl.reconcile(pool)
+        assert pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] != h0
+
+    def test_hash_version_migration_backfills_claims(self, env):
+        """hash suite — a hash-version bump restamps the pool and backfills
+        undrifted claims (so the algorithm change doesn't spuriously drift
+        them), while an already-Drifted claim keeps its old hash."""
+        from karpenter_tpu.apis.nodepool import NODEPOOL_HASH_VERSION
+
+        clock, store, provider, recorder = env
+        pool = nodepool("h-2")
+        store.create(pool)
+        ctrl = HashController(store)
+        ctrl.reconcile(pool)
+        # simulate objects stamped by an OLDER karpenter version
+        pool.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+        pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "old-algo-hash"
+        _, fresh = node_claim_pair("h2-fresh", pool="h-2")
+        fresh.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "old-algo-hash"
+        fresh.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+        store.create(fresh)
+        _, drifted = node_claim_pair("h2-drifted", pool="h-2")
+        drifted.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "old-algo-hash"
+        drifted.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+        drifted.set_condition("Drifted", "True", now=clock.now())
+        store.create(drifted)
+        ctrl.reconcile(pool)
+        current = pool.static_hash()
+        assert pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == current
+        assert (
+            pool.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY]
+            == NODEPOOL_HASH_VERSION
+        )
+        fresh = store.get("NodeClaim", "h2-fresh-claim")
+        assert fresh.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == current
+        assert (
+            fresh.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY]
+            == NODEPOOL_HASH_VERSION
+        )
+        drifted = store.get("NodeClaim", "h2-drifted-claim")
+        assert (
+            drifted.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY]
+            == "old-algo-hash"
+        )
+        assert (
+            drifted.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY]
+            == NODEPOOL_HASH_VERSION
+        )
+
     def test_validation_rejects_bad_budget(self, env):
         clock, store, provider, recorder = env
         from karpenter_tpu.apis.nodepool import Budget
