@@ -781,6 +781,48 @@ class TestNodePoolControllers:
         ReadinessController(store, clock).reconcile(pool)
         assert pool.condition_is_true("Ready")
 
+    def test_counter_tracks_node_lifecycle(self, env):
+        """counter suite — the nodepool resource counter rises as nodes
+        join, falls when one is deleted, and zeroes out when all are gone."""
+        clock, store, provider, recorder = env
+        cluster = Cluster(clock, store, provider)
+        informer = StateInformer(store, cluster)
+        pool = store.create(nodepool("cnt-1"))
+        ctrl = CounterController(store, cluster)
+        ctrl.reconcile(pool)
+        assert pool.status.node_count == 0
+        assert pool.status.resources.get("cpu", 0.0) == 0.0
+        pairs = []
+        for i in range(2):
+            node, claim = node_claim_pair(f"cnt-{i}", pool="cnt-1")
+            store.create(claim)
+            store.create(node)
+            pairs.append((node, claim))
+        informer.flush()
+        ctrl.reconcile(pool)
+        assert pool.status.node_count == 2
+        cpu_two = pool.status.resources["cpu"]
+        assert cpu_two > 0.0
+        # delete one pair
+        node, claim = pairs[0]
+        for obj in (claim, node):
+            obj.metadata.finalizers = []
+            store.apply(obj)
+            store.delete(obj)
+        informer.flush()
+        ctrl.reconcile(pool)
+        assert pool.status.node_count == 1
+        assert pool.status.resources["cpu"] == cpu_two / 2
+        node, claim = pairs[1]
+        for obj in (claim, node):
+            obj.metadata.finalizers = []
+            store.apply(obj)
+            store.delete(obj)
+        informer.flush()
+        ctrl.reconcile(pool)
+        assert pool.status.node_count == 0
+        assert pool.status.resources.get("cpu", 0.0) == 0.0
+
     def test_hash_static_vs_behavior_fields(self, env):
         """hash suite — static template fields change the hash; behavior
         fields (disruption settings, limits, weight) must not."""
