@@ -40,6 +40,7 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             healthy=operator.healthy,
             ready=operator.healthy,
             enable_profiling=options.enable_profiling,
+            solverd_stats=operator.solver_stats,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())

@@ -76,4 +76,9 @@ class MetricsCloudProvider:
         return self._inner.name()
 
     def __getattr__(self, attr):
+        # guard the delegate attribute itself: during unpickling __getattr__
+        # runs before __dict__ is restored, and delegating a missing _inner
+        # to itself recurses forever
+        if attr == "_inner":
+            raise AttributeError(attr)
         return getattr(self._inner, attr)

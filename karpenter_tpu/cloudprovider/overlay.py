@@ -29,4 +29,9 @@ class OverlayedCloudProvider:
         )
 
     def __getattr__(self, name):
+        # see MetricsCloudProvider.__getattr__: never delegate the delegate
+        # attribute itself (unpickling calls __getattr__ before __dict__ is
+        # restored and would recurse)
+        if name == "_inner":
+            raise AttributeError(name)
         return getattr(self._inner, name)

@@ -72,10 +72,16 @@ def simulate_scheduling(
     }
 
     # simulations are silent (the reference's NopLogger injection,
-    # helpers.go:102,115): consolidation runs hundreds per pass
+    # helpers.go:102,115): consolidation runs hundreds per pass. Routing
+    # through the provisioner's solverd client lets simulations coalesce
+    # into the same device batches as provisioning solves.
+    from karpenter_tpu.solverd import KIND_SIMULATE
+
     with klog.nop():
         scheduler = provisioner.new_scheduler(pods, state_nodes)
-        results = scheduler.solve(pods, timeout=60.0)
+        results = provisioner.solver.solve(
+            KIND_SIMULATE, scheduler, pods, timeout=60.0
+        )
     results.truncate_instance_types()
     # Pods landing on uninitialized nodes are speculative — fail them so
     # consolidation doesn't rely on capacity that may never materialize.

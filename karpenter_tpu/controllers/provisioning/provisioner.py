@@ -140,6 +140,7 @@ class Provisioner:
         clock: Clock,
         options: Optional[Options] = None,
         engine_factory=None,
+        solver=None,
     ):
         self.store = store
         self.cloud_provider = cloud_provider
@@ -161,6 +162,15 @@ class Provisioner:
                 shard_devices=self.options.solver_pod_shard_axis
             )
         self.engine_factory = engine_factory or None
+        # Every solve — provisioning batches here and the disruption
+        # controllers' simulations (disruption/helpers.py) — goes through
+        # the solverd client so concurrent requests coalesce into shared
+        # device batches and overload sheds with typed rejections.
+        if solver is None:
+            from karpenter_tpu.solverd import build_solver
+
+            solver = build_solver(self.options, clock)
+        self.solver = solver
 
     def trigger(self, uid: str) -> None:
         self.batcher.trigger(uid)
@@ -341,7 +351,11 @@ class Provisioner:
                 {p: NoNodePoolsError("no nodepools found") for p in pods}, {}, {}
             )
             return None
-        results = scheduler.solve(pods, timeout=SOLVE_TIMEOUT)
+        from karpenter_tpu.solverd import KIND_SOLVE
+
+        results = self.solver.solve(
+            KIND_SOLVE, scheduler, pods, timeout=SOLVE_TIMEOUT
+        )
         results.truncate_instance_types()
         self.cluster.mark_pod_scheduling_decisions(
             results.pod_errors,

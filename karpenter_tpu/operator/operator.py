@@ -314,13 +314,20 @@ class Operator:
 
     def shutdown(self) -> None:
         """Clean shutdown: release the leader lease so a standby replica
-        takes over immediately instead of waiting out the lease duration."""
+        takes over immediately instead of waiting out the lease duration,
+        and close the solver client (fails queued solves with typed
+        rejections instead of stranding their waiters)."""
         self.elector.release()
+        self.provisioner.solver.close()
 
     # -- observability ------------------------------------------------------
 
     def metrics_text(self) -> str:
         return global_registry.expose()
+
+    def solver_stats(self) -> dict:
+        """solverd introspection for /debug/solverd (operator/serving.py)."""
+        return self.provisioner.solver.stats()
 
     def healthy(self) -> bool:
         return True

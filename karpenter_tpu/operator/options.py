@@ -63,6 +63,14 @@ class Options:
     # TPU-solver knobs (ours, not the reference's)
     solver_backend: str = "tpu"  # "tpu" | "host"
     solver_pod_shard_axis: int = 1  # devices to shard the pod axis over
+    # solverd: the batched solver service fronting every solve/simulation
+    # (karpenter_tpu/solverd). "inprocess" runs the service inside the
+    # operator; "socket" forwards solves to a sidecar daemon
+    # (python -m karpenter_tpu.solverd) at solver_daemon_address.
+    solver_transport: str = "inprocess"  # "inprocess" | "socket"
+    solver_daemon_address: str = ""  # "host:port" or unix socket path
+    solverd_queue_depth: int = 256  # admission queue depth (shed past it)
+    solverd_coalesce_window: float = 0.0  # seconds the batch leader waits
 
     @classmethod
     def parse(cls, argv: Optional[list[str]] = None, env: Optional[dict] = None) -> "Options":
@@ -98,6 +106,10 @@ class Options:
         parser.add_argument("--feature-gates", dest="feature_gates_raw")
         parser.add_argument("--solver-backend")
         parser.add_argument("--solver-pod-shard-axis", type=int)
+        parser.add_argument("--solver-transport")
+        parser.add_argument("--solver-daemon-address")
+        parser.add_argument("--solverd-queue-depth", type=int)
+        parser.add_argument("--solverd-coalesce-window", type=float)
         ns = parser.parse_args(argv)
 
         opts = cls()
@@ -112,6 +124,8 @@ class Options:
             "min_values_policy": "MIN_VALUES_POLICY",
             "cluster_name": "CLUSTER_NAME",
             "solver_backend": "SOLVER_BACKEND",
+            "solver_transport": "SOLVER_TRANSPORT",
+            "solver_daemon_address": "SOLVER_DAEMON_ADDRESS",
         }
         for f in fields(cls):
             if f.name == "feature_gates":

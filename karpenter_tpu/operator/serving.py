@@ -26,11 +26,15 @@ class ServingConfig:
         healthy: Callable[[], bool],
         ready: Callable[[], bool],
         enable_profiling: bool = False,
+        solverd_stats: Optional[Callable[[], dict]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
         self.ready = ready
         self.enable_profiling = enable_profiling
+        # solverd introspection (queue depth, batches, coalesce stats);
+        # served at /debug/solverd when wired (operator.solver_stats)
+        self.solverd_stats = solverd_stats
 
 
 def _profile_sample(seconds: float, interval: float = 0.01) -> str:
@@ -106,6 +110,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/readyz":
                 ok = cfg.ready()
                 self._respond(200 if ok else 500, "ok" if ok else "not ready")
+            elif url.path == "/debug/solverd" and cfg.solverd_stats is not None:
+                import json
+
+                self._respond(
+                    200, json.dumps(cfg.solverd_stats()), "application/json"
+                )
             elif url.path == "/debug/stacks" and cfg.enable_profiling:
                 self._respond(200, _stacks())
             elif url.path == "/debug/profile" and cfg.enable_profiling:

@@ -89,6 +89,14 @@ _FALLBACKS_CTR = global_registry.counter(
     "karpenter_scheduler_device_fallbacks_total",
     "scheduling solves that fell back to the host loop",
 )
+# Joint-mask device sweeps: each increment is one batched [P, I] feasibility
+# cube dispatch over fresh joint requirement sets. solverd's coalescer uses
+# this to prove concurrent solves sharing an engine merged into ONE batch.
+JOINT_SWEEPS = 0
+_JOINT_SWEEPS_CTR = global_registry.counter(
+    "karpenter_solver_joint_sweeps_total",
+    "batched joint-requirement feasibility sweeps dispatched to the device path",
+)
 
 # Tests set this to make simulation bugs fail loudly instead of silently
 # falling back to the host loop.
@@ -111,6 +119,20 @@ _SIG_CAP = 200_000
 # engine-shared cross-solve caches (joint requirement masks, family
 # transitions) share one cap; see set_memory_budget
 _ENGINE_CACHE_CAP = 100_000
+
+
+def _evict_lru(cache: dict, cap: int) -> None:
+    """Trim an engine-shared cache to ~90% of `cap`, dropping the LEAST
+    recently touched entries. Python dicts iterate in insertion order and
+    every cache hit reinserts its entry at the tail, so iteration order IS
+    recency order — the head is the coldest entry. Unlike the previous
+    wholesale clear(), hitting the cap costs only the cold tail, never the
+    warm working set."""
+    if len(cache) <= cap:
+        return
+    drop = len(cache) - (cap - cap // 10)
+    for k in list(itertools.islice(iter(cache), drop)):
+        del cache[k]
 
 
 def set_memory_budget(limit_mib: int) -> None:
@@ -471,7 +493,7 @@ class _NativeDriver:
         self.claim_meta: list[str] = []  # hostname per claim index
         self.err_by_idx: dict[int, Exception] = {}
         self.timeout_idx: set[int] = set()
-        self._pack_cache: dict[int, tuple] = {}
+        self._pack_cache: dict[tuple[bytes, bytes], tuple] = {}
         ctx = self.lib.kt_new(
             len(self.pods),
             G,
@@ -508,7 +530,13 @@ class _NativeDriver:
         nat = self.nat
         self.claim_meta.append(hostname)
         if reusable:
-            cached = self._pack_cache.get(id(candidate))
+            # value fingerprint, not id(): object ids recycle after GC, so a
+            # recycled candidate array could hit a stale entry. The fingerprint
+            # must cover BOTH arrays — two (template, group) openings can share
+            # a candidate mask yet differ in fitting u_ids. Value keying also
+            # lets value-identical openings share one encoding.
+            cache_key = (candidate.tobytes(), np.ascontiguousarray(u_ids).tobytes())
+            cached = self._pack_cache.get(cache_key)
             if cached is None:
                 mask = self._pack(candidate)
                 u32 = np.ascontiguousarray(u_ids, dtype=np.int32)
@@ -522,9 +550,8 @@ class _NativeDriver:
                     len(u32),
                     mask,
                     u32,
-                    candidate,
                 )
-                self._pack_cache[id(candidate)] = cached
+                self._pack_cache[cache_key] = cached
             mask_ptr, u32_ptr, n_u = cached[0], cached[1], cached[2]
         else:
             mask = self._pack(candidate)
@@ -715,9 +742,9 @@ class _DeviceSolve:
         # joint requirement-set masks: frozenset(row ids) -> (compat, offer).
         # Shared on the ENGINE across solves: steady-state provisioner
         # passes re-derive identical joints, and masks are pure content
-        # functions (rows are interned per engine). Bounded below.
-        if len(e.solver_joint_cache) > _ENGINE_CACHE_CAP:
-            e.solver_joint_cache.clear()
+        # functions (rows are interned per engine). LRU-bounded: _joint_masks
+        # reinserts on every hit, so eviction sheds only cold entries.
+        _evict_lru(e.solver_joint_cache, _ENGINE_CACHE_CAP)
         self.joint_cache = e.solver_joint_cache
         # requirement-set families: frozenset(row ids) -> id, plus the
         # canonical hostname-free Requirements per id and the memoized join
@@ -1088,34 +1115,30 @@ class _DeviceSolve:
             for name, v in s.daemon_overhead[nct].items():
                 self.usage0_f[ti, self.dims[name]] = v
         # Joint (template x group) requirement sets, evaluated in ONE batched
-        # device sweep — the [T*G, I] membership-matmul cube. Degenerate
-        # solves with a huge distinct-shape count fall back to lazy per-pair
-        # host evaluation (still exact) to bound the batch.
-        if T * G <= 8192:
-            row_sets: list[list[int]] = []
-            reqs_list: list[Requirements] = []
-            keysets: list[frozenset] = []
-            for ti in range(T):
-                for gi in range(G):
-                    tg = self._tg(ti, gi)
-                    if tg is None:
-                        continue
+        # device sweep — the [T*G, I] membership-matmul cube. Shared with
+        # solverd's coalescer: prime_joint_masks is the single sweep
+        # implementation, _joint_pairs the single domain enumeration.
+        pairs = self._joint_pairs()
+        if pairs is not None:
+            prime_joint_masks(e, pairs)
+
+    def _joint_pairs(self) -> Optional[list[tuple]]:
+        """All compatible (template x group) joint (rows, Requirements)
+        pairs — this solve's sweep domain. None for degenerate solves with a
+        huge distinct-shape count, which fall back to lazy per-pair host
+        evaluation (still exact) to bound the batch."""
+        T = len(self.s.nodeclaim_templates)
+        G = len(self.groups)
+        if T * G > 8192:
+            return None
+        out: list[tuple] = []
+        for ti in range(T):
+            for gi in range(G):
+                tg = self._tg(ti, gi)
+                if tg is not None:
                     joint, rows = tg
-                    if rows not in self.joint_cache:
-                        self.joint_cache[rows] = None  # reserve
-                        row_sets.append(list(rows))
-                        reqs_list.append(joint)
-                        keysets.append(rows)
-            if row_sets:
-                requests = np.zeros((len(row_sets), self.D), dtype=np.float32)
-                fz = e.feasibility(row_sets, requests, e.key_presence(reqs_list))
-                for i, rows in enumerate(keysets):
-                    # copy: these persist on the engine across solves, and a
-                    # row VIEW would pin the whole padded sweep matrix alive
-                    self.joint_cache[rows] = (
-                        fz.compat[i].copy(),
-                        fz.has_offering[i].copy(),
-                    )
+                    out.append((rows, joint))
+        return out
 
     _MISSING = object()
 
@@ -1142,11 +1165,16 @@ class _DeviceSolve:
     # -- joint masks ---------------------------------------------------------
 
     def _joint_masks(self, rows: frozenset, reqs: Requirements) -> tuple:
-        got = self.joint_cache.get(rows)
+        cache = self.joint_cache
+        got = cache.get(rows)
         if got is None:
             keys = [r.key for r in reqs if r.key != wk.LABEL_HOSTNAME]
             got = self.engine.masks_for_rows(list(rows), keys)
-            self.joint_cache[rows] = got
+        else:
+            # LRU touch: reinsertion moves the entry to the recency tail so
+            # _evict_lru sheds cold entries first
+            del cache[rows]
+        cache[rows] = got
         return got
 
     # -- existing nodes (addToExistingNode, scheduler.go:451-473) ------------
@@ -1375,8 +1403,11 @@ class _DeviceSolve:
                     # is re-added by the consumers that need it. Shared
                     # read-only across solves — callers copy.
                     cached = (self._NARROW, rows, self._sans_hostname(joint))
-            if len(self.engine.solver_fam_trans) > _ENGINE_CACHE_CAP:
-                self.engine.solver_fam_trans.clear()
+            _evict_lru(self.engine.solver_fam_trans, _ENGINE_CACHE_CAP)
+            self.engine.solver_fam_trans[ckey] = cached
+        else:
+            # LRU touch (see _evict_lru): keep steady-state transitions warm
+            del self.engine.solver_fam_trans[ckey]
             self.engine.solver_fam_trans[ckey] = cached
         kind, rows, joint = cached
         if kind == self._NARROW:
@@ -2001,3 +2032,76 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         pod_errors=solve.pod_errors,
         timed_out=solve.timed_out,
     )
+
+
+# -- solverd coalescing hooks -------------------------------------------------
+
+
+def collect_joint_rowsets(scheduler, pods: Sequence[Pod]) -> list[tuple]:
+    """Enumerate the joint (template x group) requirement row-sets a device
+    solve of `pods` would sweep, WITHOUT dispatching the sweep. Pure host
+    work: grouping plus requirement algebra, all of it shared with the
+    subsequent real solve through the scheduler/engine caches.
+
+    Returns [(rows_frozenset, joint Requirements)] for pairs not yet in the
+    engine's joint cache, or [] when the solve wouldn't take the device path
+    (ineligible shape, tiny batch, degenerate shape count). solverd's
+    coalescer unions these across concurrent requests so several solves
+    share ONE batched device sweep (prime_joint_masks)."""
+    if scheduler.engine is None or not eligible(scheduler, pods):
+        return []
+    try:
+        solve = _DeviceSolve(scheduler, pods)
+        if solve._group_pods() is None:
+            return []
+        pairs = solve._joint_pairs()
+        if pairs is None:
+            # degenerate shape counts evaluate joints lazily per pair
+            # (_prepare_templates): there is no sweep to coalesce
+            return []
+        return [
+            (rows, joint)
+            for rows, joint in pairs
+            if rows not in solve.joint_cache
+        ]
+    except Exception:  # noqa: BLE001 — priming is best-effort, never fatal
+        return []
+
+
+def prime_joint_masks(engine: "CatalogEngine", pairs: Sequence[tuple]) -> int:
+    """Fill `engine.solver_joint_cache` for the given (rows, joint
+    Requirements) pairs in ONE batched device sweep; solves that follow find
+    their masks warm and dispatch nothing. Returns the number of fresh
+    entries primed (0 → no device call was made).
+
+    On sweep failure the reserved None placeholders stay behind — exact but
+    slower: _joint_masks computes those entries host-side on demand."""
+    global JOINT_SWEEPS
+    fresh_rows: list[frozenset] = []
+    fresh_reqs: list[Requirements] = []
+    for rows, reqs in pairs:
+        if rows in engine.solver_joint_cache:
+            continue
+        engine.solver_joint_cache[rows] = None  # reserve
+        fresh_rows.append(rows)
+        fresh_reqs.append(reqs)
+    if not fresh_rows:
+        return 0
+    requests = np.zeros(
+        (len(fresh_rows), len(engine.resource_dims)), dtype=np.float32
+    )
+    fz = engine.feasibility(
+        [list(rows) for rows in fresh_rows],
+        requests,
+        engine.key_presence(fresh_reqs),
+    )
+    JOINT_SWEEPS += 1
+    _JOINT_SWEEPS_CTR.inc()
+    for i, rows in enumerate(fresh_rows):
+        # copy: these persist on the engine; a row VIEW would pin the whole
+        # padded sweep matrix alive (same rationale as _prepare_templates)
+        engine.solver_joint_cache[rows] = (
+            fz.compat[i].copy(),
+            fz.has_offering[i].copy(),
+        )
+    return len(fresh_rows)

@@ -1,0 +1,54 @@
+"""solverd: the batched solver service.
+
+The subsystem that lifts the two in-process solvers — provisioning solves
+and consolidation simulations — behind one service with request coalescing
+(concurrent solves sharing a catalog merge their device sweeps into one
+batch), admission control (bounded queue, per-request deadlines, typed
+rejections instead of stalls), and two transports behind one client
+interface: in-process (default, zero-copy) and a length-prefixed
+JSON-over-socket daemon for sidecar deployment where the daemon owns the
+accelerator. See docs/ARCHITECTURE.md.
+"""
+
+from karpenter_tpu.solverd.api import (  # noqa: F401
+    KIND_SIMULATE,
+    KIND_SOLVE,
+    DeadlineExceededError,
+    QueueFullError,
+    SolveRequest,
+    SolverClosedError,
+    SolverRejection,
+    TransportError,
+)
+from karpenter_tpu.solverd.coalescer import Coalescer  # noqa: F401
+from karpenter_tpu.solverd.queue import AdmissionQueue  # noqa: F401
+from karpenter_tpu.solverd.service import SolverService  # noqa: F401
+from karpenter_tpu.solverd.transport import (  # noqa: F401
+    InProcessClient,
+    SocketClient,
+    SolverClient,
+    SolverDaemon,
+)
+
+
+def build_solver(options, clock) -> SolverClient:
+    """The operator's transport selector (operator/options.py): socket mode
+    forwards to the daemon at --solver-daemon-address, else an in-process
+    service tuned by the solverd options."""
+    if getattr(options, "solver_transport", "inprocess") == "socket":
+        address = getattr(options, "solver_daemon_address", "")
+        if not address:
+            # never fall back silently: in-process mode would initialize the
+            # device locally and contend with the sidecar the operator was
+            # meant to defer to
+            raise ValueError(
+                "--solver-transport socket requires --solver-daemon-address"
+            )
+        return SocketClient(address)
+    return InProcessClient(
+        SolverService(
+            clock=clock,
+            max_queue_depth=getattr(options, "solverd_queue_depth", 256),
+            coalesce_window=getattr(options, "solverd_coalesce_window", 0.0),
+        )
+    )

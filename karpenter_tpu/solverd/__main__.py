@@ -1,0 +1,66 @@
+"""Standalone solver daemon: `python -m karpenter_tpu.solverd`.
+
+Runs a SolverDaemon on --listen (host:port or a unix socket path), owning
+the accelerator for every operator replica pointed at it via
+`--solver-transport socket --solver-daemon-address <addr>`. The daemon is
+stateless between requests — each request carries its full solve state —
+so it can restart freely; clients reconnect on the next call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from karpenter_tpu.operator import logging as klog
+from karpenter_tpu.solverd.service import SolverService
+from karpenter_tpu.solverd.transport import SolverDaemon
+from karpenter_tpu.utils.clock import Clock
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="karpenter-solverd")
+    parser.add_argument(
+        "--listen",
+        default="127.0.0.1:9901",
+        help="host:port or unix socket path to serve on",
+    )
+    parser.add_argument(
+        "--queue-depth", type=int, default=256,
+        help="admission queue depth; excess requests are rejected",
+    )
+    parser.add_argument(
+        "--coalesce-window", type=float, default=0.005,
+        help="seconds the batch leader waits for concurrent requests",
+    )
+    parser.add_argument("--log-level", default="info")
+    ns = parser.parse_args(argv)
+    klog.configure(ns.log_level)
+    log = klog.logger("solverd")
+
+    service = SolverService(
+        clock=Clock(),
+        max_queue_depth=ns.queue_depth,
+        coalesce_window=ns.coalesce_window,
+    )
+    daemon = SolverDaemon(service, address=ns.listen).start()
+    log.info(
+        "solver daemon listening",
+        address=daemon.address,
+        queue_depth=ns.queue_depth,
+        coalesce_window=ns.coalesce_window,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        log.info("shutdown requested")
+    finally:
+        daemon.stop()
+        service.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

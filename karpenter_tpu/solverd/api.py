@@ -1,0 +1,58 @@
+"""solverd request/response vocabulary: solve kinds, the request envelope,
+and the typed admission rejections.
+
+The solver service fronts every scheduling solve in the process — the
+provisioner's batch solves and the disruption controllers' consolidation
+simulations — behind one request shape, so both coalesce into the same
+device batches and shed load through the same admission queue.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+KIND_SOLVE = "solve"
+KIND_SIMULATE = "simulate"
+
+
+class SolverRejection(Exception):
+    """Base for typed admission-control rejections: the service refused the
+    request WITHOUT running it. Callers distinguish these from solve errors
+    — a rejection is retryable load-shedding, not a scheduling outcome."""
+
+
+class QueueFullError(SolverRejection):
+    """The admission queue is at depth; the request was shed, not queued."""
+
+
+class DeadlineExceededError(SolverRejection):
+    """The request's deadline passed before execution started (on offer or
+    while waiting in the queue)."""
+
+
+class SolverClosedError(SolverRejection):
+    """The service is shutting down and admits nothing."""
+
+
+class TransportError(Exception):
+    """Socket-transport failure (framing, connection, codec) — distinct from
+    rejections: the daemon may never have seen the request."""
+
+
+@dataclass
+class SolveRequest:
+    """One scheduling solve to run through the service.
+
+    `scheduler` is a fully built Scheduler (the caller owns construction —
+    provisioning and simulation build different cluster views); `pods` is
+    the queue the solve processes. `timeout` bounds the solve itself;
+    `deadline` is an absolute clock time bounding ADMISSION — a request
+    still queued past it is rejected, never run."""
+
+    kind: str
+    scheduler: object
+    pods: Sequence = field(default_factory=list)
+    timeout: Optional[float] = None
+    deadline: Optional[float] = None
+    client: str = ""

@@ -1,0 +1,79 @@
+"""Bounded admission queue: depth-limited, deadline-aware, shed-not-block.
+
+An overloaded solver must reject work instead of stalling the controller
+loop behind it (the reference's controllers assume reconcile passes stay
+bounded). offer() is O(1) and never blocks: a full queue raises
+QueueFullError immediately, a request past its deadline raises
+DeadlineExceededError, and drain() expires queued entries whose deadline
+passed while they waited — expired work is returned separately so the
+service can fail it without executing it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.solverd.api import DeadlineExceededError, QueueFullError
+from karpenter_tpu.utils.clock import Clock
+
+_DEPTH = global_registry.gauge(
+    "karpenter_solverd_queue_depth", "solve requests waiting for a batch"
+)
+_REJECTIONS = global_registry.counter(
+    "karpenter_solverd_rejections_total",
+    "solve requests shed by admission control",
+    labels=["reason"],
+)
+
+
+class AdmissionQueue:
+    def __init__(self, clock: Clock, max_depth: int = 256):
+        self.clock = clock
+        self.max_depth = max_depth
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+
+    def offer(self, entry) -> None:
+        """Admit `entry` (anything with a `.request`) or raise a typed
+        rejection. Never blocks."""
+        now = self.clock.now()
+        deadline = entry.request.deadline
+        if deadline is not None and now > deadline:
+            _REJECTIONS.inc({"reason": "deadline"})
+            raise DeadlineExceededError(
+                f"deadline passed {now - deadline:.3f}s before admission"
+            )
+        with self._lock:
+            if len(self._items) >= self.max_depth:
+                _REJECTIONS.inc({"reason": "queue_full"})
+                raise QueueFullError(
+                    f"admission queue at depth {self.max_depth}"
+                )
+            entry.enqueued_at = now
+            self._items.append(entry)
+            _DEPTH.set(float(len(self._items)))
+
+    def drain(self) -> tuple[list, list]:
+        """Take everything queued: (ready, expired). Entries whose deadline
+        passed while queued come back in `expired` — the caller fails them
+        with DeadlineExceededError instead of running them."""
+        with self._lock:
+            taken = list(self._items)
+            self._items.clear()
+            _DEPTH.set(0.0)
+        now = self.clock.now()
+        ready, expired = [], []
+        for entry in taken:
+            deadline = entry.request.deadline
+            if deadline is not None and now > deadline:
+                _REJECTIONS.inc({"reason": "deadline"})
+                expired.append(entry)
+            else:
+                ready.append(entry)
+        return ready, expired
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._items)

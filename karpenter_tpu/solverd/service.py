@@ -1,0 +1,192 @@
+"""SolverService: the solver daemon's core — admission, coalescing window,
+batch execution.
+
+Concurrency model is leader/follower, the batching discipline the
+provisioner's Batcher applies to pods lifted to solve requests: the first
+caller into an idle service becomes the batch leader, holds the coalescing
+window open (idle-window semantics — clock.sleep, so FakeClock tests pay no
+real time), then drains the admission queue and executes everything that
+arrived as ONE coalesced batch. Callers that arrive while a batch executes
+queue for the next one; callers past the queue depth or their deadline are
+shed with typed rejections (api.py) instead of blocking the controller
+loop.
+
+The service is transport-agnostic: the in-process client calls solve()
+directly on the operator thread (window 0 → identical behavior to calling
+scheduler.solve, minus nothing), and the socket daemon calls it from one
+thread per connection — which is exactly how concurrent clients coalesce.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.solverd.api import SolveRequest, SolverClosedError
+from karpenter_tpu.solverd.coalescer import Coalescer
+from karpenter_tpu.solverd.queue import AdmissionQueue
+from karpenter_tpu.utils.clock import Clock
+
+_REQUESTS = global_registry.counter(
+    "karpenter_solverd_requests_total",
+    "solve requests admitted",
+    labels=["kind"],
+)
+_BATCH_SIZE = global_registry.histogram(
+    "karpenter_solverd_batch_size",
+    "requests per coalesced batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+_QUEUE_LATENCY = global_registry.histogram(
+    "karpenter_solverd_queue_latency_seconds",
+    "admission-to-execution wait per request",
+)
+
+
+class _Entry:
+    __slots__ = ("request", "result", "error", "event", "enqueued_at", "done")
+
+    def __init__(self, request: SolveRequest):
+        self.request = request
+        self.result = None
+        self.error: Optional[Exception] = None
+        self.event = threading.Event()
+        self.enqueued_at = 0.0
+        self.done = False
+
+    def finish(self) -> None:
+        self.done = True
+        self.event.set()
+
+
+class SolverService:
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        max_queue_depth: int = 256,
+        coalesce_window: float = 0.0,
+        coalescer: Optional[Coalescer] = None,
+    ):
+        self.clock = clock or Clock()
+        self.queue = AdmissionQueue(self.clock, max_depth=max_queue_depth)
+        self.coalescer = coalescer or Coalescer()
+        self.coalesce_window = coalesce_window
+        self._lock = threading.Lock()
+        self._executing = False
+        self._closed = False
+        # cumulative stats for /debug/solverd (metrics carry the histograms)
+        self.batches = 0
+        self.requests = 0
+        self.rejected = 0
+        self.max_batch_size = 0
+        self.last_batch_seconds = 0.0
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, request: SolveRequest) -> _Entry:
+        """Admit a request; raises a typed SolverRejection when shed. The
+        returned entry completes on a later run_pending()/solve() drain."""
+        if self._closed:
+            raise SolverClosedError("solver service is closed")
+        entry = _Entry(request)
+        try:
+            self.queue.offer(entry)
+        except Exception:
+            self.rejected += 1
+            raise
+        self.requests += 1
+        _REQUESTS.inc({"kind": request.kind})
+        return entry
+
+    def solve(self, request: SolveRequest):
+        """Admit + execute, returning the solve's Results (or raising its
+        error / a typed rejection). Safe from many threads: one becomes the
+        batch leader, the rest ride its batch or the next."""
+        entry = self.submit(request)
+        while True:
+            leader = False
+            with self._lock:
+                if entry.done:
+                    break
+                if not self._executing:
+                    self._executing = True
+                    leader = True
+            if leader:
+                try:
+                    if self.coalesce_window > 0:
+                        # hold the window open so concurrent callers land in
+                        # this batch; FakeClock steps instead of sleeping
+                        self.clock.sleep(self.coalesce_window)
+                    self.run_pending()
+                finally:
+                    with self._lock:
+                        self._executing = False
+            else:
+                # finish() sets the entry's event — precise wakeup when the
+                # leader completes it; the short timeout re-checks leadership
+                # in case this entry missed the leader's drain
+                entry.event.wait(timeout=0.05)
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    # -- execution -----------------------------------------------------------
+
+    def run_pending(self) -> int:
+        """Drain the queue and execute one coalesced batch synchronously.
+        Returns the number of requests executed."""
+        from karpenter_tpu.solverd.api import DeadlineExceededError
+
+        ready, expired = self.queue.drain()
+        for entry in expired:
+            self.rejected += 1
+            entry.error = DeadlineExceededError(
+                "deadline passed while queued; request not executed"
+            )
+            entry.finish()
+        if not ready:
+            return 0
+        now = self.clock.now()
+        for entry in ready:
+            _QUEUE_LATENCY.observe(max(0.0, now - entry.enqueued_at))
+        _BATCH_SIZE.observe(float(len(ready)))
+        self.batches += 1
+        self.max_batch_size = max(self.max_batch_size, len(ready))
+        started = time.perf_counter()
+        try:
+            self.coalescer.execute(ready)
+        finally:
+            for entry in ready:
+                if entry.result is None and entry.error is None:
+                    entry.error = RuntimeError("solve batch aborted")
+                entry.finish()
+        self.last_batch_seconds = time.perf_counter() - started
+        return len(ready)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        # fail anything still queued rather than stranding its waiters
+        ready, expired = self.queue.drain()
+        for entry in ready + expired:
+            entry.error = SolverClosedError("solver service closed")
+            entry.finish()
+
+    def stats(self) -> dict:
+        from karpenter_tpu.ops import ffd
+
+        return {
+            "transport": "inprocess",
+            "queue_depth": self.queue.depth(),
+            "queue_cap": self.queue.max_depth,
+            "coalesce_window": self.coalesce_window,
+            "requests": self.requests,
+            "batches": self.batches,
+            "rejected": self.rejected,
+            "max_batch_size": self.max_batch_size,
+            "joint_sweeps": ffd.JOINT_SWEEPS,
+            "device_solves": ffd.DEVICE_SOLVES,
+            "device_fallbacks": ffd.DEVICE_FALLBACKS,
+        }

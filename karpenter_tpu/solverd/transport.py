@@ -1,0 +1,432 @@
+"""solverd transports: one client interface, two implementations.
+
+In-process (default): the client calls the SolverService directly — zero
+copy, the operator loop's solves and simulations go through the same
+admission/coalescing discipline with no serialization.
+
+Socket (sidecar mode): a length-prefixed JSON protocol over TCP or a unix
+socket. Each frame is a 4-byte big-endian length followed by a JSON
+envelope; the solve state (scheduler, pods, catalog) rides inside the
+envelope as a base64 pickle — JSON carries the control plane (op, kind,
+timeout, deadline, typed error identity) so rejections stay typed across
+the wire without unpickling arbitrary exceptions.
+
+TRUST MODEL: the payload pickle means deserialization executes code on the
+receiving side, and the protocol carries no authentication. Both ends must
+trust each other fully — the supported deployment is a unix socket or
+loopback TCP between an operator and its sidecar on the same host/pod; the
+daemon logs a warning when bound to a non-loopback address.
+
+The daemon owns the accelerator: clients strip their CatalogEngine before
+pickling (device arrays don't travel) and send the catalog's instance
+types instead; the daemon rebuilds/content-caches an engine per distinct
+catalog and attaches it before solving. Decisions are transport-invariant
+by construction — the device path reproduces the host loop bit-for-bit
+(ops/ffd.py), so whether an engine attaches on the client, the daemon, or
+not at all, the node decisions are identical.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import pickle
+import socket
+import struct
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from karpenter_tpu.solverd import api
+from karpenter_tpu.solverd.api import SolveRequest, TransportError
+from karpenter_tpu.solverd.service import SolverService
+
+WIRE_VERSION = 1
+_MAX_FRAME = 256 * 1024 * 1024  # defensive cap on frame length
+
+# typed rejections cross the wire by NAME so the client re-raises the same
+# class the in-process transport would
+_ERROR_TYPES = {
+    cls.__name__: cls
+    for cls in (
+        api.SolverRejection,
+        api.QueueFullError,
+        api.DeadlineExceededError,
+        api.SolverClosedError,
+    )
+}
+
+
+class SolverClient:
+    """The one interface both transports implement."""
+
+    transport = "none"
+
+    def solve(
+        self,
+        kind: str,
+        scheduler,
+        pods,
+        timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
+    ):
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        return {"transport": self.transport}
+
+    def close(self) -> None:
+        pass
+
+
+class InProcessClient(SolverClient):
+    transport = "inprocess"
+
+    def __init__(self, service: SolverService):
+        self.service = service
+
+    def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+        return self.service.solve(
+            SolveRequest(
+                kind=kind,
+                scheduler=scheduler,
+                pods=list(pods),
+                timeout=timeout,
+                deadline=deadline,
+            )
+        )
+
+    def stats(self) -> dict:
+        return self.service.stats()
+
+    def close(self) -> None:
+        self.service.close()
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, msg: dict) -> None:
+    data = json.dumps(msg).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length > _MAX_FRAME:
+        raise TransportError(f"frame length {length} exceeds cap")
+    data = _recv_exact(sock, length)
+    if data is None:
+        raise TransportError("connection closed mid-frame")
+    return json.loads(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                raise TransportError("connection closed mid-frame")
+            return None  # clean EOF between frames
+        buf += chunk
+    return buf
+
+
+def _pack(obj) -> str:
+    return base64.b64encode(pickle.dumps(obj)).decode("ascii")
+
+
+def _unpack(payload: str):
+    return pickle.loads(base64.b64decode(payload))
+
+
+def parse_address(address: str) -> tuple[str, object]:
+    """"host:port" -> ("tcp", (host, port)); anything else is a unix path."""
+    if ":" in address:
+        host, _, port = address.rpartition(":")
+        return "tcp", (host or "127.0.0.1", int(port))
+    return "unix", address
+
+
+@contextmanager
+def _engine_stripped(scheduler):
+    """Detach the device engine for pickling; yields it for catalog export."""
+    engine = scheduler.engine
+    scheduler.engine = None
+    try:
+        yield engine
+    finally:
+        scheduler.engine = engine
+
+
+class SocketClient(SolverClient):
+    transport = "socket"
+
+    def __init__(self, address: str, connect_timeout: float = 5.0):
+        self.address = address
+        self.connect_timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        family, target = parse_address(self.address)
+        try:
+            if family == "tcp":
+                sock = socket.create_connection(
+                    target, timeout=self.connect_timeout
+                )
+            else:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self.connect_timeout)
+                sock.connect(target)
+        except OSError as e:
+            raise TransportError(f"connect {self.address}: {e}") from e
+        sock.settimeout(None)  # solves are long; the daemon bounds them
+        self._sock = sock
+        return sock
+
+    def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+        with _engine_stripped(scheduler) as engine:
+            payload = _pack(
+                {
+                    "scheduler": scheduler,
+                    "pods": list(pods),
+                    "catalog": list(engine.instance_types) if engine else None,
+                }
+            )
+        msg = {
+            "v": WIRE_VERSION,
+            "op": "solve",
+            "kind": kind,
+            "timeout": timeout,
+            # deadlines cross processes as REMAINING seconds — absolute
+            # clocks don't agree across the socket
+            "deadline_rel": None if deadline is None else max(
+                0.0, deadline - scheduler.clock.now()
+            ),
+            "payload": payload,
+        }
+        with self._lock:
+            sock = self._connect()
+            try:
+                send_frame(sock, msg)
+                reply = recv_frame(sock)
+            except (OSError, TransportError):
+                # one reconnect: the daemon may have restarted between calls
+                self._drop()
+                sock = self._connect()
+                try:
+                    send_frame(sock, msg)
+                    reply = recv_frame(sock)
+                except OSError as e:
+                    self._drop()
+                    raise TransportError(f"solve rpc failed: {e}") from e
+        if reply is None:
+            self._drop()
+            raise TransportError("daemon closed the connection")
+        if not reply.get("ok"):
+            err = reply.get("error", {})
+            cls = _ERROR_TYPES.get(err.get("type"))
+            if cls is not None:
+                raise cls(err.get("message", ""))
+            raise TransportError(
+                f"daemon error {err.get('type')}: {err.get('message')}"
+            )
+        return _unpack(reply["payload"])
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def stats(self) -> dict:
+        """The daemon's service stats (op=stats RPC) so /debug/solverd shows
+        the real queue/batch counters in sidecar mode; falls back to local
+        transport info when the daemon is unreachable."""
+        out = {"transport": "socket", "address": self.address}
+        with self._lock:
+            try:
+                sock = self._connect()
+                send_frame(sock, {"v": WIRE_VERSION, "op": "stats"})
+                reply = recv_frame(sock)
+            except (OSError, TransportError) as e:
+                self._drop()
+                out["error"] = str(e)
+                return out
+        if reply and reply.get("ok"):
+            daemon_stats = dict(reply.get("stats", {}))
+            daemon_stats.update(out)
+            return daemon_stats
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+class SolverDaemon:
+    """The sidecar: a socket front-end on a shared SolverService.
+
+    One daemon thread accepts connections; each connection gets a handler
+    thread that decodes frames and calls service.solve() — so concurrent
+    client connections coalesce into shared device batches exactly like
+    concurrent in-process threads. Engines are rebuilt per distinct catalog
+    content and cached for the daemon's lifetime."""
+
+    def __init__(
+        self,
+        service: SolverService,
+        address: str = "127.0.0.1:0",
+        engine_factory=None,
+    ):
+        self.service = service
+        self.engine_factory = engine_factory or _default_engine_factory()
+        family, target = parse_address(address)
+        if family == "tcp" and target[0] not in ("127.0.0.1", "localhost", "::1"):
+            # the payload is a pickle: deserializing it executes code, so the
+            # protocol carries NO authentication boundary — anyone who can
+            # connect can run code as the daemon. Loopback/unix sockets are
+            # the supported deployment (operator + daemon share a pod/host).
+            from karpenter_tpu.operator import logging as klog
+
+            klog.logger("solverd").warning(
+                "binding a non-loopback address: the solve protocol is "
+                "UNAUTHENTICATED and its payload is a pickle — every peer "
+                "that can connect gains code execution; use a loopback or "
+                "unix socket unless the network is fully trusted",
+                address=address,
+            )
+        self._family = family
+        if family == "tcp":
+            self._srv = socket.create_server(target)
+        else:
+            self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._srv.bind(target)
+            self._srv.listen()
+        self._path = target if family == "unix" else None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # resolved at bind time (port 0 → ephemeral) and kept past stop()
+        if family == "tcp":
+            host, port = self._srv.getsockname()[:2]
+            self.address = f"{host}:{port}"
+        else:
+            self.address = str(self._path)
+
+    def start(self) -> "SolverDaemon":
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="solverd-accept", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_frame(conn)
+                except (TransportError, OSError):
+                    return
+                if msg is None:
+                    return
+                try:
+                    reply = self._process(msg)
+                except Exception as e:  # noqa: BLE001 — keep the conn alive
+                    reply = _error_reply(e)
+                try:
+                    send_frame(conn, reply)
+                except OSError:
+                    return
+
+    def _process(self, msg: dict) -> dict:
+        if msg.get("op") == "stats":
+            return {"ok": True, "stats": self.service.stats()}
+        if msg.get("op") != "solve":
+            return _error_reply(TransportError(f"unknown op {msg.get('op')}"))
+        body = _unpack(msg["payload"])
+        scheduler = body["scheduler"]
+        catalog = body.get("catalog")
+        if catalog:
+            try:
+                scheduler.engine = self.engine_factory(catalog)
+            except Exception:  # noqa: BLE001 — host path is decision-identical
+                scheduler.engine = None
+        deadline_rel = msg.get("deadline_rel")
+        request = SolveRequest(
+            kind=msg.get("kind", api.KIND_SOLVE),
+            scheduler=scheduler,
+            pods=body["pods"],
+            timeout=msg.get("timeout"),
+            deadline=None
+            if deadline_rel is None
+            else self.service.clock.now() + deadline_rel,
+            client="socket",
+        )
+        results = self.service.solve(request)
+        # the result graph references the daemon's engine through the claim
+        # objects — detach before pickling (device arrays don't travel)
+        for nc in results.new_node_claims:
+            nc.engine = None
+        return {"ok": True, "payload": _pack(results)}
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._path:
+            import os
+
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+def _error_reply(e: Exception) -> dict:
+    return {
+        "ok": False,
+        "error": {"type": type(e).__name__, "message": str(e)},
+    }
+
+
+def _default_engine_factory():
+    """Content-cached CatalogEngine builder for the daemon: one engine per
+    distinct catalog (by instance-type fingerprint), encoded once."""
+    from karpenter_tpu.controllers.provisioning.provisioner import (
+        _type_fingerprint,
+    )
+
+    cache: dict[tuple, object] = {}
+
+    def factory(catalog: list):
+        from karpenter_tpu.ops.catalog import CatalogEngine
+
+        key = tuple(_type_fingerprint(it) for it in catalog)
+        engine = cache.get(key)
+        if engine is None:
+            engine = CatalogEngine(catalog)
+            cache[key] = engine
+        return engine
+
+    return factory

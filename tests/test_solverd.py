@@ -1,0 +1,326 @@
+"""solverd: the batched solver service — coalescing, admission control,
+transport parity (ISSUE 1 acceptance criteria)."""
+
+import threading
+
+import pytest
+
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.events.recorder import Recorder
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.scheduler.scheduler import Scheduler
+from karpenter_tpu.scheduler.topology import Topology
+from karpenter_tpu.solverd import (
+    KIND_SIMULATE,
+    KIND_SOLVE,
+    DeadlineExceededError,
+    InProcessClient,
+    QueueFullError,
+    SocketClient,
+    SolveRequest,
+    SolverClosedError,
+    SolverDaemon,
+    SolverService,
+    build_solver,
+)
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import Clock, FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+CATALOG = construct_instance_types()
+
+
+def build_scheduler(engine=None, n_pods=6, cpu="1"):
+    """A minimal, fully deterministic solve scenario over the kwok catalog.
+    Identical arguments build bit-identical scenarios (pinned uids and
+    timestamps) so transport-parity tests can compare decisions exactly."""
+    clock = FakeClock()
+    store = Store(clock=clock)
+    cluster = Cluster(clock, store, cloud_provider=None)
+    informer = StateInformer(store, cluster)
+    recorder = Recorder(clock=clock)
+    pool = nodepool("default")
+    store.create(pool)
+    informer.flush()
+    pods = []
+    for i in range(n_pods):
+        p = unschedulable_pod(name=f"pod-{i:03d}", requests={"cpu": cpu})
+        p.metadata.uid = f"uid-{i:03d}"
+        p.metadata.creation_timestamp = 1000.0 + i
+        store.create(p)
+        pods.append(p)
+    instance_types = {"default": list(CATALOG)}
+    topology = Topology(store, cluster, [], [pool], instance_types, pods)
+    scheduler = Scheduler(
+        store, [pool], cluster, [], topology, instance_types, [],
+        recorder, clock, engine=engine,
+    )
+    return scheduler, pods
+
+
+def decisions(results):
+    """The transport-invariant shape of a solve: per-claim (nodepool, pods,
+    instance-type options) plus failure names."""
+    claims = sorted(
+        (
+            nc.nodepool_name,
+            tuple(sorted(p.metadata.name for p in nc.pods)),
+            tuple(sorted(it.name for it in nc.instance_type_options)),
+        )
+        for nc in results.new_node_claims
+    )
+    errors = sorted(p.metadata.name for p in results.pod_errors)
+    return claims, errors
+
+
+class TestAdmissionControl:
+    def test_queue_full_rejects_not_blocks(self):
+        svc = SolverService(clock=FakeClock(), max_queue_depth=2)
+        reqs = [
+            SolveRequest(KIND_SOLVE, *build_scheduler(n_pods=1), timeout=60.0)
+            for _ in range(3)
+        ]
+        svc.submit(reqs[0])
+        svc.submit(reqs[1])
+        with pytest.raises(QueueFullError):
+            svc.submit(reqs[2])
+        # the shed request did not poison the queue: admitted work executes
+        assert svc.run_pending() == 2
+        assert svc.rejected == 1
+
+    def test_deadline_rejected_at_offer(self):
+        clock = FakeClock()
+        svc = SolverService(clock=clock)
+        scheduler, pods = build_scheduler(n_pods=1)
+        with pytest.raises(DeadlineExceededError):
+            svc.submit(
+                SolveRequest(
+                    KIND_SOLVE, scheduler, pods, deadline=clock.now() - 1.0
+                )
+            )
+
+    def test_deadline_expires_in_queue(self):
+        clock = FakeClock()
+        svc = SolverService(clock=clock)
+        scheduler, pods = build_scheduler(n_pods=1)
+        entry = svc.submit(
+            SolveRequest(KIND_SOLVE, scheduler, pods, deadline=clock.now() + 5.0)
+        )
+        clock.step(10.0)  # deadline passes while queued
+        assert svc.run_pending() == 0  # expired work is NOT executed
+        assert entry.done
+        assert isinstance(entry.error, DeadlineExceededError)
+
+    def test_closed_service_rejects(self):
+        svc = SolverService(clock=FakeClock())
+        svc.close()
+        scheduler, pods = build_scheduler(n_pods=1)
+        with pytest.raises(SolverClosedError):
+            svc.submit(SolveRequest(KIND_SOLVE, scheduler, pods))
+
+
+class TestInProcessTransport:
+    def test_solve_matches_direct_scheduler_solve(self):
+        direct_scheduler, direct_pods = build_scheduler()
+        direct = direct_scheduler.solve(direct_pods, timeout=60.0)
+        scheduler, pods = build_scheduler()
+        client = InProcessClient(SolverService(clock=FakeClock()))
+        via = client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+        assert decisions(via) == decisions(direct)
+
+    def test_solve_error_propagates(self):
+        svc = SolverService(clock=FakeClock())
+
+        class Boom:
+            engine = None
+
+            def solve(self, pods, timeout=None):
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            svc.solve(SolveRequest(KIND_SOLVE, Boom(), []))
+
+    def test_provisioner_routes_through_solverd(self):
+        from helpers import make_provisioner_harness
+
+        clock, store, provider, cluster, informer, prov = (
+            make_provisioner_harness()
+        )
+        assert isinstance(prov.solver, InProcessClient)
+        store.create(nodepool("default"))
+        pod = store.create(unschedulable_pod(requests={"cpu": "1"}))
+        prov.trigger(pod.metadata.uid)
+        informer.flush()
+        clock.step(1.5)
+        results = prov.reconcile()
+        assert results is not None and results.new_node_claims
+        stats = prov.solver.stats()
+        assert stats["requests"] >= 1
+        assert stats["batches"] >= 1
+
+    def test_build_solver_from_options(self):
+        from karpenter_tpu.operator.options import Options
+
+        opts = Options(solverd_queue_depth=7, solverd_coalesce_window=0.25)
+        client = build_solver(opts, FakeClock())
+        assert isinstance(client, InProcessClient)
+        assert client.service.queue.max_depth == 7
+        assert client.service.coalesce_window == 0.25
+        opts = Options(
+            solver_transport="socket", solver_daemon_address="127.0.0.1:19999"
+        )
+        client = build_solver(opts, FakeClock())
+        assert isinstance(client, SocketClient)
+        # socket mode without an address must fail loudly, not silently
+        # fall back to in-process (which would contend for the accelerator)
+        with pytest.raises(ValueError, match="solver-daemon-address"):
+            build_solver(Options(solver_transport="socket"), FakeClock())
+
+
+class TestCoalescing:
+    def test_two_requests_one_device_batch(self, monkeypatch):
+        """Two concurrent solve/simulate requests sharing a catalog merge
+        into a single coalesced batch that dispatches ONE joint-mask device
+        sweep; both results match un-coalesced solves of the same
+        scenarios."""
+        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+        monkeypatch.setattr(ffd, "STRICT", True)
+        # reference decisions, solo (one engine per scenario: no sharing)
+        ref1 = build_scheduler(engine=CatalogEngine(CATALOG))
+        ref2 = build_scheduler(engine=CatalogEngine(CATALOG), cpu="2")
+        want1 = decisions(ref1[0].solve(ref1[1], timeout=60.0))
+        want2 = decisions(ref2[0].solve(ref2[1], timeout=60.0))
+        # coalesced: both requests share one engine
+        engine = CatalogEngine(CATALOG)
+        s1, p1 = build_scheduler(engine=engine)
+        s2, p2 = build_scheduler(engine=engine, cpu="2")
+        svc = SolverService(clock=FakeClock())
+        e1 = svc.submit(SolveRequest(KIND_SOLVE, s1, p1, timeout=60.0))
+        e2 = svc.submit(SolveRequest(KIND_SIMULATE, s2, p2, timeout=60.0))
+        sweeps0 = ffd.JOINT_SWEEPS
+        assert svc.run_pending() == 2
+        assert ffd.JOINT_SWEEPS == sweeps0 + 1, (
+            "coalesced batch must dispatch exactly one joint-mask sweep"
+        )
+        assert svc.max_batch_size == 2
+        assert decisions(e1.result) == want1
+        assert decisions(e2.result) == want2
+
+    def test_concurrent_threads_share_one_batch(self, monkeypatch):
+        """Threads racing into the service inside the coalescing window ride
+        one batch — the daemon-mode concurrency story, minus the socket."""
+        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+        engine = CatalogEngine(CATALOG)
+        scenarios = [build_scheduler(engine=engine) for _ in range(2)]
+        svc = SolverService(clock=Clock(), coalesce_window=0.4)
+        client = InProcessClient(svc)
+        results = [None, None]
+        errors = []
+        barrier = threading.Barrier(2)
+
+        def run(i, scheduler, pods):
+            try:
+                barrier.wait(timeout=5)
+                results[i] = client.solve(
+                    KIND_SIMULATE, scheduler, pods, timeout=60.0
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(i, s, p))
+            for i, (s, p) in enumerate(scenarios)
+        ]
+        sweeps0 = ffd.JOINT_SWEEPS
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert all(r is not None for r in results)
+        assert svc.max_batch_size >= 2, "window should have merged both"
+        assert ffd.JOINT_SWEEPS <= sweeps0 + 1
+
+    def test_singleton_batch_skips_priming(self, monkeypatch):
+        """A lone request must not pay the collect/prime pass (bench p50
+        guard): its only sweep is the solve's own."""
+        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+        engine = CatalogEngine(CATALOG)
+        s1, p1 = build_scheduler(engine=engine)
+        svc = SolverService(clock=FakeClock())
+        svc.submit(SolveRequest(KIND_SOLVE, s1, p1, timeout=60.0))
+        sweeps0 = ffd.JOINT_SWEEPS
+        assert svc.run_pending() == 1
+        assert ffd.JOINT_SWEEPS == sweeps0 + 1  # the solve's own sweep only
+
+
+class TestSocketTransport:
+    def _daemon(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        return svc, daemon
+
+    def test_identical_decisions_host_path(self):
+        scheduler, pods = build_scheduler()
+        want = decisions(scheduler.solve(pods, timeout=60.0))
+        svc, daemon = self._daemon()
+        client = SocketClient(daemon.address)
+        try:
+            s2, p2 = build_scheduler()
+            got = client.solve(KIND_SOLVE, s2, p2, timeout=60.0)
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+        assert decisions(got) == want
+
+    def test_identical_decisions_device_path(self, monkeypatch):
+        """The kwok-catalog parity check from the acceptance criteria: the
+        daemon rebuilds its own engine from the shipped catalog, runs the
+        device path, and lands on the same node decisions as in-process."""
+        monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+        s1, p1 = build_scheduler(engine=CatalogEngine(CATALOG), n_pods=12)
+        inproc = InProcessClient(SolverService(clock=FakeClock()))
+        want = decisions(inproc.solve(KIND_SOLVE, s1, p1, timeout=60.0))
+        svc, daemon = self._daemon()
+        client = SocketClient(daemon.address)
+        try:
+            s2, p2 = build_scheduler(engine=CatalogEngine(CATALOG), n_pods=12)
+            got = client.solve(KIND_SOLVE, s2, p2, timeout=60.0)
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+        assert decisions(got) == want
+
+    def test_stats_rpc_surfaces_daemon_counters(self):
+        svc, daemon = self._daemon()
+        client = SocketClient(daemon.address)
+        try:
+            scheduler, pods = build_scheduler(n_pods=2)
+            client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            stats = client.stats()
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+        assert stats["transport"] == "socket"
+        assert stats["address"] == daemon.address
+        assert stats["requests"] >= 1 and stats["batches"] >= 1
+
+    def test_typed_rejection_crosses_the_wire(self):
+        svc = SolverService(clock=Clock(), max_queue_depth=0)
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            scheduler, pods = build_scheduler(n_pods=1)
+            with pytest.raises(QueueFullError):
+                client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
