@@ -16,7 +16,23 @@ from karpenter_tpu.utils import resources as r
 from karpenter_tpu.utils.resources import ResourceList
 
 
+# Process-wide uid source. Production uses uuid4; the simulator installs a
+# seeded random.Random so generated names/uids — and therefore event-log
+# digests — are identical across runs with the same seed.
+_uid_rng = None
+
+
+def set_uid_source(rng) -> None:
+    """Install a ``random.Random`` (or None to restore uuid4) as the uid
+    source. Deterministic ids are a simulation concern only — never set
+    this in a live operator."""
+    global _uid_rng
+    _uid_rng = rng
+
+
 def new_uid() -> str:
+    if _uid_rng is not None:
+        return f"{_uid_rng.getrandbits(128):032x}"
     return uuid.uuid4().hex
 
 

@@ -148,6 +148,14 @@ class KwokCloudProvider(CloudProvider):
     def name(self) -> str:
         return "kwok"
 
+    def reclaim(self, provider_id: str) -> bool:
+        """Out-of-band capacity reclaim (a spot interruption the control
+        plane never consented to): the instance vanishes without a Delete
+        call, the way a real cloud takes spot capacity back. Subsequent
+        get() raises NodeClaimNotFoundError and the GC controller reaps the
+        claim. Returns whether the instance existed."""
+        return self._instances.pop(provider_id, None) is not None
+
     # -- the fake kubelet (kwok controller) ---------------------------------
 
     def tick(self) -> int:

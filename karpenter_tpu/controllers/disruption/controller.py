@@ -29,6 +29,7 @@ from karpenter_tpu.controllers.disruption.types import DECISION_NOOP
 from karpenter_tpu.controllers.disruption.validation import ValidationError
 from karpenter_tpu.events.recorder import Recorder
 from karpenter_tpu.metrics import global_registry, measure
+from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.statenode import (
@@ -38,6 +39,8 @@ from karpenter_tpu.state.statenode import (
 from karpenter_tpu.utils.clock import Clock
 
 POLLING_PERIOD = 10.0  # controller.go:66
+
+_log = klog.logger("disruption")
 
 _ELIGIBLE_NODES = global_registry.gauge(
     "karpenter_voluntary_disruption_eligible_nodes",
@@ -128,9 +131,21 @@ class Controller:
         require_no_schedule_taint(self.store, False, *outdated)
         clear_node_claims_condition(self.store, CONDITION_DISRUPTION_REASON, *outdated)
 
+        from karpenter_tpu.solverd import SolverRejection, TransportError
+
         for method in self.methods:
-            if self._disrupt(method):
-                return True
+            try:
+                if self._disrupt(method):
+                    return True
+            except (SolverRejection, TransportError) as e:
+                # The solver shed our simulations (or the sidecar is down):
+                # disruption is deferrable by definition — back off for a
+                # polling period instead of crashing the operator loop.
+                _log.warning(
+                    "disruption evaluation shed by solver; backing off",
+                    method=method.reason(), error=type(e).__name__,
+                )
+                break
         self._next_run = self.clock.now() + POLLING_PERIOD
         return False
 

@@ -185,7 +185,19 @@ class Provisioner:
         if not self.cluster.synced():
             return None
         self.batcher.consume()
-        results = self.schedule()
+        from karpenter_tpu.solverd import SolverRejection, TransportError
+
+        try:
+            results = self.schedule()
+        except (SolverRejection, TransportError) as e:
+            # Shed/unreachable solver: degrade, don't crash the loop. The
+            # operator re-triggers every provisionable pod each pass, so the
+            # batch re-forms and retries on its own.
+            _log.warning(
+                "solve shed; will retry next batch",
+                error=type(e).__name__, message=str(e),
+            )
+            return None
         if results is None or not results.new_node_claims:
             return results
         _log.info(

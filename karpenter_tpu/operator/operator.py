@@ -168,13 +168,18 @@ class Operator:
 
     # -- the loop -----------------------------------------------------------
 
-    def run_once(self) -> None:
+    def run_once(self) -> dict:
         """One cooperative pass: ingest watches, dispatch object events,
         tick singletons. Controllers re-emit store writes which the next
         pass ingests — level-triggered, idempotent, resumable (SURVEY.md §5
         'Checkpoint / resume'). Only the leader writes: a standby replica
         keeps its informer warm and otherwise no-ops until the incumbent's
-        lease goes stale (reference operator.go:144-151)."""
+        lease goes stale (reference operator.go:144-151).
+
+        Returns a small activity summary (pods bound, nodes fabricated,
+        nodeclaims provisioned this pass) — the simulator's event log and
+        operators' debugging hooks consume it; other callers ignore it."""
+        summary = {"bound": 0, "fabricated": 0, "provisioned": 0}
         if not self.elector.try_acquire_or_renew():
             self._was_leader = False
             self.informer.flush()
@@ -185,7 +190,7 @@ class Operator:
                     self.pod_metrics.on_delete(
                         event.obj.metadata.namespace, event.obj.metadata.name
                     )
-            return
+            return summary
         if not getattr(self, "_was_leader", False):
             # just took over (or first pass): events dropped while standing
             # by are gone, and several controllers are event-driven only —
@@ -197,7 +202,7 @@ class Operator:
         self._dispatch()
         # kwok fake kubelet fabricates due nodes before controllers run
         if hasattr(self.cloud_provider, "tick"):
-            self.cloud_provider.tick()
+            summary["fabricated"] = self.cloud_provider.tick() or 0
         self.informer.flush()
         # Periodic sweeps stand in for the reference's RequeueAfter timers:
         # registration waits on node appearance, liveness/expiration on the
@@ -215,7 +220,7 @@ class Operator:
         self.informer.flush()
         # Fake kube-scheduler: bind placeable pods before provisioning so the
         # solver only sees genuinely unsatisfiable demand.
-        self.binding.reconcile()
+        summary["bound"] = self.binding.reconcile()
         self.informer.flush()
         # Reference requeues provisionable pods every 10s (provisioning/
         # controller.go RequeueAfter): re-trigger each pass so pods left
@@ -228,7 +233,9 @@ class Operator:
         self.provisioner.prewarm()
         for pending in self.store.list("Pod", predicate=podutil.is_provisionable):
             self.provisioner.trigger(pending.metadata.uid)
-        self.provisioner.reconcile()
+        results = self.provisioner.reconcile()
+        if results is not None:
+            summary["provisioned"] = len(results.new_node_claims)
         self.disruption.reconcile()
         self.disruption_queue.reconcile()
         self.eviction_queue.reconcile()
@@ -238,6 +245,7 @@ class Operator:
         self.node_metrics.reconcile()
         self.nodepool_metrics.reconcile()
         self.condition_metrics.reconcile()
+        return summary
 
     def run(self, passes: int = 1) -> None:
         for _ in range(passes):

@@ -299,7 +299,10 @@ def sharded_cube(mesh):
     dimension over ICI)."""
     fn = _sharded_cube_cache.get(mesh)
     if fn is None:
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # jax < 0.6 keeps shard_map under jax.experimental
+            from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
         axis = mesh.axis_names[0]

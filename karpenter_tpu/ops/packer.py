@@ -29,7 +29,14 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+
+    _SHARD_MAP_UNCHECKED = {"check_vma": False}
+except ImportError:  # jax < 0.6 keeps shard_map under jax.experimental
+    from jax.experimental.shard_map import shard_map
+
+    _SHARD_MAP_UNCHECKED = {"check_rep": False}
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_tpu.apis import labels as wk
@@ -193,7 +200,7 @@ class GroupSolver:
             fn = jax.jit(
                 shard_map(
                     _solve_block, mesh=mesh, in_specs=in_specs,
-                    out_specs=out_specs, check_vma=False,
+                    out_specs=out_specs, **_SHARD_MAP_UNCHECKED,
                 )
             )
             self._sharded_fns[fn_key] = fn

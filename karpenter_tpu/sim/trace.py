@@ -1,0 +1,265 @@
+"""Versioned trace format + synthetic workload generators.
+
+A trace is a plain JSON-serializable dict — a first-class, replayable
+artifact. Version 1 shape:
+
+    {
+      "version": 1,
+      "name": "steady-state",
+      "duration": 300.0,            # virtual seconds simulated
+      "tick": 1.0,                  # controller pass interval (virtual s)
+      "nodepools": [
+        {"name": "workers", "consolidate_after": 15.0,
+         "requirements": [...], "limits": {...}}        # optional extras
+      ],
+      "faults": {                   # probabilistic per-call fault rates
+        "launch_failure_rate": 0.0,        # CreateError (retryable)
+        "insufficient_capacity_rate": 0.0, # ICE (claim deleted, re-solved)
+        "api_latency": 0.0,                # virtual s added per cloud call
+        "api_jitter": 0.0,                 # + uniform[0, jitter)
+        "solver_rejection_rate": 0.0       # QueueFullError per solve
+      },
+      "events": [                   # sorted by "at" (virtual s from start)
+        {"at": 5.0, "kind": "submit", "group": "web", "count": 6,
+         "pod": {"cpu": "1", "memory": "1Gi",
+                 "capacity_type": "spot",     # optional nodeSelector pins
+                 "zone": "...", "arch": "...",
+                 "labels": {...},
+                 "spread": "zone"},           # topology-spread on zone
+         "until": 200.0,            # group completes (pods deleted); omit
+                                    #   to run to end of trace
+         "replace": true},          # ReplicaSet stand-in: deleted pods are
+                                    #   resubmitted until "until"
+        {"at": 90.0, "kind": "interrupt", "count": 1,
+         "mode": "graceful",        # delete NodeClaim (interruption notice)
+         "capacity_type": "spot"},  # victim filter
+        {"at": 150.0, "kind": "interrupt", "count": 1, "mode": "reclaim"}
+      ]
+    }
+
+Generators are pure functions of a seeded ``random.Random`` — the same seed
+always yields the same trace, which (with the harness's seeded uid source)
+makes whole runs byte-reproducible.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from random import Random
+
+TRACE_VERSION = 1
+
+
+def validate(trace: dict) -> dict:
+    """Cheap structural validation; returns the trace for chaining."""
+    if trace.get("version") != TRACE_VERSION:
+        raise ValueError(
+            f"unsupported trace version {trace.get('version')!r} "
+            f"(this build reads version {TRACE_VERSION})"
+        )
+    for key in ("name", "duration", "events"):
+        if key not in trace:
+            raise ValueError(f"trace missing required key {key!r}")
+    last = -math.inf
+    for ev in trace["events"]:
+        if "at" not in ev or "kind" not in ev:
+            raise ValueError(f"trace event missing at/kind: {ev!r}")
+        if ev["at"] < last:
+            raise ValueError("trace events must be sorted by 'at'")
+        last = ev["at"]
+    return trace
+
+
+def loads(text: str) -> dict:
+    return validate(json.loads(text))
+
+
+def dumps(trace: dict) -> str:
+    return json.dumps(trace, sort_keys=True, indent=2)
+
+
+def _base(name: str, duration: float, tick: float = 1.0) -> dict:
+    return {
+        "version": TRACE_VERSION,
+        "name": name,
+        "duration": duration,
+        "tick": tick,
+        "nodepools": [{"name": "workers", "consolidate_after": 15.0}],
+        "faults": {},
+        "events": [],
+    }
+
+
+# -- generators ---------------------------------------------------------------
+
+
+def steady_state(rng: Random) -> dict:
+    """A constant web-service footprint: one burst of service pods that run
+    for the whole trace, plus a small mid-run scale-up. No faults — this is
+    the baseline whose digest should never move."""
+    trace = _base("steady-state", duration=240.0)
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "web",
+            "count": 5 + rng.randrange(3),
+            "pod": {"cpu": str(1 + rng.randrange(2)), "memory": "1Gi"},
+            "replace": True,
+        },
+        {
+            "at": 120.0,
+            "kind": "submit",
+            "group": "web-scaleup",
+            "count": 2 + rng.randrange(2),
+            "pod": {"cpu": "1", "memory": "1Gi"},
+            "replace": True,
+        },
+    ]
+    return trace
+
+
+def spot_interruption(rng: Random) -> dict:
+    """Spot-pinned service pods under repeated capacity interruptions: one
+    graceful (interruption-notice → NodeClaim delete → drain → replacement)
+    and one hard reclaim (instance vanishes out-of-band → GC reaps the
+    claim → replacement). Exercises the NodeClaim retry/replacement path."""
+    trace = _base("spot-interruption", duration=420.0)
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "spotty",
+            "count": 4 + rng.randrange(3),
+            "pod": {"cpu": "2", "memory": "2Gi", "capacity_type": "spot"},
+            "replace": True,
+        },
+        {"at": 60.0, "kind": "interrupt", "count": 1, "mode": "graceful",
+         "capacity_type": "spot"},
+        {"at": 140.0, "kind": "interrupt", "count": 1, "mode": "reclaim",
+         "capacity_type": "spot"},
+    ]
+    return trace
+
+
+def diurnal(rng: Random) -> dict:
+    """Diurnal web traffic, a day compressed into the trace: pod arrivals
+    follow a sinusoid — waves submitted on the upswing, completing on the
+    downswing — so the autoscaler rides scale-up AND consolidation."""
+    duration, waves = 600.0, 6
+    trace = _base("diurnal", duration=duration, tick=2.0)
+    events = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "base",
+            "count": 2,
+            "pod": {"cpu": "1", "memory": "1Gi"},
+            "replace": True,
+        }
+    ]
+    for i in range(waves):
+        at = 20.0 + i * (duration - 80.0) / waves
+        # sinusoidal demand: peak mid-trace
+        level = math.sin(math.pi * (i + 1) / (waves + 1))
+        count = max(1, round(level * (4 + rng.randrange(3))))
+        events.append(
+            {
+                "at": round(at, 3),
+                "kind": "submit",
+                "group": f"wave-{i}",
+                "count": count,
+                "pod": {"cpu": str(rng.choice([1, 1, 2])), "memory": "2Gi"},
+                "until": round(min(at + 120.0 + rng.randrange(60), duration - 30.0), 3),
+                "replace": True,
+            }
+        )
+    trace["events"] = sorted(events, key=lambda e: e["at"])
+    return trace
+
+
+def batch_waves(rng: Random) -> dict:
+    """Batch-job waves: bursts of short-lived jobs arriving every ~90s,
+    each wave finishing before the next two land — steady churn through
+    provisioning, completion, and empty-node consolidation."""
+    duration = 480.0
+    trace = _base("batch-waves", duration=duration, tick=2.0)
+    events = []
+    at = 6.0
+    i = 0
+    while at < duration - 120.0:
+        runtime = 60.0 + rng.randrange(40)
+        events.append(
+            {
+                "at": round(at, 3),
+                "kind": "submit",
+                "group": f"job-{i}",
+                "count": 3 + rng.randrange(4),
+                "pod": {"cpu": "4", "memory": "8Gi"},
+                "until": round(at + runtime, 3),
+                "replace": False,  # batch pods that die stay dead
+            }
+        )
+        at += 80.0 + rng.randrange(30)
+        i += 1
+    trace["events"] = events
+    return trace
+
+
+def tpu_training(rng: Random) -> dict:
+    """TPU-slice-shaped training jobs: gangs of large workers spread across
+    zones (one slice per failure domain, the topology-spread discipline
+    multislice training uses), pinned to arm64 hosts, long-running."""
+    trace = _base("tpu-training", duration=360.0, tick=2.0)
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "trainer",
+            "count": 4,
+            "pod": {
+                "cpu": "16",
+                "memory": "64Gi",
+                "arch": "arm64",
+                "spread": "zone",
+                "labels": {"app": "trainer"},
+            },
+            "replace": True,
+        },
+        {
+            "at": 90.0,
+            "kind": "submit",
+            "group": "eval",
+            "count": 2 + rng.randrange(2),
+            "pod": {"cpu": "8", "memory": "16Gi", "arch": "arm64"},
+            "until": 250.0,
+            "replace": True,
+        },
+    ]
+    return trace
+
+
+def flaky_cloud(rng: Random) -> dict:
+    """Steady demand against a misbehaving cloud: probabilistic launch
+    failures, occasional capacity errors, API latency, and a solver
+    shedding part of its load — the graceful-degradation gauntlet."""
+    trace = _base("flaky-cloud", duration=360.0)
+    trace["faults"] = {
+        "launch_failure_rate": 0.3,
+        "insufficient_capacity_rate": 0.1,
+        "api_latency": 0.2,
+        "api_jitter": 0.3,
+        "solver_rejection_rate": 0.25,
+    }
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "svc",
+            "count": 4 + rng.randrange(3),
+            "pod": {"cpu": "2", "memory": "2Gi"},
+            "replace": True,
+        },
+    ]
+    return trace

@@ -21,6 +21,8 @@ class SolverRejection(Exception):
     request WITHOUT running it. Callers distinguish these from solve errors
     — a rejection is retryable load-shedding, not a scheduling outcome."""
 
+    retryable = True
+
 
 class QueueFullError(SolverRejection):
     """The admission queue is at depth; the request was shed, not queued."""
@@ -37,7 +39,11 @@ class SolverClosedError(SolverRejection):
 
 class TransportError(Exception):
     """Socket-transport failure (framing, connection, codec) — distinct from
-    rejections: the daemon may never have seen the request."""
+    rejections: the daemon may never have seen the request. Retryable: the
+    client has already exhausted its reconnect-with-backoff budget, but the
+    controller loop may safely re-submit on a later pass."""
+
+    retryable = True
 
 
 @dataclass

@@ -34,6 +34,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from contextlib import contextmanager
 from typing import Optional
 
@@ -164,13 +165,33 @@ def _engine_stripped(scheduler):
 
 
 class SocketClient(SolverClient):
+    """Socket transport with reconnect-with-backoff: a daemon restart
+    between — or in the middle of — requests is survived by re-dialing
+    with exponential backoff and replaying the in-flight frame (solves are
+    idempotent: the daemon holds no per-request state). When every attempt
+    fails, the caller gets a typed, retryable TransportError promptly
+    instead of a hung recv."""
+
     transport = "socket"
 
-    def __init__(self, address: str, connect_timeout: float = 5.0):
+    def __init__(
+        self,
+        address: str,
+        connect_timeout: float = 5.0,
+        reconnect_attempts: int = 3,
+        backoff_base: float = 0.05,
+        backoff_max: float = 1.0,
+        sleep=None,
+    ):
         self.address = address
         self.connect_timeout = connect_timeout
+        self.reconnect_attempts = max(1, reconnect_attempts)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._sleep = sleep if sleep is not None else time.sleep
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self.reconnects = 0  # cumulative, for stats/tests
 
     def _connect(self) -> socket.socket:
         if self._sock is not None:
@@ -190,6 +211,34 @@ class SocketClient(SolverClient):
         sock.settimeout(None)  # solves are long; the daemon bounds them
         self._sock = sock
         return sock
+
+    def _rpc(self, msg: dict, attempts: Optional[int] = None) -> Optional[dict]:
+        """Send one frame and await its reply, re-dialing with exponential
+        backoff on connection failure. Caller holds the lock."""
+        last_err: Optional[Exception] = None
+        attempts = self.reconnect_attempts if attempts is None else attempts
+        for attempt in range(attempts):
+            if attempt > 0:
+                self.reconnects += 1
+                self._sleep(
+                    min(self.backoff_base * (2 ** (attempt - 1)), self.backoff_max)
+                )
+            try:
+                sock = self._connect()
+                send_frame(sock, msg)
+                reply = recv_frame(sock)
+                if reply is None:
+                    # daemon closed between frames (restart): retry
+                    self._drop()
+                    last_err = TransportError("daemon closed the connection")
+                    continue
+                return reply
+            except (OSError, TransportError) as e:
+                self._drop()
+                last_err = e
+        raise TransportError(
+            f"solve rpc failed after {attempts} attempts: {last_err}"
+        ) from last_err
 
     def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
         with _engine_stripped(scheduler) as engine:
@@ -213,23 +262,7 @@ class SocketClient(SolverClient):
             "payload": payload,
         }
         with self._lock:
-            sock = self._connect()
-            try:
-                send_frame(sock, msg)
-                reply = recv_frame(sock)
-            except (OSError, TransportError):
-                # one reconnect: the daemon may have restarted between calls
-                self._drop()
-                sock = self._connect()
-                try:
-                    send_frame(sock, msg)
-                    reply = recv_frame(sock)
-                except OSError as e:
-                    self._drop()
-                    raise TransportError(f"solve rpc failed: {e}") from e
-        if reply is None:
-            self._drop()
-            raise TransportError("daemon closed the connection")
+            reply = self._rpc(msg)
         if not reply.get("ok"):
             err = reply.get("error", {})
             cls = _ERROR_TYPES.get(err.get("type"))
@@ -252,14 +285,18 @@ class SocketClient(SolverClient):
         """The daemon's service stats (op=stats RPC) so /debug/solverd shows
         the real queue/batch counters in sidecar mode; falls back to local
         transport info when the daemon is unreachable."""
-        out = {"transport": "socket", "address": self.address}
+        out = {
+            "transport": "socket",
+            "address": self.address,
+            "reconnects": self.reconnects,
+        }
         with self._lock:
             try:
-                sock = self._connect()
-                send_frame(sock, {"v": WIRE_VERSION, "op": "stats"})
-                reply = recv_frame(sock)
-            except (OSError, TransportError) as e:
-                self._drop()
+                # single attempt: the debug path has a graceful fallback, and
+                # running the full backoff loop here would pin the lock (and
+                # any concurrent solve) for seconds while the daemon is down
+                reply = self._rpc({"v": WIRE_VERSION, "op": "stats"}, attempts=1)
+            except TransportError as e:
                 out["error"] = str(e)
                 return out
         if reply and reply.get("ok"):
@@ -315,6 +352,8 @@ class SolverDaemon:
         self._path = target if family == "unix" else None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         # resolved at bind time (port 0 → ephemeral) and kept past stop()
         if family == "tcp":
             host, port = self._srv.getsockname()[:2]
@@ -335,11 +374,20 @@ class SolverDaemon:
                 conn, _ = self._srv.accept()
             except OSError:
                 return  # listener closed
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            self._serve_frames(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _serve_frames(self, conn: socket.socket) -> None:
         with conn:
             while not self._stop.is_set():
                 try:
@@ -394,6 +442,20 @@ class SolverDaemon:
             self._srv.close()
         except OSError:
             pass
+        # Tear down live handler connections too: otherwise their threads
+        # stay parked in recv until every client goes away, and the port
+        # can't be rebound for a restart.
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         if self._path:
             import os
 

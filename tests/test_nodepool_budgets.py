@@ -165,3 +165,115 @@ class TestDisruptionBudgetCounting:
         h.informer.flush()
         for reason in self.REASONS:
             assert self._mapping(h, reason)["default"] == 8
+
+
+class TestBudgetScheduleWindows:
+    """Satellite (ISSUE 2): build_disruption_budget_mapping under
+    overlapping cron-windowed budgets and zero-budget (maintenance-freeze)
+    windows — the simulator's interruption scenarios lean on this mapping
+    to decide when replacements may be disrupted."""
+
+    def _harness(self, budgets, n=10):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.events.recorder import Recorder
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.state.cluster import Cluster
+        from karpenter_tpu.state.informer import StateInformer
+        from karpenter_tpu.utils.clock import FakeClock
+
+        from helpers import node_claim_pair, nodepool
+
+        class H:
+            pass
+
+        h = H()
+        h.clock = FakeClock()
+        h.store = Store(clock=h.clock)
+        h.provider = FakeCloudProvider()
+        h.cluster = Cluster(h.clock, h.store, h.provider)
+        h.informer = StateInformer(h.store, h.cluster)
+        h.recorder = Recorder(clock=h.clock)
+        pool = nodepool("default")
+        pool.spec.disruption.budgets = list(budgets)
+        h.store.create(pool)
+        h.pairs = []
+        for i in range(n):
+            node, claim = node_claim_pair(f"n-{i}")
+            h.store.create(claim)
+            h.store.create(node)
+            h.pairs.append((node, claim))
+        h.informer.flush()
+        return h
+
+    def _mapping(self, h, reason="Empty"):
+        from karpenter_tpu.controllers.disruption.helpers import (
+            build_disruption_budget_mapping,
+        )
+
+        return build_disruption_budget_mapping(
+            h.store, h.cluster, h.clock, h.recorder, reason
+        )
+
+    def test_overlapping_windows_most_restrictive_wins(self):
+        budgets = [
+            Budget(nodes="3", schedule="0 9 * * *", duration=4 * 3600.0),
+            Budget(nodes="1", schedule="0 10 * * *", duration=2 * 3600.0),
+        ]
+        h = self._harness(budgets)
+        # 10:30 — both windows active: min(3, 1)
+        h.clock.set_time(ts(2026, 7, 29, 10, 30))
+        assert self._mapping(h)["default"] == 1
+        # 09:30 — only the wide window is active
+        h.clock.set_time(ts(2026, 7, 29, 9, 30))
+        assert self._mapping(h)["default"] == 3
+        # 12:30 — the narrow window closed at 12:00, the wide one runs to 13:00
+        h.clock.set_time(ts(2026, 7, 29, 12, 30))
+        assert self._mapping(h)["default"] == 3
+        # 14:00 — both inactive: unrestricted
+        h.clock.set_time(ts(2026, 7, 29, 14, 0))
+        assert self._mapping(h)["default"] == 10
+
+    def test_zero_budget_window_blocks_and_publishes(self):
+        h = self._harness(
+            [Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)]
+        )
+        h.clock.set_time(ts(2026, 7, 29, 9, 30))
+        assert self._mapping(h)["default"] == 0
+        blocked = [e for e in h.recorder.events if e.reason == "DisruptionBlocked"]
+        assert len(blocked) == 1
+        # window over: unrestricted again, no new block event
+        h.clock.set_time(ts(2026, 7, 29, 11, 0))
+        assert self._mapping(h)["default"] == 10
+
+    def test_zero_budget_window_scoped_to_reason(self):
+        h = self._harness(
+            [
+                Budget(
+                    nodes="0",
+                    reasons=["Drifted"],
+                    schedule="0 9 * * *",
+                    duration=3600.0,
+                )
+            ]
+        )
+        h.clock.set_time(ts(2026, 7, 29, 9, 30))
+        assert self._mapping(h, "Drifted")["default"] == 0
+        assert self._mapping(h, "Empty")["default"] == 10
+
+    def test_window_boundaries(self):
+        b = Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)
+        h = self._harness([b])
+        # inclusive at the opening instant
+        h.clock.set_time(ts(2026, 7, 29, 9, 0))
+        assert self._mapping(h)["default"] == 0
+        # exclusive at the closing instant (now - start == duration)
+        h.clock.set_time(ts(2026, 7, 29, 10, 0))
+        assert self._mapping(h)["default"] == 10
+
+    def test_active_window_still_subtracts_disrupting(self):
+        budgets = [Budget(nodes="2", schedule="0 9 * * *", duration=3600.0)]
+        h = self._harness(budgets)
+        h.clock.set_time(ts(2026, 7, 29, 9, 30))
+        node0, _ = h.pairs[0]
+        h.cluster.mark_for_deletion(f"kwok://{node0.metadata.name}")
+        assert self._mapping(h)["default"] == 1

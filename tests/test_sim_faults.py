@@ -1,0 +1,222 @@
+"""Fault injection: the spot-interruption scenario must demonstrably drive
+NodeClaim retry/replacement, and probabilistic cloud/solver faults must
+degrade gracefully instead of wedging the loop (ISSUE 2 acceptance)."""
+
+from random import Random
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import (
+    CreateError,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+)
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.sim import scenarios
+from karpenter_tpu.sim.faults import FaultyCloudProvider, FlakySolverClient, interrupt
+from karpenter_tpu.sim.harness import run_scenario
+from karpenter_tpu.solverd import QueueFullError
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool
+
+
+def make_claim(store, name="workers-test", capacity_type=None):
+    from karpenter_tpu.apis.core import ObjectMeta
+    from karpenter_tpu.apis.nodeclaim import NodeClaim
+
+    if store.try_get("NodePool", "workers") is None:
+        store.create(nodepool("workers"))
+    claim = NodeClaim(
+        metadata=ObjectMeta(name=name, labels={wk.NODEPOOL_LABEL_KEY: "workers"})
+    )
+    claim.spec.requirements = [
+        {"key": wk.LABEL_OS, "operator": "In", "values": ["linux"]},
+        {"key": wk.LABEL_ARCH, "operator": "In", "values": ["amd64"]},
+    ]
+    if capacity_type is not None:
+        claim.spec.requirements.append(
+            {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In",
+             "values": [capacity_type]}
+        )
+    claim.spec.resources.requests = {"cpu": 1.0}
+    return claim
+
+
+class TestSpotInterruptionScenario:
+    @pytest.fixture(scope="class")
+    def result(self):
+        return run_scenario(scenarios.resolve("spot-interruption", 7), 7)
+
+    def test_interruptions_injected(self, result):
+        faults = result.report["faults"]
+        assert faults["spot_interruptions"] >= 1
+        assert faults["capacity_reclaims"] >= 1
+
+    def test_replacement_path_exercised(self, result):
+        """Each interruption kills capacity that live pods depend on, so the
+        provisioner must mint replacement NodeClaims: strictly more claims
+        than the steady workload needed, and interrupted claims are gone."""
+        churn = result.report["churn"]
+        assert churn["nodeclaims_deleted"] >= 2  # one graceful + one reclaim
+        assert churn["nodeclaims_created"] > churn["nodeclaims_deleted"]
+        assert churn["nodes_at_end"] >= 1
+        # a replacement claim is created AFTER the first interruption
+        entries = list(result.log)
+        first_fault_t = next(
+            e["t"] for e in entries if e["ev"] in ("fault-interrupt", "fault-reclaim")
+        )
+        assert any(
+            e["ev"] == "nodeclaim-added" and e["t"] > first_fault_t for e in entries
+        )
+
+    def test_workload_recovers(self, result):
+        slo = result.report["slo"]
+        assert slo["pods_never_bound"] == 0
+        assert slo["pods_bound"] == slo["pods_submitted"]
+        # the reclaim loses bound pods out-of-band; the workload driver
+        # resubmits and the cluster re-places them
+        assert result.report["faults"]["pods_lost"] >= 1
+
+    def test_spot_capacity_only(self, result):
+        assert set(result.report["cost"]["by_capacity_type"]) == {"spot"}
+
+    def test_deterministic_under_faults(self, result):
+        again = run_scenario(scenarios.resolve("spot-interruption", 7), 7)
+        assert again.digest == result.digest
+
+
+class TestFlakyCloudScenario:
+    @pytest.fixture(scope="class")
+    def result(self):
+        return run_scenario(scenarios.resolve("flaky-cloud", 7), 7)
+
+    def test_faults_fired_and_loop_survived(self, result):
+        faults = result.report["faults"]
+        assert (
+            faults["launch_failures"]
+            + faults["capacity_errors"]
+            + faults["solver_rejections"]
+        ) >= 1
+        # graceful degradation: demand is still met by the end of the run
+        assert result.report["slo"]["pods_never_bound"] == 0
+        assert result.report["churn"]["nodes_at_end"] >= 1
+
+
+class TestFaultyCloudProvider:
+    def _provider(self, **kwargs):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        kwok = KwokCloudProvider(store, clock)
+        faulty = FaultyCloudProvider(kwok, Random(1), clock, **kwargs)
+        return clock, store, faulty
+
+    def _claim(self, store):
+        return make_claim(store)
+
+    def test_launch_failure_is_retryable_create_error(self):
+        _, store, faulty = self._provider(launch_failure_rate=1.0)
+        with pytest.raises(CreateError):
+            faulty.create(self._claim(store))
+        assert faulty.launch_failures == 1
+
+    def test_insufficient_capacity_injection(self):
+        _, store, faulty = self._provider(insufficient_capacity_rate=1.0)
+        with pytest.raises(InsufficientCapacityError):
+            faulty.create(self._claim(store))
+        assert faulty.capacity_errors == 1
+
+    def test_api_latency_advances_virtual_time(self):
+        clock, store, faulty = self._provider(api_latency=0.5)
+        t0 = clock.now()
+        faulty.create(self._claim(store))
+        assert clock.now() >= t0 + 0.5
+
+    def test_delegates_provider_surface(self):
+        _, store, faulty = self._provider()
+        created = faulty.create(self._claim(store))
+        assert faulty.get(created.status.provider_id).metadata.name == "workers-test"
+        assert faulty.name() == "kwok"
+        assert faulty.tick() == 0  # kwok tick passes through __getattr__
+        faulty.delete(created)
+        with pytest.raises(NodeClaimNotFoundError):
+            faulty.get(created.status.provider_id)
+
+
+class TestFlakySolverClient:
+    def test_rejection_storm_raises_typed_retryable(self):
+        class Inner:
+            transport = "inprocess"
+
+            def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+                return "solved"
+
+            def stats(self):
+                return {"transport": "inprocess"}
+
+            def close(self):
+                pass
+
+        flaky = FlakySolverClient(Inner(), Random(1), rejection_rate=1.0)
+        with pytest.raises(QueueFullError) as exc:
+            flaky.solve("solve", None, [])
+        assert exc.value.retryable is True
+        assert flaky.stats()["injected_rejections"] == 1
+        flaky.rejection_rate = 0.0
+        assert flaky.solve("solve", None, []) == "solved"
+
+
+class TestInterrupt:
+    def _cluster(self, n=3):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        kwok = KwokCloudProvider(store, clock)
+        claims = []
+        for i in range(n):
+            claim = make_claim(
+                store,
+                name=f"workers-{i}",
+                capacity_type="spot" if i % 2 == 0 else "on-demand",
+            )
+            created = kwok.create(claim)
+            # the lifecycle controller adds this on launch; graceful
+            # interruption relies on it to leave the claim in "deleting"
+            created.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+            store.create(created)
+            claims.append(created)
+        clock.step(5.0)
+        kwok.tick()
+        return clock, store, kwok, claims
+
+    def test_graceful_deletes_claim(self):
+        _, store, kwok, _ = self._cluster()
+        hit = interrupt(store, kwok, Random(2), count=1, mode="graceful",
+                        capacity_type="spot")
+        assert hit == 1
+        deleting = [
+            c for c in store.list("NodeClaim")
+            if c.metadata.deletion_timestamp is not None
+        ]
+        assert len(deleting) == 1
+        assert deleting[0].metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == "spot"
+
+    def test_reclaim_vanishes_instance_and_node(self):
+        _, store, kwok, _ = self._cluster()
+        n_nodes = len(store.list("Node"))
+        hit = interrupt(store, kwok, Random(2), count=1, mode="reclaim")
+        assert hit == 1
+        assert len(store.list("Node")) == n_nodes - 1
+        # the claim survives until GC reaps it — the instance is just gone
+        gone = [
+            c for c in store.list("NodeClaim")
+            if c.status.provider_id not in {x.status.provider_id for x in kwok.list()}
+        ]
+        assert len(gone) == 1
+
+    def test_respects_capacity_filter_and_count(self):
+        _, store, kwok, _ = self._cluster(n=4)
+        hit = interrupt(store, kwok, Random(2), count=10, mode="graceful",
+                        capacity_type="on-demand")
+        assert hit == 2  # only the two on-demand claims qualify

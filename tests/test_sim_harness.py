@@ -1,0 +1,231 @@
+"""Simulator harness: seed determinism, report shape, trace format, the
+virtual-time event loop, and the CLI (ISSUE 2 acceptance criteria)."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.sim import scenarios
+from karpenter_tpu.sim import trace as tracemod
+from karpenter_tpu.sim.events import EventLog
+from karpenter_tpu.sim.harness import build_pod, run_scenario
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class TestEventLog:
+    def test_digest_covers_every_entry(self):
+        a, b = EventLog(), EventLog()
+        a.append(1.0, "node-added", node="n1")
+        b.append(1.0, "node-added", node="n1")
+        assert a.digest() == b.digest()
+        b.append(2.0, "node-deleted", node="n1")
+        assert a.digest() != b.digest()
+
+    def test_canonical_jsonl_roundtrip(self):
+        log = EventLog()
+        log.append(0.5, "pod-bound", pod="p", node="n")
+        [line] = log.to_jsonl().splitlines()
+        assert json.loads(line) == {"t": 0.5, "ev": "pod-bound", "pod": "p", "node": "n"}
+
+
+class TestTraceFormat:
+    def test_generators_are_seed_deterministic(self):
+        for name in scenarios.names():
+            assert scenarios.resolve(name, 5) == scenarios.resolve(name, 5)
+
+    def test_version_gate(self):
+        with pytest.raises(ValueError, match="version"):
+            tracemod.validate({"version": 99, "name": "x", "duration": 1, "events": []})
+
+    def test_events_must_be_sorted(self):
+        trace = scenarios.resolve("steady-state", 1)
+        trace["events"] = list(reversed(trace["events"]))
+        with pytest.raises(ValueError, match="sorted"):
+            tracemod.validate(trace)
+
+    def test_dumps_loads_roundtrip(self):
+        trace = scenarios.resolve("spot-interruption", 3)
+        assert tracemod.loads(tracemod.dumps(trace)) == trace
+
+    def test_unknown_scenario(self):
+        with pytest.raises(KeyError, match="unknown scenario"):
+            scenarios.resolve("nope", 0)
+
+
+class TestDeterminism:
+    def test_same_seed_identical_digest_and_log(self):
+        a = run_scenario(scenarios.resolve("steady-state", 7), 7)
+        b = run_scenario(scenarios.resolve("steady-state", 7), 7)
+        assert a.digest == b.digest
+        assert a.log.to_jsonl() == b.log.to_jsonl()
+        assert a.report["event_log_digest"] == a.digest
+        # the WHOLE report reproduces, including solver stats — process-global
+        # counters must not leak between sims in one process
+        assert a.report == b.report
+
+    def test_different_seed_different_digest(self):
+        a = run_scenario(scenarios.resolve("steady-state", 7), 7)
+        b = run_scenario(scenarios.resolve("steady-state", 8), 8)
+        assert a.digest != b.digest
+
+
+class TestReport:
+    @pytest.fixture(scope="class")
+    def result(self):
+        return run_scenario(scenarios.resolve("steady-state", 7), 7)
+
+    def test_cost_fields(self, result):
+        cost = result.report["cost"]
+        assert cost["total_usd"] > 0
+        assert cost["node_hours"] > 0
+        assert cost["by_capacity_type"]
+        # one node for ~236 virtual seconds: node-hours bounded by duration
+        assert cost["node_hours"] <= result.report["virtual_duration_s"] / 3600.0 * (
+            result.report["churn"]["max_concurrent_nodes"]
+        )
+
+    def test_slo_fields(self, result):
+        slo = result.report["slo"]
+        assert slo["pods_submitted"] > 0
+        assert slo["pods_bound"] == slo["pods_submitted"]
+        assert slo["pods_never_bound"] == 0
+        tts = slo["time_to_schedule_s"]
+        for p in ("p50", "p90", "p99", "max"):
+            assert tts[p] is not None and tts[p] > 0
+        assert tts["p50"] <= tts["p99"] <= tts["max"]
+
+    def test_churn_fields(self, result):
+        churn = result.report["churn"]
+        assert churn["nodes_created"] >= 1
+        assert churn["nodeclaims_created"] >= 1
+        assert churn["max_concurrent_nodes"] >= 1
+
+    def test_steady_state_injects_no_faults(self, result):
+        assert all(v == 0 for v in result.report["faults"].values())
+
+    def test_lifecycle_events_in_order(self, result):
+        """claim first, node after registration delay, binds after that."""
+        evs = [e["ev"] for e in result.log]
+        assert evs.index("nodeclaim-added") < evs.index("node-added")
+        first_bind = next(e for e in result.log if e["ev"] == "pod-bound")
+        first_node = next(e for e in result.log if e["ev"] == "node-added")
+        assert first_bind["t"] >= first_node["t"]
+
+
+class TestBuildPod:
+    def test_capacity_pin_and_group_label(self):
+        pod = build_pod("p-0", "g", {"cpu": "2", "capacity_type": "spot"})
+        assert pod.spec.node_selector[wk.CAPACITY_TYPE_LABEL_KEY] == "spot"
+        assert pod.metadata.labels["sim.kwok.sh/group"] == "g"
+        from karpenter_tpu.utils import pod as podutil
+
+        assert podutil.is_provisionable(pod)
+
+    def test_zone_spread(self):
+        pod = build_pod("p-0", "g", {"spread": "zone"})
+        [tsc] = pod.spec.topology_spread_constraints
+        assert tsc.topology_key == wk.LABEL_TOPOLOGY_ZONE
+        assert tsc.when_unsatisfiable == "DoNotSchedule"
+        assert tsc.label_selector.match_labels == {"sim.kwok.sh/group": "g"}
+
+
+class TestCli:
+    def test_report_and_events_files(self, tmp_path):
+        report = tmp_path / "report.json"
+        events = tmp_path / "events.jsonl"
+        from karpenter_tpu.sim.__main__ import main
+
+        rc = main(
+            [
+                "--scenario", "steady-state", "--seed", "7",
+                "--report", str(report), "--events", str(events),
+            ]
+        )
+        assert rc == 0
+        data = json.loads(report.read_text())
+        assert data["scenario"] == "steady-state"
+        assert data["event_log_digest"].startswith("sha256:")
+        lines = [json.loads(l) for l in events.read_text().splitlines()]
+        assert len(lines) == data["events"]
+
+    def test_list(self, capsys):
+        from karpenter_tpu.sim.__main__ import main
+
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for name in scenarios.names():
+            assert name in out
+
+    def test_trace_file_input(self, tmp_path):
+        trace_path = tmp_path / "t.json"
+        trace_path.write_text(tracemod.dumps(scenarios.resolve("steady-state", 1)))
+        from karpenter_tpu.sim.__main__ import main
+
+        report = tmp_path / "r.json"
+        assert main(["--trace", str(trace_path), "--seed", "1",
+                     "--report", str(report)]) == 0
+        assert json.loads(report.read_text())["events"] > 0
+
+
+class TestFakeClockWaiters:
+    """Satellite: registered-waiter wakeups on the shared FakeClock."""
+
+    def test_default_sleep_still_steps(self):
+        clock = FakeClock()
+        t0 = clock.now()
+        clock.sleep(5.0)
+        assert clock.now() == t0 + 5.0
+
+    def test_driver_sleep_steps_in_blocking_mode(self):
+        clock = FakeClock()
+        clock.enable_blocking_sleep()
+        t0 = clock.now()
+        clock.sleep(3.0)  # driver can never deadlock on itself
+        assert clock.now() == t0 + 3.0
+
+    def test_worker_sleep_blocks_until_time_passes(self):
+        clock = FakeClock()
+        clock.enable_blocking_sleep()
+        woke = threading.Event()
+
+        def worker():
+            clock.sleep(10.0)
+            woke.set()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        # the worker must park, not step time itself
+        for _ in range(100):
+            if clock.waiter_count() == 1:
+                break
+            threading.Event().wait(0.01)
+        assert clock.waiter_count() == 1
+        assert clock.next_wakeup() == clock.now() + 10.0
+        assert not woke.is_set()
+        clock.step(5.0)
+        assert not woke.wait(0.05)
+        clock.step(5.0)
+        assert woke.wait(2.0)
+        t.join(2.0)
+        assert clock.waiter_count() == 0
+
+    def test_disable_releases_blocked_workers(self):
+        clock = FakeClock()
+        clock.enable_blocking_sleep()
+        woke = threading.Event()
+
+        def worker():
+            clock.sleep(100.0)
+            woke.set()
+
+        threading.Thread(target=worker, daemon=True).start()
+        for _ in range(100):
+            if clock.waiter_count() == 1:
+                break
+            threading.Event().wait(0.01)
+        clock.disable_blocking_sleep()
+        assert woke.wait(2.0)

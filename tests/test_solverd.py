@@ -23,6 +23,7 @@ from karpenter_tpu.solverd import (
     SolverClosedError,
     SolverDaemon,
     SolverService,
+    TransportError,
     build_solver,
 )
 from karpenter_tpu.state.cluster import Cluster
@@ -324,3 +325,106 @@ class TestSocketTransport:
             client.close()
             daemon.stop()
             svc.close()
+
+
+class TestSocketReconnect:
+    """Satellite (ISSUE 2): the socket transport must survive a daemon
+    restart mid-stream via reconnect-with-backoff, and in-flight requests
+    against a dead daemon must surface a typed retryable error promptly
+    instead of hanging."""
+
+    def test_survives_daemon_restart_midstream(self, tmp_path):
+        # unix socket: restart-on-same-address without TCP TIME_WAIT games
+        address = str(tmp_path / "solverd.sock")
+        svc1 = SolverService(clock=Clock())
+        daemon1 = SolverDaemon(svc1, address=address).start()
+        sleeps = []
+        client = SocketClient(address, sleep=sleeps.append)
+        scheduler, pods = build_scheduler(n_pods=2)
+        want = decisions(client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0))
+        # restart the daemon on the SAME address: the client's persistent
+        # connection is now a dead socket it must notice and re-dial
+        daemon1.stop()
+        svc1.close()
+        svc2 = SolverService(clock=Clock())
+        daemon2 = SolverDaemon(svc2, address=address).start()
+        try:
+            s2, p2 = build_scheduler(n_pods=2)
+            got = decisions(client.solve(KIND_SOLVE, s2, p2, timeout=60.0))
+        finally:
+            client.close()
+            daemon2.stop()
+            svc2.close()
+        assert got == want
+        assert client.reconnects >= 1
+
+    def test_dead_daemon_raises_typed_retryable_not_hang(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        daemon.stop()
+        svc.close()
+        sleeps = []
+        client = SocketClient(
+            daemon.address,
+            connect_timeout=0.5,
+            reconnect_attempts=3,
+            backoff_base=0.05,
+            backoff_max=1.0,
+            sleep=sleeps.append,
+        )
+        scheduler, pods = build_scheduler(n_pods=1)
+        done = threading.Event()
+        caught = []
+
+        def attempt():
+            with pytest.raises(TransportError) as exc:
+                client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            caught.append(exc.value)
+            done.set()
+
+        t = threading.Thread(target=attempt, daemon=True)
+        t.start()
+        # "promptly": bounded by attempts x connect_timeout, not a recv hang
+        assert done.wait(timeout=10.0), "in-flight request hung on dead daemon"
+        t.join()
+        client.close()
+        assert caught[0].retryable is True
+        # exponential backoff between re-dials: base, then base*2
+        assert sleeps == [pytest.approx(0.05), pytest.approx(0.1)]
+
+    def test_backoff_capped_and_attempts_bounded(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        daemon.stop()
+        svc.close()
+        sleeps = []
+        client = SocketClient(
+            daemon.address,
+            connect_timeout=0.2,
+            reconnect_attempts=5,
+            backoff_base=0.1,
+            backoff_max=0.25,
+            sleep=sleeps.append,
+        )
+        with pytest.raises(TransportError), client._lock:
+            client._rpc({"v": 1, "op": "stats"})
+        client.close()
+        assert sleeps == [
+            pytest.approx(0.1),
+            pytest.approx(0.2),
+            pytest.approx(0.25),
+            pytest.approx(0.25),
+        ]
+
+    def test_stats_degrades_instead_of_raising(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        daemon.stop()
+        svc.close()
+        client = SocketClient(
+            daemon.address, connect_timeout=0.2, sleep=lambda s: None
+        )
+        stats = client.stats()
+        client.close()
+        assert stats["transport"] == "socket"
+        assert "error" in stats
