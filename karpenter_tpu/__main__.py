@@ -38,9 +38,10 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
         serving = ServingConfig(
             metrics_text=operator.metrics_text,
             healthy=operator.healthy,
-            ready=operator.healthy,
+            ready=operator.ready,
             enable_profiling=options.enable_profiling,
             solverd_stats=operator.solver_stats,
+            health_snapshot=operator.health_snapshot,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import time
 
+from karpenter_tpu.cloudprovider.types import is_retryable_error
 from karpenter_tpu.metrics import global_registry
 
 _DURATION = global_registry.histogram(
@@ -21,7 +22,7 @@ _DURATION = global_registry.histogram(
 _ERRORS = global_registry.counter(
     "karpenter_cloudprovider_errors_total",
     "total errors returned from cloud provider methods",
-    labels=("controller", "method", "provider", "error"),
+    labels=("controller", "method", "provider", "error", "retryable"),
 )
 
 class MetricsCloudProvider:
@@ -46,7 +47,16 @@ class MetricsCloudProvider:
         try:
             return getattr(self._inner, method)(*args, **kwargs)
         except Exception as e:
-            _ERRORS.inc({**labels, "error": type(e).__name__})
+            # retryable distinguishes infrastructure failures (what the
+            # circuit breaker counts) from typed domain answers like
+            # not-found — an alert on retryable=true is an outage signal
+            _ERRORS.inc(
+                {
+                    **labels,
+                    "error": type(e).__name__,
+                    "retryable": "true" if is_retryable_error(e) else "false",
+                }
+            )
             raise
         finally:
             _DURATION.observe(time.perf_counter() - start, labels)

@@ -264,6 +264,35 @@ class CreateError(Exception):
         self.condition_message = condition_message or message
 
 
+class CircuitBreakerOpenError(CreateError):
+    """Fast-fail: the provider circuit breaker is open — the cloud has been
+    failing consecutively and calls are shed until the next probe window.
+    Subclasses CreateError so launch paths degrade through the normal
+    typed-error handling (condition set, claim retried) instead of crashing;
+    delete paths surface it to the reconciler harness for backoff."""
+
+    def __init__(self, message: str, retry_after: float = 0.0):
+        super().__init__(message, condition_reason="CloudProviderCircuitOpen")
+        self.retry_after = retry_after
+
+
+def is_retryable_error(e: BaseException) -> bool:
+    """Whether a cloud call failure is infrastructure-shaped (worth a retry,
+    counted by the circuit breaker) rather than a domain answer. Not-found,
+    insufficient capacity, and nodeclass-not-ready are the cloud RESPONDING
+    — they break a consecutive-failure streak instead of extending it. A
+    breaker fast-fail is itself never evidence about the cloud."""
+    return not isinstance(
+        e,
+        (
+            NodeClaimNotFoundError,
+            InsufficientCapacityError,
+            NodeClassNotReadyError,
+            CircuitBreakerOpenError,
+        ),
+    )
+
+
 class CloudProvider(ABC):
     """The pluggable provider boundary (types.go:64-92)."""
 

@@ -20,6 +20,7 @@ from karpenter_tpu.apis.nodeclaim import (
 from karpenter_tpu.cloudprovider.types import CloudProvider, NodeClaimNotFoundError
 from karpenter_tpu.events.recorder import Event, Recorder
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.operator.harness import RECONCILE_ERRORS
 from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.utils import pod as podutil
@@ -116,6 +117,13 @@ class GarbageCollectionController:
                     pass  # terminated out-of-band between list() and delete()
                 except Exception as e:  # noqa: BLE001 — retried next GC period
                     _GC_DELETE_ERRORS.inc()
+                    # per-claim failures must not abort the sweep, so they
+                    # can't propagate to the harness — count them into the
+                    # shared reconcile-error metric here so GC retries are
+                    # observable alongside every other controller's errors
+                    RECONCILE_ERRORS.inc(
+                        {"controller": "nodeclaim.garbagecollection"}
+                    )
                     _log.error(
                         "failed to garbage-collect orphaned instance",
                         provider_id=pid,

@@ -28,6 +28,7 @@ from karpenter_tpu.cloudprovider.types import (
 )
 from karpenter_tpu.events.recorder import Event, Recorder
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.runtime.store import NotFound as StoreNotFound
 from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.scheduling.requirements import requirements_from_dicts
 from karpenter_tpu.scheduling.taints import (
@@ -134,10 +135,14 @@ class LifecycleController:
         claim.metadata.finalizers = [
             f for f in claim.metadata.finalizers if f != wk.TERMINATION_FINALIZER
         ]
+        # only "already gone" is benign here — typed not-found from the
+        # store or the cloud; anything else is a real failure that must
+        # surface to the reconciler harness (backoff + error metric)
+        # instead of being swallowed
         try:
             self.store.apply(claim)
             self.store.delete(claim)
-        except Exception:  # noqa: BLE001 — already gone
+        except (StoreNotFound, NodeClaimNotFoundError):
             pass
 
     # -- registration (registration.go:46-116) ------------------------------

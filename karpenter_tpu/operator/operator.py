@@ -48,6 +48,7 @@ from karpenter_tpu.controllers.nodepool_controllers import (
 from karpenter_tpu.controllers.provisioning import Provisioner
 from karpenter_tpu.events.recorder import Recorder
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.operator.harness import ReconcilerHarness
 from karpenter_tpu.operator.leaderelection import LeaderElector
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.runtime.store import DELETED, Store
@@ -93,6 +94,17 @@ class Operator:
         from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
 
         cloud_provider = MetricsCloudProvider(cloud_provider)
+        # circuit breaker OUTSIDE metrics: fast-fails never reach the inner
+        # provider, so they are not miscounted as provider errors/latency
+        from karpenter_tpu.cloudprovider.breaker import BreakerCloudProvider
+
+        cloud_provider = BreakerCloudProvider(
+            cloud_provider,
+            clock=self.clock,
+            threshold=self.options.cloud_breaker_threshold,
+            cooldown=self.options.cloud_breaker_cooldown,
+        )
+        self.breaker = cloud_provider.breaker
         self.cloud_provider = cloud_provider
         self.recorder = Recorder(clock=self.clock)
         self.cluster = Cluster(
@@ -166,6 +178,57 @@ class Operator:
             enabled=not self.options.disable_leader_election,
         )
 
+        # -- reconciler harness (operator/harness.py): every controller call
+        # in run_once/_dispatch/_resync goes through a named Reconciler so
+        # one failure is isolated, counted, and backed off per-item instead
+        # of aborting the pass (the reference gets this from
+        # controller-runtime's workqueue; SURVEY.md §2).
+        self.harness = ReconcilerHarness(
+            self.clock,
+            base_delay=self.options.requeue_base_delay,
+            max_delay=self.options.requeue_max_delay,
+        )
+        # refreshed once per pass; probes read the cache (see _solver_health)
+        self._solver_health_cache: Optional[dict] = None
+        reg = self.harness.register
+        self.r_lifecycle = reg("nodeclaim.lifecycle", self.lifecycle.reconcile)
+        self.r_nc_disruption = reg("nodeclaim.disruption", self.nc_disruption.reconcile)
+        self.r_expiration = reg("nodeclaim.expiration", self.expiration.reconcile)
+        self.r_consistency = reg("nodeclaim.consistency", self.consistency.reconcile)
+        self.r_hydration_claim = reg("nodeclaim.hydration", self.hydration.reconcile_claim)
+        self.r_podevents = reg("nodeclaim.podevents", self.podevents.on_pod_event)
+        self.r_gc = reg("nodeclaim.garbagecollection", self.gc.reconcile)
+        self.r_termination = reg("node.termination", self.termination.reconcile)
+        self.r_eviction_queue = reg("node.termination.eviction", self.eviction_queue.reconcile)
+        self.r_node_health = reg("node.health", self.health.reconcile)
+        self.r_hydration_node = reg("node.hydration", self.hydration.reconcile_node)
+        self.r_np_hash = reg("nodepool.hash", self.np_hash.reconcile)
+        self.r_np_validation = reg("nodepool.validation", self.np_validation.reconcile)
+        self.r_np_readiness = reg("nodepool.readiness", self.np_readiness.reconcile)
+        self.r_np_registration_health = reg(
+            "nodepool.registrationhealth", self.np_registration_health.reconcile
+        )
+        self.r_np_counter = reg("nodepool.counter", self.np_counter.reconcile)
+        self.r_binding = reg("binding", self.binding.reconcile)
+        self.r_provisioner = reg("provisioning", self._provision)
+        self.r_disruption = reg("disruption", self.disruption.reconcile)
+        self.r_disruption_queue = reg("disruption.queue", self.disruption_queue.reconcile)
+        self.r_kwok_tick = reg(
+            "kwok.fakekubelet", lambda: self.cloud_provider.tick()
+        )
+        self.r_overlay_validation = None
+        if self.overlay_validation is not None:
+            self.r_overlay_validation = reg(
+                "nodeoverlay.validation", self.overlay_validation.reconcile_all
+            )
+        self.r_pod_metrics = reg("metrics.pod", self.pod_metrics.reconcile)
+        # distinct name: a successful on_delete must not reset the
+        # reconcile path's consecutive-failure health accounting
+        self.r_pod_metrics_delete = reg("metrics.pod.delete", self.pod_metrics.on_delete)
+        self.r_node_metrics = reg("metrics.node", self.node_metrics.reconcile)
+        self.r_nodepool_metrics = reg("metrics.nodepool", self.nodepool_metrics.reconcile)
+        self.r_condition_metrics = reg("metrics.status", self.condition_metrics.reconcile)
+
     # -- the loop -----------------------------------------------------------
 
     def run_once(self) -> dict:
@@ -187,9 +250,15 @@ class Operator:
             # by the full resync on the first leader pass
             for event in self._dispatch_watch.drain():
                 if event.kind == "Pod" and event.type == DELETED:
-                    self.pod_metrics.on_delete(
-                        event.obj.metadata.namespace, event.obj.metadata.name
+                    self.r_pod_metrics_delete(
+                        event.obj.metadata.namespace,
+                        event.obj.metadata.name,
+                        item=_obj_item(event.obj),
                     )
+            # a warm standby is a healthy replica: its pass did everything
+            # a standby pass is supposed to do
+            self.harness.note_pass()
+            self._refresh_solver_health()
             return summary
         if not getattr(self, "_was_leader", False):
             # just took over (or first pass): events dropped while standing
@@ -202,50 +271,57 @@ class Operator:
         self._dispatch()
         # kwok fake kubelet fabricates due nodes before controllers run
         if hasattr(self.cloud_provider, "tick"):
-            summary["fabricated"] = self.cloud_provider.tick() or 0
+            summary["fabricated"] = self.r_kwok_tick() or 0
         self.informer.flush()
         # Periodic sweeps stand in for the reference's RequeueAfter timers:
         # registration waits on node appearance, liveness/expiration on the
         # clock, termination on drain progress — all time-, not event-driven.
         for claim in self.store.list("NodeClaim"):
-            self.lifecycle.reconcile(claim)
+            item = _obj_item(claim)
+            self.r_lifecycle(claim, item=item)
             if self.store.try_get("NodeClaim", claim.metadata.name) is None:
                 continue
-            self.nc_disruption.reconcile(claim)
-            self.expiration.reconcile(claim)
+            self.r_nc_disruption(claim, item=item)
+            self.r_expiration(claim, item=item)
         for node in self.store.list(
             "Node", predicate=lambda n: n.metadata.deletion_timestamp is not None
         ):
-            self.termination.reconcile(node)
+            self.r_termination(node, item=_obj_item(node))
         self.informer.flush()
         # Fake kube-scheduler: bind placeable pods before provisioning so the
         # solver only sees genuinely unsatisfiable demand.
-        summary["bound"] = self.binding.reconcile()
+        summary["bound"] = self.r_binding() or 0
         self.informer.flush()
-        # Reference requeues provisionable pods every 10s (provisioning/
-        # controller.go RequeueAfter): re-trigger each pass so pods left
-        # pending after a batch re-enter the next window instead of being
-        # stranded once their watch event is consumed.
-        if self.overlay_validation is not None:
-            self.overlay_validation.reconcile_all()
+        if self.r_overlay_validation is not None:
+            self.r_overlay_validation()
+        results = self.r_provisioner()
+        if results is not None:
+            summary["provisioned"] = len(results.new_node_claims)
+        self.r_disruption()
+        self.r_disruption_queue()
+        self.r_eviction_queue()
+        self.r_gc()
+        self.informer.flush()
+        self.r_pod_metrics()
+        self.r_node_metrics()
+        self.r_nodepool_metrics()
+        self.r_condition_metrics()
+        self.harness.note_pass()
+        self._refresh_solver_health()
+        return summary
+
+    def _provision(self):
+        """One provisioning reconcile: re-trigger every provisionable pod
+        (the reference requeues them every 10s — provisioning/controller.go
+        RequeueAfter — so pods left pending after a batch re-enter the next
+        window instead of being stranded once their watch event is
+        consumed), then batch-solve."""
         # pay the solver's encode/compile cold cost at idle, not inside the
         # first batch (no-op once the engine for the current catalog is warm)
         self.provisioner.prewarm()
         for pending in self.store.list("Pod", predicate=podutil.is_provisionable):
             self.provisioner.trigger(pending.metadata.uid)
-        results = self.provisioner.reconcile()
-        if results is not None:
-            summary["provisioned"] = len(results.new_node_claims)
-        self.disruption.reconcile()
-        self.disruption_queue.reconcile()
-        self.eviction_queue.reconcile()
-        self.gc.reconcile()
-        self.informer.flush()
-        self.pod_metrics.reconcile()
-        self.node_metrics.reconcile()
-        self.nodepool_metrics.reconcile()
-        self.condition_metrics.reconcile()
-        return summary
+        return self.provisioner.reconcile()
 
     def run(self, passes: int = 1) -> None:
         for _ in range(passes):
@@ -257,18 +333,21 @@ class Operator:
         dropped while standing by."""
         self.informer.flush()
         for pool in self.store.list("NodePool"):
-            self.np_hash.reconcile(pool)
-            self.np_validation.reconcile(pool)
-            self.np_readiness.reconcile(pool)
-            self.np_registration_health.reconcile(pool)
-            self.np_counter.reconcile(pool)
+            item = _obj_item(pool)
+            self.r_np_hash(pool, item=item)
+            self.r_np_validation(pool, item=item)
+            self.r_np_readiness(pool, item=item)
+            self.r_np_registration_health(pool, item=item)
+            self.r_np_counter(pool, item=item)
         for node in self.store.list("Node"):
             if node.metadata.deletion_timestamp is None:
-                self.health.reconcile(node)
-                self.hydration.reconcile_node(node)
+                item = _obj_item(node)
+                self.r_node_health(node, item=item)
+                self.r_hydration_node(node, item=item)
         for claim in self.store.list("NodeClaim"):
-            self.consistency.reconcile(claim)
-            self.hydration.reconcile_claim(claim)
+            item = _obj_item(claim)
+            self.r_consistency(claim, item=item)
+            self.r_hydration_claim(claim, item=item)
         # podevents deliberately NOT resynced: stamping lastPodEventTime
         # for every existing pod would reset consolidateAfter windows; a
         # missed event only delays consolidation, which is the safe side.
@@ -276,13 +355,14 @@ class Operator:
     def _dispatch(self) -> None:
         for event in self._dispatch_watch.drain():
             obj = event.obj
+            item = _obj_item(obj)
             if event.kind == "Pod":
                 if event.type != DELETED and podutil.is_provisionable(obj):
                     self.provisioner.trigger(obj.metadata.uid)
-                self.podevents.on_pod_event(obj)
+                self.r_podevents(obj, item=item)
                 if event.type == DELETED:
-                    self.pod_metrics.on_delete(
-                        obj.metadata.namespace, obj.metadata.name
+                    self.r_pod_metrics_delete(
+                        obj.metadata.namespace, obj.metadata.name, item=item
                     )
             elif event.kind == "NodeClaim":
                 if event.type == DELETED:
@@ -290,35 +370,35 @@ class Operator:
                 live = self.store.try_get("NodeClaim", obj.metadata.name)
                 if live is None:
                     continue
-                self.lifecycle.reconcile(live)
+                self.r_lifecycle(live, item=item)
                 if self.store.try_get("NodeClaim", obj.metadata.name) is None:
                     continue
-                self.nc_disruption.reconcile(live)
-                self.expiration.reconcile(live)
-                self.consistency.reconcile(live)
-                self.hydration.reconcile_claim(live)
+                self.r_nc_disruption(live, item=item)
+                self.r_expiration(live, item=item)
+                self.r_consistency(live, item=item)
+                self.r_hydration_claim(live, item=item)
             elif event.kind == "Node":
                 if event.type == DELETED:
                     continue
                 live = self.store.try_get("Node", obj.metadata.name)
                 if live is None:
                     continue
-                self.termination.reconcile(live)
+                self.r_termination(live, item=item)
                 if self.store.try_get("Node", obj.metadata.name) is None:
                     continue
-                self.health.reconcile(live)
-                self.hydration.reconcile_node(live)
+                self.r_node_health(live, item=item)
+                self.r_hydration_node(live, item=item)
             elif event.kind == "NodePool":
                 if event.type == DELETED:
                     continue
                 live = self.store.try_get("NodePool", obj.metadata.name)
                 if live is None:
                     continue
-                self.np_hash.reconcile(live)
-                self.np_validation.reconcile(live)
-                self.np_readiness.reconcile(live)
-                self.np_registration_health.reconcile(live)
-                self.np_counter.reconcile(live)
+                self.r_np_hash(live, item=item)
+                self.r_np_validation(live, item=item)
+                self.r_np_readiness(live, item=item)
+                self.r_np_registration_health(live, item=item)
+                self.r_np_counter(live, item=item)
 
     def shutdown(self) -> None:
         """Clean shutdown: release the leader lease so a standby replica
@@ -338,4 +418,81 @@ class Operator:
         return self.provisioner.solver.stats()
 
     def healthy(self) -> bool:
-        return True
+        """Real liveness: degraded when any controller is failing
+        consecutively, the cloud-provider circuit breaker is open, solverd
+        is unreachable, or a leader stopped completing passes."""
+        return not self._degraded_reasons(self._solver_health())
+
+    def ready(self) -> bool:
+        """Readiness: at least one pass (leader or warm standby) completed."""
+        return self.harness.passes > 0
+
+    def _solver_health(self) -> dict:
+        """Solverd reachability, CACHED per reconcile pass: /healthz is a
+        probe path, and the socket transport's stats() RPC serializes
+        behind the same lock as an in-flight solve — a probe must never
+        block on (or hammer) the daemon. run_once refreshes the cache; a
+        probe before the first pass computes it once lazily."""
+        if self._solver_health_cache is None:
+            self._refresh_solver_health()
+        return self._solver_health_cache
+
+    def _refresh_solver_health(self) -> None:
+        try:
+            stats = self.provisioner.solver.stats()
+        except Exception as e:  # noqa: BLE001 — health must not raise
+            self._solver_health_cache = {
+                "reachable": False,
+                "error": f"{type(e).__name__}: {e}",
+            }
+            return
+        out = {
+            "transport": stats.get("transport", "unknown"),
+            "reachable": "error" not in stats,
+        }
+        if "error" in stats:
+            out["error"] = stats["error"]
+        if "reconnects" in stats:
+            out["reconnects"] = stats["reconnects"]
+        self._solver_health_cache = out
+
+    def _degraded_reasons(self, solver_health: dict) -> list[str]:
+        reasons = []
+        for name in self.harness.degraded_controllers():
+            reasons.append(f"controller {name} failing consecutively")
+        if self.breaker.state != self.breaker.CLOSED:
+            reasons.append(
+                f"cloud provider circuit breaker {self.breaker.state}"
+            )
+        if not solver_health["reachable"]:
+            reasons.append("solverd unreachable")
+        if self.harness.stale():
+            reasons.append("no successful reconcile pass recently")
+        return reasons
+
+    def health_snapshot(self) -> dict:
+        """Structured health for /healthz and /debug/health: pass liveness,
+        per-controller consecutive-failure counts, breaker state, and
+        solverd reachability, plus the reasons for any degradation. One
+        solver-health read feeds both the verdict and the body, so they
+        can never disagree."""
+        solver_health = self._solver_health()
+        reasons = self._degraded_reasons(solver_health)
+        snap = self.harness.snapshot()
+        return {
+            "healthy": not reasons,
+            "status": "ok" if not reasons else "degraded",
+            "degraded_reasons": reasons,
+            "leader": getattr(self, "_was_leader", False),
+            "cloud_provider_breaker": self.breaker.snapshot(),
+            "solverd": solver_health,
+            **snap,
+        }
+
+
+def _obj_item(obj) -> str:
+    """Backoff item key for an object: kind/name (namespaces are single
+    in this build; pods include it for uniqueness)."""
+    meta = obj.metadata
+    ns = getattr(meta, "namespace", "") or ""
+    return f"{obj.KIND}/{ns}/{meta.name}" if ns else f"{obj.KIND}/{meta.name}"

@@ -72,6 +72,15 @@ class Options:
     solverd_queue_depth: int = 256  # admission queue depth (shed past it)
     solverd_coalesce_window: float = 0.0  # seconds the batch leader waits
 
+    # reconciler harness (operator/harness.py): per-item exponential
+    # backoff bounds for failing reconciles, and the cloud-provider circuit
+    # breaker (consecutive retryable create/delete failures before opening;
+    # seconds open before a half-open probe). threshold 0 disables.
+    requeue_base_delay: float = 1.0
+    requeue_max_delay: float = 120.0
+    cloud_breaker_threshold: int = 5
+    cloud_breaker_cooldown: float = 30.0
+
     @classmethod
     def parse(cls, argv: Optional[list[str]] = None, env: Optional[dict] = None) -> "Options":
         import sys
@@ -110,6 +119,10 @@ class Options:
         parser.add_argument("--solver-daemon-address")
         parser.add_argument("--solverd-queue-depth", type=int)
         parser.add_argument("--solverd-coalesce-window", type=float)
+        parser.add_argument("--requeue-base-delay", type=float)
+        parser.add_argument("--requeue-max-delay", type=float)
+        parser.add_argument("--cloud-breaker-threshold", type=int)
+        parser.add_argument("--cloud-breaker-cooldown", type=float)
         ns = parser.parse_args(argv)
 
         opts = cls()

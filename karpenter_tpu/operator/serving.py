@@ -27,6 +27,7 @@ class ServingConfig:
         ready: Callable[[], bool],
         enable_profiling: bool = False,
         solverd_stats: Optional[Callable[[], dict]] = None,
+        health_snapshot: Optional[Callable[[], dict]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -35,6 +36,10 @@ class ServingConfig:
         # solverd introspection (queue depth, batches, coalesce stats);
         # served at /debug/solverd when wired (operator.solver_stats)
         self.solverd_stats = solverd_stats
+        # structured health (operator.health_snapshot): when wired, /healthz
+        # serves the snapshot as JSON (503 when degraded, with the reasons
+        # in the body) and /debug/health always returns the full document
+        self.health_snapshot = health_snapshot
 
 
 def _profile_sample(seconds: float, interval: float = 0.01) -> str:
@@ -105,11 +110,30 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path == "/metrics":
                 self._respond(200, cfg.metrics_text(), "text/plain; version=0.0.4")
             elif url.path == "/healthz":
-                ok = cfg.healthy()
-                self._respond(200 if ok else 500, "ok" if ok else "unhealthy")
+                if cfg.health_snapshot is not None:
+                    import json
+
+                    snap = cfg.health_snapshot()
+                    self._respond(
+                        200 if snap.get("healthy") else 503,
+                        json.dumps(snap),
+                        "application/json",
+                    )
+                else:
+                    ok = cfg.healthy()
+                    self._respond(200 if ok else 500, "ok" if ok else "unhealthy")
             elif url.path == "/readyz":
                 ok = cfg.ready()
                 self._respond(200 if ok else 500, "ok" if ok else "not ready")
+            elif url.path == "/debug/health" and cfg.health_snapshot is not None:
+                import json
+
+                # the full health document, always 200: this is the operator
+                # debugging surface, not the probe — a degraded operator must
+                # still explain itself
+                self._respond(
+                    200, json.dumps(cfg.health_snapshot()), "application/json"
+                )
             elif url.path == "/debug/solverd" and cfg.solverd_stats is not None:
                 import json
 

@@ -69,8 +69,15 @@ class Accountant:
             "capacity_reclaims": 0,
             "launch_failures": 0,
             "capacity_errors": 0,
+            "cloud_outage_failures": 0,
             "solver_rejections": 0,
             "pods_lost": 0,
+        }
+        breaker = {
+            "opens": 0,
+            "half_opens": 0,
+            "closes": 0,
+            "state_at_end": "closed",
         }
         max_nodes = 0
 
@@ -120,8 +127,19 @@ class Accountant:
                 faults["launch_failures"] += 1
             elif ev == "fault-ice":
                 faults["capacity_errors"] += 1
+            elif ev == "fault-outage":
+                faults["cloud_outage_failures"] += 1
             elif ev == "fault-solver-reject":
                 faults["solver_rejections"] += 1
+            elif ev == "breaker":
+                to = e["to"]
+                if to == "open":
+                    breaker["opens"] += 1
+                elif to == "half-open":
+                    breaker["half_opens"] += 1
+                elif to == "closed":
+                    breaker["closes"] += 1
+                breaker["state_at_end"] = to
 
         # nodes still up at the end of the run accrue cost to the horizon
         for entry in node_added.values():
@@ -159,6 +177,7 @@ class Accountant:
                 "nodes_at_end": len(node_added),
             },
             "faults": faults,
+            "breaker": breaker,
         }
         if solver_stats is not None:
             report["solver"] = solver_stats

@@ -28,11 +28,20 @@ def _noop_on_fault(ev: str, **fields) -> None:
     pass
 
 
+class CloudOutageError(Exception):
+    """Total cloud-API outage: create/delete fail untyped, the way a real
+    region event looks to a controller. Deliberately NOT one of the typed
+    domain errors — the lifecycle controller does not catch it, so it
+    propagates to the reconciler harness (per-item backoff) and counts as
+    a retryable failure for the circuit breaker."""
+
+
 class FaultyCloudProvider(CloudProvider):
-    """Wraps any CloudProvider with probabilistic launch failures and API
-    latency. Latency advances VIRTUAL time (clock.sleep) — under the
-    simulator's FakeClock the whole control loop experiences a slow cloud
-    API without any wall-clock cost."""
+    """Wraps any CloudProvider with probabilistic launch failures, API
+    latency, and scheduled full-API outage windows. Latency advances
+    VIRTUAL time (clock.sleep) — under the simulator's FakeClock the whole
+    control loop experiences a slow cloud API without any wall-clock
+    cost."""
 
     def __init__(
         self,
@@ -43,6 +52,7 @@ class FaultyCloudProvider(CloudProvider):
         insufficient_capacity_rate: float = 0.0,
         api_latency: float = 0.0,
         api_jitter: float = 0.0,
+        outages: Optional[list[tuple[float, float]]] = None,
         on_fault: Optional[OnFault] = None,
     ):
         self.inner = inner
@@ -52,17 +62,31 @@ class FaultyCloudProvider(CloudProvider):
         self.insufficient_capacity_rate = insufficient_capacity_rate
         self.api_latency = api_latency
         self.api_jitter = api_jitter
+        # absolute virtual-time [start, end) windows where EVERY
+        # create/delete raises CloudOutageError
+        self.outages = list(outages or [])
         self.on_fault = on_fault or _noop_on_fault
         self.launch_failures = 0
         self.capacity_errors = 0
+        self.outage_failures = 0
 
     def _lag(self) -> None:
         if self.api_latency <= 0 and self.api_jitter <= 0:
             return
         self.clock.sleep(self.api_latency + self.api_jitter * self.rng.random())
 
+    def _outage(self, op: str, node_claim) -> None:
+        now = self.clock.now()
+        if any(start <= now < end for start, end in self.outages):
+            self.outage_failures += 1
+            self.on_fault(
+                "fault-outage", op=op, nodeclaim=node_claim.metadata.name
+            )
+            raise CloudOutageError(f"sim: injected cloud outage ({op})")
+
     def create(self, node_claim):
         self._lag()
+        self._outage("create", node_claim)
         roll = self.rng.random()
         if roll < self.launch_failure_rate:
             self.launch_failures += 1
@@ -79,6 +103,7 @@ class FaultyCloudProvider(CloudProvider):
 
     def delete(self, node_claim):
         self._lag()
+        self._outage("delete", node_claim)
         return self.inner.delete(node_claim)
 
     def get(self, provider_id: str):

@@ -136,10 +136,23 @@ class Simulation:
             insufficient_capacity_rate=faults.get("insufficient_capacity_rate", 0.0),
             api_latency=faults.get("api_latency", 0.0),
             api_jitter=faults.get("api_jitter", 0.0),
+            outages=[
+                (self.t0 + float(o["at"]), self.t0 + float(o["at"]) + float(o["duration"]))
+                for o in faults.get("outages", [])
+            ],
             on_fault=self._on_fault,
         )
         self.operator = Operator(
             self.store, self.provider, clock=self.clock, options=options or Options()
+        )
+        # the operator's cloud-provider circuit breaker is part of the
+        # scenario's observable record: every transition lands in the event
+        # log (deterministic — virtual time, seeded faults), and the
+        # Accountant folds them into report["breaker"]
+        self.operator.breaker.subscribe(
+            lambda old, new: self.log.append(
+                self._rel(self.clock.now()), "breaker", **{"from": old, "to": new}
+            )
         )
         rejection_rate = faults.get("solver_rejection_rate", 0.0)
         if rejection_rate > 0:

@@ -17,7 +17,10 @@ artifact. Version 1 shape:
         "insufficient_capacity_rate": 0.0, # ICE (claim deleted, re-solved)
         "api_latency": 0.0,                # virtual s added per cloud call
         "api_jitter": 0.0,                 # + uniform[0, jitter)
-        "solver_rejection_rate": 0.0       # QueueFullError per solve
+        "solver_rejection_rate": 0.0,      # QueueFullError per solve
+        "outages": [                       # scheduled FULL cloud-API
+          {"at": 150.0, "duration": 50.0}  #   outages: every create/delete
+        ]                                  #   raises (untyped, retryable)
       },
       "events": [                   # sorted by "at" (virtual s from start)
         {"at": 5.0, "kind": "submit", "group": "web", "count": 6,
@@ -242,8 +245,12 @@ def tpu_training(rng: Random) -> dict:
 
 def flaky_cloud(rng: Random) -> dict:
     """Steady demand against a misbehaving cloud: probabilistic launch
-    failures, occasional capacity errors, API latency, and a solver
-    shedding part of its load — the graceful-degradation gauntlet."""
+    failures, occasional capacity errors, API latency, a solver shedding
+    part of its load, and a scheduled FULL cloud-API outage — the
+    graceful-degradation gauntlet. The outage (with an interruption inside
+    it forcing cloud deletes) drives the operator's circuit breaker through
+    closed → open → half-open → closed and exercises per-item reconcile
+    backoff, all in virtual time."""
     trace = _base("flaky-cloud", duration=360.0)
     trace["faults"] = {
         "launch_failure_rate": 0.3,
@@ -251,6 +258,9 @@ def flaky_cloud(rng: Random) -> dict:
         "api_latency": 0.2,
         "api_jitter": 0.3,
         "solver_rejection_rate": 0.25,
+        # long enough that the first half-open probe (default 30s cooldown)
+        # fails and re-opens the breaker before recovery closes it
+        "outages": [{"at": 150.0, "duration": 50.0}],
     }
     trace["events"] = [
         {
@@ -261,5 +271,9 @@ def flaky_cloud(rng: Random) -> dict:
             "pod": {"cpu": "2", "memory": "2Gi"},
             "replace": True,
         },
+        # a graceful interruption mid-outage: its finalizer needs a cloud
+        # delete, which fails until the breaker recovers — the per-item
+        # backoff path for deletes
+        {"at": 160.0, "kind": "interrupt", "count": 1, "mode": "graceful"},
     ]
     return trace

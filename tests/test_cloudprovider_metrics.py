@@ -39,6 +39,9 @@ class TestMetricsCloudProvider:
             "method": "get",
             "provider": "fake",
             "error": "NodeClaimNotFoundError",
+            # typed not-found is a domain answer, not an infrastructure
+            # failure — the retryable label separates outage signals
+            "retryable": "false",
         }
         before = _ERRORS.value(labels)
         with pytest.raises(NodeClaimNotFoundError):
@@ -55,7 +58,11 @@ class TestMetricsCloudProvider:
         clock = FakeClock()
         store = Store(clock=clock)
         op = Operator(store, KwokCloudProvider(store, clock), clock=clock)
-        assert isinstance(op.cloud_provider, MetricsCloudProvider)
+        # breaker OUTSIDE metrics, so fast-fails never reach the meters
+        from karpenter_tpu.cloudprovider.breaker import BreakerCloudProvider
+
+        assert isinstance(op.cloud_provider, BreakerCloudProvider)
+        assert isinstance(op.cloud_provider._inner, MetricsCloudProvider)
         store.create(nodepool("workers"))
         store.create(unschedulable_pod(requests={"cpu": "1"}))
         for _ in range(8):

@@ -1,0 +1,510 @@
+"""Reconciler harness (ISSUE 3): per-item backoff growth/jitter/cap/reset
+under FakeClock, circuit-breaker open/half-open/close transitions, chaos
+isolation (one controller raising every pass must not stop the others),
+and the real health surface (/healthz JSON, /debug/health)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.cloudprovider.breaker import BreakerCloudProvider
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.cloudprovider.types import (
+    CircuitBreakerOpenError,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    is_retryable_error,
+)
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.operator.harness import (
+    BackoffRateLimiter,
+    CircuitBreaker,
+    RECONCILE_ERRORS,
+    ReconcilerHarness,
+    Result,
+)
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+
+class TestBackoffRateLimiter:
+    def _limiter(self, **kw):
+        clock = FakeClock()
+        return clock, BackoffRateLimiter(clock, **kw)
+
+    def test_growth_curve_and_jitter_bounds(self):
+        """delay(n) in [min(cap, base*factor^(n-1)), min(cap, that*(1+jitter))]."""
+        _, limiter = self._limiter(base=1.0, cap=300.0, factor=2.0, jitter=0.5)
+        for n in range(1, 8):
+            raw = 1.0 * 2.0 ** (n - 1)
+            delay = limiter.failure("item")
+            assert raw <= delay <= raw * 1.5, (n, delay)
+
+    def test_cap_is_a_hard_ceiling(self):
+        _, limiter = self._limiter(base=1.0, cap=10.0, jitter=0.5)
+        for _ in range(12):
+            delay = limiter.failure("item")
+            assert delay <= 10.0
+
+    def test_reset_on_success(self):
+        clock, limiter = self._limiter(base=1.0, cap=100.0, jitter=0.0)
+        for _ in range(5):
+            limiter.failure("item")
+        assert limiter.retries("item") == 5
+        limiter.success("item")
+        assert limiter.retries("item") == 0
+        assert limiter.allowed("item")
+        # the growth curve restarts from the base
+        assert limiter.failure("item") == pytest.approx(1.0)
+
+    def test_allowed_tracks_virtual_time(self):
+        clock, limiter = self._limiter(base=4.0, jitter=0.0)
+        assert limiter.allowed("item")  # never-failed items are always due
+        delay = limiter.failure("item")
+        assert not limiter.allowed("item")
+        clock.step(delay + 0.001)
+        assert limiter.allowed("item")
+
+    def test_items_are_independent(self):
+        _, limiter = self._limiter(jitter=0.0)
+        limiter.failure("a")
+        assert not limiter.allowed("a")
+        assert limiter.allowed("b")
+
+    def test_deterministic_given_same_failure_sequence(self):
+        _, l1 = self._limiter()
+        _, l2 = self._limiter()
+        assert [l1.failure("x") for _ in range(6)] == [
+            l2.failure("x") for _ in range(6)
+        ]
+
+
+class TestCircuitBreaker:
+    def _breaker(self, threshold=3, cooldown=30.0):
+        clock = FakeClock()
+        return clock, CircuitBreaker(clock, threshold=threshold, cooldown=cooldown)
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        _, cb = self._breaker(threshold=3)
+        for _ in range(2):
+            cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        assert not cb.allow()
+
+    def test_success_resets_the_streak(self):
+        _, cb = self._breaker(threshold=3)
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED
+
+    def test_half_open_probe_after_cooldown_then_close(self):
+        clock, cb = self._breaker(threshold=1, cooldown=30.0)
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        clock.step(29.0)
+        assert not cb.allow()
+        clock.step(1.0)
+        assert cb.allow()  # the single probe
+        assert cb.state == CircuitBreaker.HALF_OPEN
+        assert not cb.allow()  # no second call while the probe is out
+        cb.record_success()
+        assert cb.state == CircuitBreaker.CLOSED
+        assert cb.consecutive_failures == 0
+
+    def test_half_open_probe_failure_reopens(self):
+        clock, cb = self._breaker(threshold=1, cooldown=30.0)
+        cb.record_failure()
+        clock.step(30.0)
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        # the cooldown restarts from the re-open
+        clock.step(29.0)
+        assert not cb.allow()
+        clock.step(1.0)
+        assert cb.allow()
+
+    def test_disabled_breaker_never_opens(self):
+        _, cb = self._breaker(threshold=0)
+        for _ in range(50):
+            cb.record_failure()
+            assert cb.allow()
+        assert cb.state == CircuitBreaker.CLOSED
+
+    def test_transitions_are_observable(self):
+        clock, cb = self._breaker(threshold=1, cooldown=10.0)
+        seen = []
+        cb.subscribe(lambda old, new: seen.append((old, new)))
+        cb.record_failure()
+        clock.step(10.0)
+        cb.allow()
+        cb.record_success()
+        assert seen == [
+            ("closed", "open"),
+            ("open", "half-open"),
+            ("half-open", "closed"),
+        ]
+
+    def test_snapshot_shape(self):
+        clock, cb = self._breaker(threshold=2, cooldown=15.0)
+        cb.record_failure()
+        cb.record_failure()
+        snap = cb.snapshot()
+        assert snap["state"] == "open"
+        assert snap["consecutive_failures"] == 2
+        assert snap["opened_at"] == clock.now()
+        assert 0 < snap["retry_after_seconds"] <= 15.0
+
+
+class TestHarnessIsolation:
+    def _harness(self):
+        clock = FakeClock()
+        return clock, ReconcilerHarness(clock, base_delay=1.0, max_delay=60.0)
+
+    def test_exception_is_swallowed_counted_and_backed_off(self):
+        clock, harness = self._harness()
+        calls = []
+
+        def boom():
+            calls.append(clock.now())
+            raise RuntimeError("kaput")
+
+        rec = harness.register("boom", boom)
+        errors0 = RECONCILE_ERRORS.value({"controller": "boom"})
+        assert rec() is None  # raised, swallowed
+        assert RECONCILE_ERRORS.value({"controller": "boom"}) == errors0 + 1
+        assert rec() is None  # backed off: NOT called again
+        assert len(calls) == 1
+        clock.step(2.0)  # past base*1.5 worst-case jitter
+        rec()
+        assert len(calls) == 2
+
+    def test_per_item_backoff_does_not_block_other_items(self):
+        clock, harness = self._harness()
+
+        def only_a_fails(obj):
+            if obj == "a":
+                raise RuntimeError("a is broken")
+            return obj
+
+        rec = harness.register("picky", only_a_fails)
+        assert rec("a", item="a") is None
+        assert rec("b", item="b") == "b"  # a's backoff is not b's problem
+
+    def test_result_requeue_after_defers_without_failure(self):
+        clock, harness = self._harness()
+        calls = []
+
+        def periodic():
+            calls.append(clock.now())
+            return Result(requeue_after=10.0)
+
+        rec = harness.register("periodic", periodic)
+        rec()
+        rec()  # deferred — not due yet
+        assert len(calls) == 1
+        clock.step(10.0)
+        rec()
+        assert len(calls) == 2
+        assert harness._consecutive.get("periodic", 0) == 0
+
+    def test_degraded_controllers_require_consecutive_failures(self):
+        clock, harness = self._harness()
+        flaky = {"fail": True}
+
+        def sometimes():
+            if flaky["fail"]:
+                raise RuntimeError("nope")
+
+        rec = harness.register("sometimes", sometimes)
+        for _ in range(2):
+            rec()
+            clock.step(120.0)
+        assert harness.degraded_controllers() == []
+        rec()
+        clock.step(120.0)
+        assert harness.degraded_controllers() == ["sometimes"]
+        flaky["fail"] = False
+        rec()
+        assert harness.degraded_controllers() == []
+
+
+def make_operator(options=None):
+    clock = FakeClock()
+    store = Store(clock=clock)
+    provider = KwokCloudProvider(store, clock)
+    op = Operator(store, provider, clock=clock, options=options or Options())
+    return clock, store, op
+
+
+def settle(clock, op, passes=12, step=2.0):
+    for _ in range(passes):
+        clock.step(step)
+        op.run_once()
+
+
+class TestChaosIsolation:
+    """ISSUE 3 acceptance: a controller stubbed to raise on every reconcile
+    must not stop run_once, other controllers' writes still land, the error
+    metric increments, and healthy() flips to degraded."""
+
+    def test_failing_controller_does_not_take_down_the_pass(self):
+        clock, store, op = make_operator()
+        raises = {"n": 0}
+
+        def boom(*args, **kwargs):
+            raises["n"] += 1
+            raise RuntimeError("injected chaos")
+
+        # consistency runs in the per-claim dispatch/resync path, between
+        # hydration and the nodepool controllers — a worst-case blast radius
+        op.r_consistency.fn = boom
+        errors0 = RECONCILE_ERRORS.value({"controller": "nodeclaim.consistency"})
+        store.create(nodepool("workers"))
+        for _ in range(3):
+            store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        # the chaos controller really ran and raised...
+        assert raises["n"] >= 1
+        assert (
+            RECONCILE_ERRORS.value({"controller": "nodeclaim.consistency"})
+            > errors0
+        )
+        # ...and everything else still made progress: pods became nodes
+        assert len(store.list("Node")) >= 1
+        for claim in store.list("NodeClaim"):
+            assert claim.condition_is_true("Launched")
+            assert claim.condition_is_true("Registered")
+        assert all(p.spec.node_name for p in store.list("Pod"))
+
+    def test_healthy_flips_to_degraded_and_recovers(self):
+        clock, store, op = make_operator()
+        assert op.healthy() is True
+
+        real_fn = op.r_disruption.fn
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("injected chaos")
+
+        # disruption is a singleton that runs every pass
+        op.r_disruption.fn = boom
+        store.create(nodepool("workers"))
+        settle(clock, op, passes=8, step=70.0)  # outlive every backoff
+        snap = op.health_snapshot()
+        assert op.healthy() is False
+        assert snap["status"] == "degraded"
+        assert any("disruption" in r for r in snap["degraded_reasons"])
+        assert snap["controllers"]["disruption"]["consecutive_failures"] >= 3
+        assert "injected chaos" in snap["controllers"]["disruption"]["last_error"]
+        # fix the controller: one clean reconcile restores health
+        op.r_disruption.fn = real_fn
+        settle(clock, op, passes=4, step=70.0)
+        assert op.healthy() is True
+
+    def test_wedged_before_first_pass_goes_stale(self):
+        """An operator that never completes even its FIRST pass (hung
+        resync, deadlocked controller) must degrade after the grace
+        window, not report healthy forever."""
+        clock, store, op = make_operator()
+        assert op.healthy() is True  # inside the startup grace window
+        clock.step(61.0)  # STALE_PASS_AFTER with no pass ever landing
+        assert op.healthy() is False
+        assert any(
+            "pass" in r for r in op.health_snapshot()["degraded_reasons"]
+        )
+        op.run_once()  # the loop comes alive: healthy again
+        assert op.healthy() is True
+
+    def test_snapshot_reports_pass_liveness_and_solverd(self):
+        clock, store, op = make_operator()
+        snap = op.health_snapshot()
+        assert snap["passes"] == 0
+        assert snap["last_successful_pass"] is None
+        assert op.ready() is False
+        op.run_once()
+        snap = op.health_snapshot()
+        assert snap["passes"] == 1
+        assert snap["seconds_since_last_pass"] == 0.0
+        assert snap["solverd"]["reachable"] is True
+        assert snap["cloud_provider_breaker"]["state"] == "closed"
+        assert op.ready() is True
+
+
+class _AngryProvider(KwokCloudProvider):
+    """create/delete fail like a dead cloud API until switched off."""
+
+    def __init__(self, store, clock):
+        super().__init__(store, clock)
+        self.broken = True
+        self.create_attempts = 0
+
+    def create(self, node_claim):
+        self.create_attempts += 1
+        if self.broken:
+            raise RuntimeError("cloud API down")
+        return super().create(node_claim)
+
+    def delete(self, node_claim):
+        if self.broken:
+            raise RuntimeError("cloud API down")
+        return super().delete(node_claim)
+
+
+class TestCloudProviderBreaker:
+    def test_opens_fast_fails_and_recovers(self):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = BreakerCloudProvider(
+            _AngryProvider(store, clock), clock, threshold=3, cooldown=30.0
+        )
+        claim_store = Store(clock=clock)  # claims only, keep kwok happy
+        from test_sim_faults import make_claim
+
+        claim = make_claim(claim_store)
+        for _ in range(3):
+            with pytest.raises(RuntimeError):
+                provider.create(claim)
+        # open: fast-fail with the typed error, inner never called
+        attempts = provider._inner.create_attempts
+        with pytest.raises(CircuitBreakerOpenError) as exc:
+            provider.create(claim)
+        assert provider._inner.create_attempts == attempts
+        assert exc.value.retry_after > 0
+        assert exc.value.condition_reason == "CloudProviderCircuitOpen"
+        # delete shares the breaker
+        with pytest.raises(CircuitBreakerOpenError):
+            provider.delete(claim)
+        # recovery: cooldown elapses, the cloud is back, probe closes it
+        provider._inner.broken = False
+        clock.step(30.0)
+        created = provider.create(claim)
+        assert created.status.provider_id
+        assert provider.breaker.state == "closed"
+
+    def test_domain_errors_break_the_streak(self):
+        """A typed not-found from delete is the cloud ANSWERING — it must
+        reset the consecutive-failure streak instead of extending it."""
+        clock = FakeClock()
+        store = Store(clock=clock)
+
+        class _NotFoundProvider(_AngryProvider):
+            def delete(self, node_claim):
+                raise NodeClaimNotFoundError("gone")
+
+        provider = BreakerCloudProvider(
+            _NotFoundProvider(store, clock), clock, threshold=2
+        )
+        provider.breaker.consecutive_failures = 1
+        with pytest.raises(NodeClaimNotFoundError):
+            provider.delete(None)
+        assert provider.breaker.consecutive_failures == 0
+        assert provider.breaker.state == "closed"
+
+    def test_retryable_classification(self):
+        assert is_retryable_error(RuntimeError("boom"))
+        assert not is_retryable_error(NodeClaimNotFoundError())
+        assert not is_retryable_error(InsufficientCapacityError())
+        assert not is_retryable_error(CircuitBreakerOpenError("open"))
+
+    def test_breaker_state_metric_tracks_transitions(self):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = BreakerCloudProvider(
+            _AngryProvider(store, clock), clock, threshold=1, cooldown=5.0
+        )
+        gauge = global_registry.get(
+            "karpenter_cloudprovider_circuit_breaker_state"
+        )
+        labels = {"provider": "kwok"}
+        assert gauge.value(labels) == 0.0
+        with pytest.raises(RuntimeError):
+            provider.create(type("C", (), {"metadata": None})())
+        assert gauge.value(labels) == 2.0
+        provider._inner.broken = False
+        clock.step(5.0)
+        from test_sim_faults import make_claim
+
+        provider.create(make_claim(Store(clock=clock)))
+        assert gauge.value(labels) == 0.0
+
+
+class TestHealthServing:
+    """/healthz serves the structured snapshot (503 when degraded) and
+    /debug/health always returns the full document."""
+
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_healthz_json_and_debug_health(self):
+        from karpenter_tpu.operator.serving import Server, ServingConfig
+
+        state = {"healthy": True}
+
+        def snapshot():
+            return {
+                "healthy": state["healthy"],
+                "status": "ok" if state["healthy"] else "degraded",
+                "degraded_reasons": [] if state["healthy"] else ["boom"],
+            }
+
+        config = ServingConfig(
+            metrics_text=lambda: "",
+            healthy=lambda: state["healthy"],
+            ready=lambda: True,
+            health_snapshot=snapshot,
+        )
+        server = Server(0, config).start()
+        try:
+            code, body = self._get(server.port, "/healthz")
+            assert code == 200
+            assert json.loads(body)["status"] == "ok"
+            state["healthy"] = False
+            code, body = self._get(server.port, "/healthz")
+            assert code == 503
+            assert json.loads(body)["degraded_reasons"] == ["boom"]
+            # the debug surface always answers 200 with the full document
+            code, body = self._get(server.port, "/debug/health")
+            assert code == 200
+            assert json.loads(body)["status"] == "degraded"
+        finally:
+            server.stop()
+
+    def test_operator_end_to_end_snapshot_over_http(self):
+        from karpenter_tpu.operator.serving import Server, ServingConfig
+
+        clock, store, op = make_operator()
+        store.create(nodepool("workers"))
+        op.run_once()
+        config = ServingConfig(
+            metrics_text=op.metrics_text,
+            healthy=op.healthy,
+            ready=op.ready,
+            health_snapshot=op.health_snapshot,
+        )
+        server = Server(0, config).start()
+        try:
+            code, body = self._get(server.port, "/healthz")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["healthy"] is True
+            assert snap["cloud_provider_breaker"]["state"] == "closed"
+            assert "nodeclaim.lifecycle" in snap["controllers"]
+        finally:
+            server.stop()

@@ -104,6 +104,27 @@ class TestFlakyCloudScenario:
         assert result.report["slo"]["pods_never_bound"] == 0
         assert result.report["churn"]["nodes_at_end"] >= 1
 
+    def test_outage_trips_and_recovers_the_circuit_breaker(self, result):
+        """ISSUE 3 acceptance: the scheduled cloud outage must drive the
+        operator's circuit breaker through open → half-open → closed, all
+        recorded in the event log and folded into the report."""
+        assert result.report["faults"]["cloud_outage_failures"] >= 1
+        breaker = result.report["breaker"]
+        assert breaker["opens"] >= 1
+        assert breaker["half_opens"] >= 1
+        assert breaker["closes"] >= 1
+        assert breaker["state_at_end"] == "closed"
+        # transition order is sane: first open precedes the final close
+        transitions = [e for e in result.log if e["ev"] == "breaker"]
+        assert transitions[0]["to"] == "open"
+        assert transitions[-1]["to"] == "closed"
+
+    def test_deterministic_with_breaker_and_backoff(self, result):
+        """Backoff jitter and breaker timing are clock/seed-driven: the
+        same seed must replay to a byte-identical event log."""
+        again = run_scenario(scenarios.resolve("flaky-cloud", 7), 7)
+        assert again.digest == result.digest
+
 
 class TestFaultyCloudProvider:
     def _provider(self, **kwargs):
