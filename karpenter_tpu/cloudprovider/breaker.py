@@ -18,6 +18,7 @@ would blind the very controllers that drain a broken cloud's state.
 
 from __future__ import annotations
 
+from karpenter_tpu import tracing
 from karpenter_tpu.cloudprovider.types import (
     CircuitBreakerOpenError,
     is_retryable_error,
@@ -77,24 +78,36 @@ class BreakerCloudProvider:
         )
 
     def _guarded(self, method: str, *args):
-        if not self.breaker.allow():
-            retry_after = self.breaker.retry_after()
-            raise CircuitBreakerOpenError(
-                f"cloud provider circuit breaker open for {method!r} "
-                f"(retry in {retry_after:.1f}s)",
-                retry_after=retry_after,
-            )
-        try:
-            result = getattr(self._inner, method)(*args)
-        except Exception as e:
-            if is_retryable_error(e):
-                self.breaker.record_failure()
-            else:
-                # a typed domain answer: the cloud is alive and responding
-                self.breaker.record_success()
-            raise
-        self.breaker.record_success()
-        return result
+        # every guarded call is a span carrying breaker state — nested under
+        # whatever journey hop invoked it (nodeclaim.launch, finalization),
+        # so a fast-fail shows up in the pod's trace as exactly that
+        with tracing.tracer().span(
+            f"cloudprovider.{method}", breaker_state=self.breaker.state
+        ) as span:
+            if not self.breaker.allow():
+                retry_after = self.breaker.retry_after()
+                span.set_attr(fast_fail=True)
+                raise CircuitBreakerOpenError(
+                    f"cloud provider circuit breaker open for {method!r} "
+                    f"(retry in {retry_after:.1f}s)",
+                    retry_after=retry_after,
+                )
+            # allow() may have transitioned open -> half-open: record the
+            # state the call actually ran under
+            span.set_attr(breaker_state=self.breaker.state)
+            try:
+                result = getattr(self._inner, method)(*args)
+            except Exception as e:
+                if is_retryable_error(e):
+                    self.breaker.record_failure()
+                    span.set_attr(retryable=True)
+                else:
+                    # a typed domain answer: the cloud is alive and responding
+                    self.breaker.record_success()
+                    span.set_attr(retryable=False)
+                raise
+            self.breaker.record_success()
+            return result
 
     def create(self, node_claim):
         return self._guarded("create", node_claim)

@@ -27,6 +27,7 @@ from karpenter_tpu.scheduling.requirements import Requirements, strict_pod_requi
 from karpenter_tpu.scheduling.taints import Taints
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.statenode import StateNode
+from karpenter_tpu import tracing
 from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.clock import Clock
@@ -208,6 +209,25 @@ class BindingController:
         self.cluster.update_pod(pod)
         self._pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
         _PODS_BOUND.inc()
+        # final journey hop: re-join the pod's scheduling trace (linked at
+        # pod.schedule) — or the claim's, for pods the provisioner never
+        # named (e.g. daemonset-shaped arrivals onto a fresh node). A pod
+        # bound straight to pre-existing capacity roots a trivial trace.
+        tracer = tracing.tracer()
+        claim_name = (
+            sn.node_claim.metadata.name if sn.node_claim is not None else ""
+        )
+        ctx = tracer.linked("pod", pod.metadata.uid)
+        if ctx is None and claim_name:
+            ctx = tracer.linked("nodeclaim", claim_name)
+        tracer.event(
+            "pod.bind",
+            parent=ctx,
+            pod=pod.metadata.name,
+            pod_uid=pod.metadata.uid,
+            node=sn.node.metadata.name,
+            nodeclaim=claim_name,
+        )
         self.recorder.publish(
             Event(pod, "Normal", "Scheduled", f"bound to {sn.node.metadata.name}")
         )

@@ -38,6 +38,7 @@ from karpenter_tpu.scheduling.taints import (
 )
 from karpenter_tpu.utils import resources as res
 from karpenter_tpu.utils.clock import Clock
+from karpenter_tpu import tracing
 from karpenter_tpu.operator import logging as klog
 
 _log = klog.logger("nodeclaim.lifecycle")
@@ -95,34 +96,53 @@ class LifecycleController:
     def _launch(self, claim: NodeClaim) -> None:
         if claim.condition_is_true(CONDITION_LAUNCHED):
             return
-        try:
-            created = self.cloud_provider.create(claim)
-        except InsufficientCapacityError as e:
-            self.recorder.publish(
-                Event(claim, "Warning", "InsufficientCapacityError", str(e))
-            )
-            self._delete_claim(claim, "insufficient_capacity")
-            return
-        except NodeClassNotReadyError:
-            self._delete_claim(claim, "nodeclass_not_ready")
-            return
-        except CreateError as e:
-            claim.set_condition(
-                CONDITION_LAUNCHED,
-                "Unknown",
-                reason=e.condition_reason or "LaunchFailed",
-                message=e.condition_message[:300],
-                now=self.clock.now(),
-            )
-            return
-        _populate_node_claim_details(claim, created)
-        claim.set_condition(CONDITION_LAUNCHED, "True", now=self.clock.now())
-        _log.info(
-            "launched nodeclaim",
+        # the launch hop re-joins the claim's scheduling-journey trace (the
+        # provisioner linked it at create); the breaker's cloudprovider
+        # span nests under this one, so breaker state lands in the journey
+        tracer = tracing.tracer()
+        with tracer.span(
+            "nodeclaim.launch",
+            parent=tracer.linked("nodeclaim", claim.metadata.name),
             nodeclaim=claim.metadata.name,
-            provider_id=claim.status.provider_id,
-            instance_type=claim.metadata.labels.get(wk.LABEL_INSTANCE_TYPE, ""),
-        )
+        ) as span:
+            try:
+                created = self.cloud_provider.create(claim)
+            except InsufficientCapacityError as e:
+                span.fail(e)
+                span.set_attr(outcome="insufficient_capacity")
+                self.recorder.publish(
+                    Event(claim, "Warning", "InsufficientCapacityError", str(e))
+                )
+                self._delete_claim(claim, "insufficient_capacity")
+                return
+            except NodeClassNotReadyError as e:
+                span.fail(e)
+                span.set_attr(outcome="nodeclass_not_ready")
+                self._delete_claim(claim, "nodeclass_not_ready")
+                return
+            except CreateError as e:
+                span.fail(e)
+                span.set_attr(outcome="launch_failed")
+                claim.set_condition(
+                    CONDITION_LAUNCHED,
+                    "Unknown",
+                    reason=e.condition_reason or "LaunchFailed",
+                    message=e.condition_message[:300],
+                    now=self.clock.now(),
+                )
+                return
+            _populate_node_claim_details(claim, created)
+            claim.set_condition(CONDITION_LAUNCHED, "True", now=self.clock.now())
+            span.set_attr(
+                outcome="launched",
+                instance_type=claim.metadata.labels.get(wk.LABEL_INSTANCE_TYPE, ""),
+            )
+            _log.info(
+                "launched nodeclaim",
+                nodeclaim=claim.metadata.name,
+                provider_id=claim.status.provider_id,
+                instance_type=claim.metadata.labels.get(wk.LABEL_INSTANCE_TYPE, ""),
+            )
 
     def _delete_claim(self, claim: NodeClaim, reason: str) -> None:
         _NODECLAIMS_DISRUPTED.inc(
@@ -163,8 +183,25 @@ class LifecycleController:
             )
             return
         self._sync_node(claim, node)
-        claim.set_condition(CONDITION_REGISTERED, "True", now=self.clock.now())
+        now = self.clock.now()
+        claim.set_condition(CONDITION_REGISTERED, "True", now=now)
         claim.status.node_name = node.metadata.name
+        # registration hop: the wait from launch to the node joining the
+        # cluster, recorded retroactively (start = the launch transition)
+        tracer = tracing.tracer()
+        launched = claim.get_condition(CONDITION_LAUNCHED)
+        tracer.event(
+            "nodeclaim.registration",
+            parent=tracer.linked("nodeclaim", claim.metadata.name),
+            start=min(
+                launched.last_transition_time
+                if launched is not None
+                else claim.metadata.creation_timestamp,
+                now,
+            ),
+            nodeclaim=claim.metadata.name,
+            node=node.metadata.name,
+        )
         _NODES_CREATED.inc(
             {"nodepool": claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
         )

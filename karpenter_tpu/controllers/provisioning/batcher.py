@@ -8,7 +8,7 @@ exactly like the reference's fake timers.
 
 from __future__ import annotations
 
-from typing import Generic, Hashable, TypeVar
+from typing import Generic, Hashable, Optional, TypeVar
 
 from karpenter_tpu.utils.clock import Clock
 
@@ -20,7 +20,10 @@ class Batcher(Generic[T]):
         self.clock = clock
         self.idle_duration = idle_duration
         self.max_duration = max_duration
-        self._elems: set[T] = set()
+        # elem -> first trigger time within the current window: the
+        # "first-seen-pending" instant each pod's scheduling-journey trace
+        # starts from (tracing's pod.pending span)
+        self._elems: dict[T, float] = {}
         self._first_trigger = 0.0
         self._last_trigger = 0.0
 
@@ -31,7 +34,7 @@ class Batcher(Generic[T]):
         if not self._elems:
             self._first_trigger = now
         self._last_trigger = now
-        self._elems.add(elem)
+        self._elems[elem] = now
 
     def ready(self) -> bool:
         """The window closed: idle since last trigger, or max age reached."""
@@ -43,12 +46,17 @@ class Batcher(Generic[T]):
             or now - self._first_trigger >= self.max_duration
         )
 
-    def consume(self) -> bool:
-        """Take the batch if ready, clearing it (the Wait() return)."""
+    def consume(self) -> Optional[dict[T, float]]:
+        """Take the batch if ready, clearing it (the Wait() return).
+        Returns each element's first-trigger time — the pending-wait start
+        the provisioner's trace records — or None when not ready. A ready
+        batch is never empty, so the return stays truthy exactly when the
+        old boolean was."""
         if not self.ready():
-            return False
-        self._elems.clear()
-        return True
+            return None
+        taken = self._elems
+        self._elems = {}
+        return taken
 
     def __len__(self) -> int:
         return len(self._elems)

@@ -28,6 +28,7 @@ from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.statenode import StateNode, active, deleting
 from karpenter_tpu.utils import nodepool as nodepoolutil
 from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu import tracing
 from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.utils.clock import Clock
 from karpenter_tpu.utils.pdb import Limits
@@ -184,32 +185,47 @@ class Provisioner:
         # so the next loop pass retries it instead of dropping it.
         if not self.cluster.synced():
             return None
-        self.batcher.consume()
+        pending_since = self.batcher.consume() or {}
         from karpenter_tpu.solverd import SolverRejection, TransportError
 
-        try:
-            results = self.schedule()
-        except (SolverRejection, TransportError) as e:
-            # Shed/unreachable solver: degrade, don't crash the loop. The
-            # operator re-triggers every provisionable pod each pass, so the
-            # batch re-forms and retries on its own.
-            _log.warning(
-                "solve shed; will retry next batch",
-                error=type(e).__name__, message=str(e),
+        # One trace per batch (parent=None: the batch is the request, not a
+        # detail of whichever reconcile pass flushed it); every hop of every
+        # pod's journey — solverd spans on either transport, nodeclaim
+        # create/launch/registration, the eventual bind — joins this trace.
+        with tracing.tracer().span(
+            "provisioner.batch", parent=None, triggered=len(pending_since)
+        ) as batch_span:
+            try:
+                results = self.schedule(pending_since=pending_since)
+            except (SolverRejection, TransportError) as e:
+                # Shed/unreachable solver: degrade, don't crash the loop. The
+                # operator re-triggers every provisionable pod each pass, so
+                # the batch re-forms and retries on its own.
+                batch_span.fail(e)
+                _log.warning(
+                    "solve shed; will retry next batch",
+                    error=type(e).__name__, message=str(e),
+                )
+                return None
+            if results is None or not results.new_node_claims:
+                batch_span.set_attr(nodeclaims=0)
+                return results
+            batch_span.set_attr(
+                nodeclaims=len(results.new_node_claims),
+                pods=sum(len(nc.pods) for nc in results.new_node_claims),
+                failed=len(results.pod_errors),
             )
-            return None
-        if results is None or not results.new_node_claims:
+            _log.info(
+                "computed new nodeclaim(s) to fit pod(s)",
+                nodeclaims=len(results.new_node_claims),
+                pods=sum(len(nc.pods) for nc in results.new_node_claims),
+                failed=len(results.pod_errors),
+            )
+            self.create_node_claims(
+                results.new_node_claims, reason=PROVISIONED_REASON,
+                record_pod_nomination=True,
+            )
             return results
-        _log.info(
-            "computed new nodeclaim(s) to fit pod(s)",
-            nodeclaims=len(results.new_node_claims),
-            pods=sum(len(nc.pods) for nc in results.new_node_claims),
-            failed=len(results.pod_errors),
-        )
-        self.create_node_claims(
-            results.new_node_claims, reason=PROVISIONED_REASON, record_pod_nomination=True
-        )
-        return results
 
     # -- scheduling ---------------------------------------------------------
 
@@ -343,7 +359,7 @@ class Provisioner:
         if engine is not None:
             engine.warmup()
 
-    def schedule(self) -> Optional[Results]:
+    def schedule(self, pending_since: Optional[dict] = None) -> Optional[Results]:
         """provisioner.go:281-383."""
         nodes = self.cluster.state_nodes()
         pending = self.get_pending_pods()
@@ -356,6 +372,16 @@ class Provisioner:
         pods = pending + deleting_node_pods
         if not pods:
             return None
+        # child span per pod: the pending wait, from the pod's first batcher
+        # trigger (first-seen-pending) to this flush
+        tracer = tracing.tracer()
+        flush = self.clock.now()
+        for p in pods:
+            first = (pending_since or {}).get(p.metadata.uid, flush)
+            tracer.event(
+                "pod.pending", start=min(first, flush),
+                pod=p.metadata.name, pod_uid=p.metadata.uid,
+            )
         try:
             scheduler = self.new_scheduler(pods, active(nodes))
         except NoNodePoolsError:
@@ -369,6 +395,18 @@ class Provisioner:
             KIND_SOLVE, scheduler, pods, timeout=SOLVE_TIMEOUT
         )
         results.truncate_instance_types()
+        # pods placed on EXISTING capacity complete their journey without a
+        # nodeclaim: record the decision and link the pod so the eventual
+        # bind joins this trace
+        for en in results.existing_nodes:
+            for p in en.pods:
+                sp = tracer.event(
+                    "pod.schedule", pod=p.metadata.name,
+                    pod_uid=p.metadata.uid, node=en.name(), existing=True,
+                )
+                # link by uid: names collide across namespaces and across a
+                # recreated pod's lifetimes; uids never do
+                tracer.link("pod", p.metadata.uid, sp.context)
         self.cluster.mark_pod_scheduling_decisions(
             results.pod_errors,
             results.nodepool_to_pod_mapping(),
@@ -415,6 +453,26 @@ class Provisioner:
         claim = n.to_api_nodeclaim()
         claim.metadata.name = f"{n.nodepool_name}-{new_uid()[:8]}"
         self.store.create(claim)
+        # journey hop: the claim exists. Link the claim (lifecycle's
+        # launch/registration spans re-join here) and each pod (binding's
+        # pod.bind span re-joins here) into the current trace.
+        tracer = tracing.tracer()
+        create_span = tracer.event(
+            "nodeclaim.create",
+            nodeclaim=claim.metadata.name,
+            nodepool=n.nodepool_name,
+            reason=reason,
+            pods=len(n.pods),
+        )
+        tracer.link("nodeclaim", claim.metadata.name, create_span.context)
+        for pod in n.pods:
+            pod_span = tracer.event(
+                "pod.schedule",
+                pod=pod.metadata.name,
+                pod_uid=pod.metadata.uid,
+                nodeclaim=claim.metadata.name,
+            )
+            tracer.link("pod", pod.metadata.uid, pod_span.context)
         self.cluster.pod_to_node_claim.update(
             {
                 (p.metadata.namespace, p.metadata.name): claim.metadata.name

@@ -168,7 +168,7 @@ class Registry:
     def _expose(self) -> str:
         lines = []
         for m in self._metrics.values():
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {m.name} counter")
                 for key, v in m._values.items():
@@ -180,18 +180,55 @@ class Registry:
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {m.name} histogram")
                 for key, total in m._totals.items():
-                    lines.append(f"{m.name}_count{_fmt_labels(key)} {total}")
+                    # bucket counts are stored cumulatively; the mandatory
+                    # +Inf bucket equals _count (text exposition format)
+                    counts = m._counts.get(key, [0] * len(m.buckets))
+                    for bound, cumulative in zip(m.buckets, counts):
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_fmt_labels(key, le=_fmt_bound(bound))} "
+                            f"{cumulative}"
+                        )
+                    lines.append(
+                        f'{m.name}_bucket{_fmt_labels(key, le="+Inf")} {total}'
+                    )
                     lines.append(f"{m.name}_sum{_fmt_labels(key)} {m._sums[key]}")
+                    lines.append(f"{m.name}_count{_fmt_labels(key)} {total}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         self._metrics.clear()
 
 
-def _fmt_labels(key: tuple) -> str:
-    if not key:
+def _escape_help(text: str) -> str:
+    # HELP lines escape backslash and newline (not quotes)
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value) -> str:
+    # label values escape backslash, double-quote, and newline — in that
+    # order, so the escaping backslashes are not themselves re-escaped
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_bound(bound: float) -> str:
+    # integral bounds print without the trailing .0 (Prometheus convention:
+    # le="1" and le="1.0" are DIFFERENT series to a scraper)
+    return repr(float(bound)).removesuffix(".0")
+
+
+def _fmt_labels(key: tuple, le: Optional[str] = None) -> str:
+    pairs = list(key)
+    if le is not None:
+        pairs.append(("le", le))
+    if not pairs:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
     return "{" + inner + "}"
 
 

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 from random import Random
 from typing import Any, Callable, Optional
 
+from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry
 from karpenter_tpu.operator import logging as klog
 from karpenter_tpu.utils.clock import Clock
@@ -277,37 +278,48 @@ class ReconcilerHarness:
             RECONCILE_REQUEUES.inc({"controller": rec.name})
             return None
         RECONCILE_TOTAL.inc({"controller": rec.name})
-        start = time.perf_counter()
-        try:
-            result = rec.fn(*args)
-        except Exception as e:  # noqa: BLE001 — isolation is the point
-            RECONCILE_ERRORS.inc({"controller": rec.name})
-            delay = self.limiter.failure(key)
-            self._consecutive[rec.name] = self._consecutive.get(rec.name, 0) + 1
-            self._errors[rec.name] = self._errors.get(rec.name, 0) + 1
-            self._last_error[rec.name] = f"{type(e).__name__}: {e}"
-            _log.error(
-                "reconcile failed",
-                controller=rec.name,
-                item=item or "",
-                error=f"{type(e).__name__}: {e}",
-                retries=self.limiter.retries(key),
-                backoff_seconds=round(delay, 3),
-            )
-            return None
-        finally:
-            RECONCILE_DURATION.observe(
-                time.perf_counter() - start, {"controller": rec.name}
-            )
-        self.limiter.success(key)
-        self._consecutive[rec.name] = 0
-        if (
-            isinstance(result, Result)
-            and result.requeue_after is not None
-            and result.requeue_after > 0
-        ):
-            self.limiter.defer(key, result.requeue_after)
-        return result
+        # every reconcile is a span: the per-hop record a pod's scheduling
+        # journey correlates against (controller=, result=, error=), and the
+        # source of trace_id/span_id on every log line the call emits
+        with tracing.tracer().span(
+            "reconcile", controller=rec.name, item=item or ""
+        ) as span:
+            start = time.perf_counter()
+            try:
+                result = rec.fn(*args)
+            except Exception as e:  # noqa: BLE001 — isolation is the point
+                RECONCILE_ERRORS.inc({"controller": rec.name})
+                delay = self.limiter.failure(key)
+                self._consecutive[rec.name] = self._consecutive.get(rec.name, 0) + 1
+                self._errors[rec.name] = self._errors.get(rec.name, 0) + 1
+                self._last_error[rec.name] = f"{type(e).__name__}: {e}"
+                span.fail(e)
+                span.set_attr(retries=self.limiter.retries(key))
+                _log.error(
+                    "reconcile failed",
+                    controller=rec.name,
+                    item=item or "",
+                    error=f"{type(e).__name__}: {e}",
+                    retries=self.limiter.retries(key),
+                    backoff_seconds=round(delay, 3),
+                )
+                return None
+            finally:
+                RECONCILE_DURATION.observe(
+                    time.perf_counter() - start, {"controller": rec.name}
+                )
+            self.limiter.success(key)
+            self._consecutive[rec.name] = 0
+            if (
+                isinstance(result, Result)
+                and result.requeue_after is not None
+                and result.requeue_after > 0
+            ):
+                self.limiter.defer(key, result.requeue_after)
+                span.set_attr(result="requeue")
+            else:
+                span.set_attr(result="ok")
+            return result
 
     # -- pass/health accounting ---------------------------------------------
 

@@ -65,6 +65,15 @@ class Logger:
             return
         if self._inner.isEnabledFor(level):
             exc_info = kv.pop("exc_info", None)
+            # correlation fields: any log emitted inside a span carries its
+            # trace/span ids, so `grep trace_id=X` yields the same story
+            # /debug/traces?trace_id=X tells (explicit fields win)
+            from karpenter_tpu import tracing
+
+            ctx = tracing.current()
+            if ctx is not None and ctx.sampled:
+                kv.setdefault("trace_id", ctx.trace_id)
+                kv.setdefault("span_id", ctx.span_id)
             self._inner.log(level, message, extra={"kv": kv}, exc_info=exc_info)
 
     def debug(self, message: str, **kv) -> None:

@@ -70,6 +70,16 @@ class Operator:
         self.clock = clock or Clock()
         self.store = store
         self.options = options or Options()
+        # the process-global tracer follows the operator's clock and tracing
+        # options (same pattern as the metrics registry); the simulator
+        # reconfigures it in deterministic mode before running
+        from karpenter_tpu import tracing
+
+        self.tracer = tracing.configure(
+            clock=self.clock,
+            sample_rate=self.options.tracing_sample_rate,
+            buffer_size=self.options.trace_buffer_size,
+        )
         # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
         # here it bounds the solver's interning/memo caches. The caps are
         # process-global, so only an EXPLICIT setting mutates them: -1 (the
@@ -416,6 +426,31 @@ class Operator:
     def solver_stats(self) -> dict:
         """solverd introspection for /debug/solverd (operator/serving.py)."""
         return self.provisioner.solver.stats()
+
+    def trace_snapshot(
+        self,
+        trace_id: Optional[str] = None,
+        view: Optional[str] = None,
+        limit: int = 20,
+    ) -> Optional[dict]:
+        """/debug/traces (operator/serving.py): recent traces, a trace_id
+        drill-down (the spans plus any completed pod journeys they carry),
+        or the slowest-journeys view. None => unknown trace_id (404)."""
+        if trace_id:
+            spans = self.tracer.ring.trace(trace_id)
+            if not spans:
+                return None
+            return {
+                "trace_id": trace_id,
+                "spans": spans,
+                "journeys": self.tracer.journeys.for_trace(trace_id),
+            }
+        if view == "slowest":
+            return {"slowest_journeys": self.tracer.journeys.slowest(limit)}
+        return {
+            "traces": self.tracer.ring.summaries(limit),
+            "journeys": self.tracer.journeys.stats(),
+        }
 
     def healthy(self) -> bool:
         """Real liveness: degraded when any controller is failing

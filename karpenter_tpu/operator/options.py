@@ -72,6 +72,13 @@ class Options:
     solverd_queue_depth: int = 256  # admission queue depth (shed past it)
     solverd_coalesce_window: float = 0.0  # seconds the batch leader waits
 
+    # tracing (karpenter_tpu/tracing): safe-on-by-default — sample every
+    # trace into a BOUNDED in-memory ring buffer (spans; /debug/traces
+    # reads it). Rate 0 disables span export entirely; the simulator always
+    # runs at 1.0 so journeys and span digests are complete.
+    tracing_sample_rate: float = 1.0
+    trace_buffer_size: int = 4096
+
     # reconciler harness (operator/harness.py): per-item exponential
     # backoff bounds for failing reconciles, and the cloud-provider circuit
     # breaker (consecutive retryable create/delete failures before opening;
@@ -119,6 +126,8 @@ class Options:
         parser.add_argument("--solver-daemon-address")
         parser.add_argument("--solverd-queue-depth", type=int)
         parser.add_argument("--solverd-coalesce-window", type=float)
+        parser.add_argument("--tracing-sample-rate", type=float)
+        parser.add_argument("--trace-buffer-size", type=int)
         parser.add_argument("--requeue-base-delay", type=float)
         parser.add_argument("--requeue-max-delay", type=float)
         parser.add_argument("--cloud-breaker-threshold", type=int)

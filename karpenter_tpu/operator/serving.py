@@ -28,6 +28,7 @@ class ServingConfig:
         enable_profiling: bool = False,
         solverd_stats: Optional[Callable[[], dict]] = None,
         health_snapshot: Optional[Callable[[], dict]] = None,
+        trace_snapshot: Optional[Callable[..., Optional[dict]]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -40,6 +41,10 @@ class ServingConfig:
         # serves the snapshot as JSON (503 when degraded, with the reasons
         # in the body) and /debug/health always returns the full document
         self.health_snapshot = health_snapshot
+        # scheduling traces (operator.trace_snapshot): /debug/traces serves
+        # the last-N traces, ?trace_id= drill-down (404 when unknown), and
+        # ?view=slowest for the slowest pod journeys
+        self.trace_snapshot = trace_snapshot
 
 
 def _profile_sample(seconds: float, interval: float = 0.01) -> str:
@@ -134,6 +139,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(
                     200, json.dumps(cfg.health_snapshot()), "application/json"
                 )
+            elif url.path == "/debug/traces" and cfg.trace_snapshot is not None:
+                import json
+
+                q = parse_qs(url.query)
+                snap = cfg.trace_snapshot(
+                    trace_id=q.get("trace_id", [None])[0],
+                    view=q.get("view", [None])[0],
+                    limit=int(q.get("limit", ["20"])[0]),
+                )
+                if snap is None:
+                    self._respond(
+                        404, json.dumps({"error": "unknown trace_id"}),
+                        "application/json",
+                    )
+                else:
+                    self._respond(200, json.dumps(snap), "application/json")
             elif url.path == "/debug/solverd" and cfg.solverd_stats is not None:
                 import json
 

@@ -25,6 +25,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.ops import encoding as enc
 from karpenter_tpu.ops import feasibility as feas
+from karpenter_tpu.tracing import kernel as ktime
 from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
 
 DEFAULT_RESOURCE_DIMS = (
@@ -541,7 +542,8 @@ class CatalogEngine:
                 if P3 > P2:
                     membership = np.pad(membership, ((0, P3 - P2), (0, 0)))
                     key_present_p = np.pad(key_present_p, ((0, P3 - P2), (0, 0)))
-                compat_d, offering_d = feas.sharded_cube(self.mesh)(
+                compat_d, offering_d = ktime.dispatch(
+                    feas.sharded_cube(self.mesh),
                     membership,
                     req_compat_h,
                     offer_compat_h,
@@ -551,7 +553,8 @@ class CatalogEngine:
                     self._mesh_dev("owner_onehot", self._owner_onehot),
                 )
             else:
-                compat_d, offering_d = feas.production_cube(
+                compat_d, offering_d = ktime.dispatch(
+                    feas.production_cube,
                     jnp.asarray(membership),
                     jnp.asarray(req_compat_h),
                     jnp.asarray(offer_compat_h),

@@ -97,6 +97,30 @@ _JOINT_SWEEPS_CTR = global_registry.counter(
     "karpenter_solver_joint_sweeps_total",
     "batched joint-requirement feasibility sweeps dispatched to the device path",
 )
+# Cache-hit attribution for the engine-shared solver caches: the solverd
+# solve span snapshots these around each solve so slow solves can be
+# attributed to cold caches vs device work. Process-history state — span
+# code records the deltas as VOLATILE attrs (excluded from deterministic
+# span digests; a warm second run legitimately hits where a cold first run
+# missed).
+JOINT_CACHE_HITS = 0
+JOINT_CACHE_MISSES = 0
+PACK_CACHE_HITS = 0
+PACK_CACHE_MISSES = 0
+
+
+def solver_cache_counters() -> dict:
+    """Snapshot of the solver's cumulative cache/dispatch counters (delta
+    two snapshots to attribute one solve)."""
+    return {
+        "joint_cache_hits": JOINT_CACHE_HITS,
+        "joint_cache_misses": JOINT_CACHE_MISSES,
+        "pack_cache_hits": PACK_CACHE_HITS,
+        "pack_cache_misses": PACK_CACHE_MISSES,
+        "joint_sweeps": JOINT_SWEEPS,
+        "device_solves": DEVICE_SOLVES,
+        "device_fallbacks": DEVICE_FALLBACKS,
+    }
 
 # Tests set this to make simulation bugs fail loudly instead of silently
 # falling back to the host loop.
@@ -535,9 +559,11 @@ class _NativeDriver:
             # must cover BOTH arrays — two (template, group) openings can share
             # a candidate mask yet differ in fitting u_ids. Value keying also
             # lets value-identical openings share one encoding.
+            global PACK_CACHE_HITS, PACK_CACHE_MISSES
             cache_key = (candidate.tobytes(), np.ascontiguousarray(u_ids).tobytes())
             cached = self._pack_cache.get(cache_key)
             if cached is None:
+                PACK_CACHE_MISSES += 1
                 mask = self._pack(candidate)
                 u32 = np.ascontiguousarray(u_ids, dtype=np.int32)
                 # pre-cast the stable pointers: openings for the same
@@ -552,6 +578,8 @@ class _NativeDriver:
                     u32,
                 )
                 self._pack_cache[cache_key] = cached
+            else:
+                PACK_CACHE_HITS += 1
             mask_ptr, u32_ptr, n_u = cached[0], cached[1], cached[2]
         else:
             mask = self._pack(candidate)
@@ -1165,12 +1193,15 @@ class _DeviceSolve:
     # -- joint masks ---------------------------------------------------------
 
     def _joint_masks(self, rows: frozenset, reqs: Requirements) -> tuple:
+        global JOINT_CACHE_HITS, JOINT_CACHE_MISSES
         cache = self.joint_cache
         got = cache.get(rows)
         if got is None:
+            JOINT_CACHE_MISSES += 1
             keys = [r.key for r in reqs if r.key != wk.LABEL_HOSTNAME]
             got = self.engine.masks_for_rows(list(rows), keys)
         else:
+            JOINT_CACHE_HITS += 1
             # LRU touch: reinsertion moves the entry to the recency tail so
             # _evict_lru sheds cold entries first
             del cache[rows]

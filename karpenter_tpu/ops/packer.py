@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.ops import encoding as enc
 from karpenter_tpu.ops import feasibility as feas
+from karpenter_tpu.tracing import kernel as ktime
 from karpenter_tpu.ops.catalog import CatalogEngine
 from karpenter_tpu.scheduling.requirements import Requirements
 
@@ -172,9 +173,11 @@ class GroupSolver:
 
     def solve(self, grouped: GroupedPods):
         """Single-device fused solve; returns host arrays
-        (choice, feasible, nodes-per-group, unschedulable-per-group)."""
+        (choice, feasible, nodes-per-group, unschedulable-per-group).
+        Dispatch goes through the kernel timer so the solve span can split
+        wall time into compile vs execute (tracing/kernel.py)."""
         args = self._catalog_args()
-        out = np.asarray(solve_block_jit(*_pack_groups(grouped), *args))
+        out = np.asarray(ktime.dispatch(solve_block_jit, *_pack_groups(grouped), *args))
         return out[:, 0], out[:, 1].astype(bool), out[:, 2], out[:, 3]
 
     def solve_sharded(self, grouped: GroupedPods, mesh: Mesh, axis: str = "pods"):
@@ -210,7 +213,7 @@ class GroupSolver:
             jax.device_put(group_bools, sharding),
             jax.device_put(group_ints, sharding),
         ] + [jax.device_put(np.asarray(a), rep) for a in catalog_args]
-        out = np.asarray(fn(*dev_args))
+        out = np.asarray(ktime.dispatch(fn, *dev_args))
         return (
             out[:G, 0],
             out[:G, 1].astype(bool),

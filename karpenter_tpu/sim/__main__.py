@@ -38,6 +38,11 @@ def main(argv=None) -> int:
     parser.add_argument("--events", help="write the event log (JSONL) here")
     parser.add_argument("--dump-trace", help="write the materialized trace here")
     parser.add_argument(
+        "--trace-export",
+        help="write the span log (JSONL, one canonical span per line) here; "
+        "same-seed runs write byte-identical files",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     parser.add_argument(
@@ -66,7 +71,7 @@ def main(argv=None) -> int:
         with open(args.dump_trace, "w", encoding="utf-8") as f:
             f.write(tracemod.dumps(trace) + "\n")
 
-    result = run_scenario(trace, args.seed)
+    result = run_scenario(trace, args.seed, trace_export=args.trace_export)
 
     if args.events:
         with open(args.events, "w", encoding="utf-8") as f:

@@ -10,21 +10,13 @@ each node's registered lifetime in virtual time.
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.sim.events import EventLog
+from karpenter_tpu.utils.stats import percentile  # noqa: F401 — re-export
 
 REPORT_VERSION = 1
-
-
-def percentile(sorted_values: list[float], p: float) -> Optional[float]:
-    """Nearest-rank percentile over an ascending list; None when empty."""
-    if not sorted_values:
-        return None
-    rank = max(1, math.ceil(p / 100.0 * len(sorted_values)))
-    return sorted_values[rank - 1]
 
 
 class Accountant:

@@ -116,6 +116,7 @@ class Simulation:
         seed: int,
         options: Optional[Options] = None,
         registration_delay: float = 2.0,
+        trace_export: Optional[str] = None,
     ):
         tracemod.validate(trace)
         self.trace = trace
@@ -145,6 +146,21 @@ class Simulation:
         self.operator = Operator(
             self.store, self.provider, clock=self.clock, options=options or Options()
         )
+        # re-install the tracer the Operator just configured, in DETERMINISTIC
+        # mode: full sampling (journeys and the span digest must be complete),
+        # volatile wall-clock attrs dropped at export — so two same-seed runs
+        # emit byte-identical span logs, and the digest below is a regression
+        # fingerprint exactly like the event-log digest
+        from karpenter_tpu import tracing
+
+        self.tracer = tracing.configure(
+            clock=self.clock,
+            sample_rate=1.0,
+            deterministic=True,
+            buffer_size=(options or Options()).trace_buffer_size,
+            jsonl_path=trace_export,
+        )
+        self.operator.tracer = self.tracer
         # the operator's cloud-provider circuit breaker is part of the
         # scenario's observable record: every transition lands in the event
         # log (deterministic — virtual time, seeded faults), and the
@@ -227,6 +243,15 @@ class Simulation:
                 solver_stats=self._solver_stats(),
             )
             self.operator.shutdown()
+            # fold the scheduling traces into the report: the span-log
+            # digest (determinism fingerprint) and per-stage journey
+            # p50/p99 over every pod that completed its journey
+            report["tracing"] = {
+                "span_digest": self.tracer.digest.digest(),
+                "spans": self.tracer.digest.count,
+                "journeys": self.tracer.journeys.stats(),
+            }
+            self.tracer.close()  # flush the JSONL export, if any
             return SimResult(report=report, digest=self.log.digest(), log=self.log)
         finally:
             apicore.set_uid_source(None)
@@ -237,6 +262,9 @@ class Simulation:
         for key, base in self._ffd_base.items():
             if isinstance(stats.get(key), int):
                 stats[key] -= base
+        # wall-clock measurements stay on /debug/solverd but OUT of the
+        # report: the report must be a pure function of (scenario, seed)
+        stats.pop("last_batch_seconds", None)
         return stats
 
     # -- trace events --------------------------------------------------------
@@ -352,6 +380,9 @@ class Simulation:
 
 
 def run_scenario(
-    trace: dict, seed: int, options: Optional[Options] = None
+    trace: dict,
+    seed: int,
+    options: Optional[Options] = None,
+    trace_export: Optional[str] = None,
 ) -> SimResult:
-    return Simulation(trace, seed, options=options).run()
+    return Simulation(trace, seed, options=options, trace_export=trace_export).run()

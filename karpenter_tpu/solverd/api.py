@@ -54,7 +54,15 @@ class SolveRequest:
     provisioning and simulation build different cluster views); `pods` is
     the queue the solve processes. `timeout` bounds the solve itself;
     `deadline` is an absolute clock time bounding ADMISSION — a request
-    still queued past it is rejected, never run."""
+    still queued past it is rejected, never run.
+
+    `trace_context` is the caller's span carrier ({"trace_id", "span_id"}
+    or None): it rides the request itself so service-side spans (queue
+    wait, coalesce, solve) parent to the ORIGINATING trace on both
+    transports — the in-process path passes it through, the socket path
+    puts the same fields in the JSON frame. Context must live on the
+    request, not ambient state: a coalesced batch executes many callers'
+    requests on one leader thread."""
 
     kind: str
     scheduler: object
@@ -62,3 +70,4 @@ class SolveRequest:
     timeout: Optional[float] = None
     deadline: Optional[float] = None
     client: str = ""
+    trace_context: Optional[dict] = None

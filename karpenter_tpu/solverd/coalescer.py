@@ -15,11 +15,21 @@ host-sequential by design (each placement mutates claim state) and the
 device work IS the sweep being coalesced. Singleton batches skip the
 priming pass entirely — collect-then-solve would group the pods twice for
 zero sharing.
+
+Tracing: each request's solve runs under a `solverd.solve` span parented
+to the ORIGINATING trace via the request's carried context (never the
+ambient context — a coalesced batch executes many callers' requests on one
+leader thread). The span attributes the solve's wall time to kernel
+compile vs execute (tracing/kernel.py, block_until_ready-fenced) and its
+cache behavior to joint-mask / native-pack hits and misses — both recorded
+as volatile attrs since they are process-history, not scenario, facts.
 """
 
 from __future__ import annotations
 
+from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry, measure
+from karpenter_tpu.tracing import kernel as ktime
 
 _SOLVE_LATENCY = global_registry.histogram(
     "karpenter_solverd_solve_latency_seconds",
@@ -41,20 +51,56 @@ class Coalescer:
         """Run every entry's solve, filling entry.result / entry.error.
         Entries are anything with `.request` (a SolveRequest) plus writable
         `result`/`error` slots; completion signalling is the caller's job."""
+        from karpenter_tpu.ops import ffd
+
         self._prime(entries)
+        tracer = tracing.tracer()
         for entry in entries:
             req = entry.request
-            try:
-                with measure(_SOLVE_LATENCY, {"kind": req.kind}):
-                    entry.result = req.scheduler.solve(
-                        req.pods, timeout=req.timeout
-                    )
-            except Exception as err:  # noqa: BLE001 — fail the one request
-                entry.error = err
+            ctx = tracer.context_from(getattr(req, "trace_context", None))
+            with tracer.span(
+                "solverd.solve", parent=ctx, kind=req.kind, pods=len(req.pods)
+            ) as span:
+                if not span.sampled:
+                    # no span to attribute to: skip the kernel timer so the
+                    # solve's device dispatches are NOT block_until_ready
+                    # fenced (tracing off must not serialize the hot path)
+                    self._solve_one(entry)
+                    continue
+                base = ffd.solver_cache_counters()
+                with ktime.measure() as kernels:
+                    err = self._solve_one(entry)
+                    if err is not None:
+                        span.fail(err)
+                delta = {
+                    name: value - base[name]
+                    for name, value in ffd.solver_cache_counters().items()
+                }
+                span.set_volatile(
+                    wall_compile_s=round(kernels["compile_s"], 6),
+                    wall_execute_s=round(kernels["execute_s"], 6),
+                    kernel_dispatches=kernels["dispatches"],
+                    kernel_compiles=kernels["compiles"],
+                    **delta,
+                )
+
+    @staticmethod
+    def _solve_one(entry):
+        """Run one entry's solve, filling result/error; returns the error
+        (the request fails, the batch continues)."""
+        req = entry.request
+        try:
+            with measure(_SOLVE_LATENCY, {"kind": req.kind}):
+                entry.result = req.scheduler.solve(req.pods, timeout=req.timeout)
+        except Exception as err:  # noqa: BLE001 — fail the one request
+            entry.error = err
+            return err
+        return None
 
     def _prime(self, entries: list) -> None:
         from karpenter_tpu.ops import ffd
 
+        tracer = tracing.tracer()
         buckets: dict[int, tuple[object, list]] = {}
         for entry in entries:
             engine = getattr(entry.request.scheduler, "engine", None)
@@ -64,19 +110,29 @@ class Coalescer:
         for engine, bucket in buckets.values():
             if len(bucket) < 2:
                 continue
-            try:
-                pairs = []
-                for entry in bucket:
-                    pairs.extend(
-                        ffd.collect_joint_rowsets(
-                            entry.request.scheduler, entry.request.pods
+            # the leader's trace owns the shared sweep; riders are counted
+            # in the attrs (their own solve spans see the warm cache)
+            ctx = tracer.context_from(
+                getattr(bucket[0].request, "trace_context", None)
+            )
+            with tracer.span(
+                "solverd.coalesce", parent=ctx, requests=len(bucket)
+            ) as span:
+                try:
+                    pairs = []
+                    for entry in bucket:
+                        pairs.extend(
+                            ffd.collect_joint_rowsets(
+                                entry.request.scheduler, entry.request.pods
+                            )
                         )
-                    )
-                if pairs:
-                    primed = ffd.prime_joint_masks(engine, pairs)
-                    if primed:
-                        _PRIMED.inc(value=float(primed))
-                _COALESCED.inc(value=float(len(bucket)))
-            except Exception:  # noqa: BLE001 — priming is an optimization;
-                # the solves below are exact without it
-                pass
+                    primed = 0
+                    if pairs:
+                        primed = ffd.prime_joint_masks(engine, pairs)
+                        if primed:
+                            _PRIMED.inc(value=float(primed))
+                    _COALESCED.inc(value=float(len(bucket)))
+                    span.set_volatile(primed=primed, rowsets=len(pairs))
+                except Exception as e:  # noqa: BLE001 — priming is an
+                    # optimization; the solves below are exact without it
+                    span.fail(e)

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Optional
 
+from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry
 from karpenter_tpu.solverd.api import SolveRequest, SolverClosedError
 from karpenter_tpu.solverd.coalescer import Coalescer
@@ -76,9 +77,15 @@ class SolverService:
         self._lock = threading.Lock()
         self._executing = False
         self._closed = False
-        # cumulative stats for /debug/solverd (metrics carry the histograms)
+        # cumulative stats for /debug/solverd (metrics carry the
+        # histograms). Mutated and snapshotted only under _stats_lock so a
+        # concurrent /debug/solverd read sees a mutually consistent set —
+        # e.g. `executed` never exceeds `requests`, `batches` never exceeds
+        # `executed` — instead of counters torn mid-batch.
+        self._stats_lock = threading.Lock()
         self.batches = 0
         self.requests = 0
+        self.executed = 0
         self.rejected = 0
         self.max_batch_size = 0
         self.last_batch_seconds = 0.0
@@ -94,9 +101,11 @@ class SolverService:
         try:
             self.queue.offer(entry)
         except Exception:
-            self.rejected += 1
+            with self._stats_lock:
+                self.rejected += 1
             raise
-        self.requests += 1
+        with self._stats_lock:
+            self.requests += 1
         _REQUESTS.inc({"kind": request.kind})
         return entry
 
@@ -139,21 +148,38 @@ class SolverService:
         Returns the number of requests executed."""
         from karpenter_tpu.solverd.api import DeadlineExceededError
 
+        tracer = tracing.tracer()
         ready, expired = self.queue.drain()
+        now = self.clock.now()
         for entry in expired:
-            self.rejected += 1
-            entry.error = DeadlineExceededError(
+            with self._stats_lock:
+                self.rejected += 1
+            err = DeadlineExceededError(
                 "deadline passed while queued; request not executed"
             )
+            ctx = tracer.context_from(entry.request.trace_context)
+            if ctx is not None:
+                tracer.event(
+                    "solverd.queue", parent=ctx, start=entry.enqueued_at,
+                    kind=entry.request.kind, error=err,
+                )
+            entry.error = err
             entry.finish()
         if not ready:
             return 0
-        now = self.clock.now()
         for entry in ready:
             _QUEUE_LATENCY.observe(max(0.0, now - entry.enqueued_at))
+            # the admission hop of the caller's trace: enqueue → batch drain
+            ctx = tracer.context_from(entry.request.trace_context)
+            if ctx is not None:
+                tracer.event(
+                    "solverd.queue", parent=ctx, start=entry.enqueued_at,
+                    kind=entry.request.kind,
+                )
         _BATCH_SIZE.observe(float(len(ready)))
-        self.batches += 1
-        self.max_batch_size = max(self.max_batch_size, len(ready))
+        with self._stats_lock:
+            self.batches += 1
+            self.max_batch_size = max(self.max_batch_size, len(ready))
         started = time.perf_counter()
         try:
             self.coalescer.execute(ready)
@@ -162,7 +188,9 @@ class SolverService:
                 if entry.result is None and entry.error is None:
                     entry.error = RuntimeError("solve batch aborted")
                 entry.finish()
-        self.last_batch_seconds = time.perf_counter() - started
+        with self._stats_lock:
+            self.executed += len(ready)
+            self.last_batch_seconds = time.perf_counter() - started
         return len(ready)
 
     def close(self) -> None:
@@ -177,15 +205,25 @@ class SolverService:
     def stats(self) -> dict:
         from karpenter_tpu.ops import ffd
 
+        # snapshot under the stats lock: every counter in the result comes
+        # from one atomic read, so invariants (executed <= requests,
+        # batches <= executed) hold in every snapshot a concurrent
+        # /debug/solverd reader takes
+        with self._stats_lock:
+            counters = {
+                "requests": self.requests,
+                "batches": self.batches,
+                "executed": self.executed,
+                "rejected": self.rejected,
+                "max_batch_size": self.max_batch_size,
+                "last_batch_seconds": self.last_batch_seconds,
+            }
         return {
             "transport": "inprocess",
             "queue_depth": self.queue.depth(),
             "queue_cap": self.queue.max_depth,
             "coalesce_window": self.coalesce_window,
-            "requests": self.requests,
-            "batches": self.batches,
-            "rejected": self.rejected,
-            "max_batch_size": self.max_batch_size,
+            **counters,
             "joint_sweeps": ffd.JOINT_SWEEPS,
             "device_solves": ffd.DEVICE_SOLVES,
             "device_fallbacks": ffd.DEVICE_FALLBACKS,

@@ -87,6 +87,8 @@ class InProcessClient(SolverClient):
         self.service = service
 
     def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+        from karpenter_tpu import tracing
+
         return self.service.solve(
             SolveRequest(
                 kind=kind,
@@ -94,6 +96,10 @@ class InProcessClient(SolverClient):
                 pods=list(pods),
                 timeout=timeout,
                 deadline=deadline,
+                # the caller's span context rides the request so the
+                # service-side queue/coalesce/solve spans join its trace
+                # even when another thread's batch leader executes them
+                trace_context=tracing.tracer().carrier(),
             )
         )
 
@@ -241,6 +247,8 @@ class SocketClient(SolverClient):
         ) from last_err
 
     def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+        from karpenter_tpu import tracing
+
         with _engine_stripped(scheduler) as engine:
             payload = _pack(
                 {
@@ -249,6 +257,7 @@ class SocketClient(SolverClient):
                     "catalog": list(engine.instance_types) if engine else None,
                 }
             )
+        tracer = tracing.tracer()
         msg = {
             "v": WIRE_VERSION,
             "op": "solve",
@@ -259,10 +268,19 @@ class SocketClient(SolverClient):
             "deadline_rel": None if deadline is None else max(
                 0.0, deadline - scheduler.clock.now()
             ),
+            # trace context as plain carrier fields in the JSON control
+            # plane: daemon-side spans join the caller's trace without
+            # unpickling anything
+            "trace": tracer.carrier(),
             "payload": payload,
         }
         with self._lock:
             reply = self._rpc(msg)
+        # daemon-side spans for this trace ride home in the reply frame and
+        # re-export into the caller's exporters — /debug/traces shows one
+        # joined trace whichever side of the socket a span was born on
+        if reply.get("spans"):
+            tracer.import_spans(reply["spans"])
         if not reply.get("ok"):
             err = reply.get("error", {})
             cls = _ERROR_TYPES.get(err.get("type"))
@@ -400,10 +418,23 @@ class SolverDaemon:
                     reply = self._process(msg)
                 except Exception as e:  # noqa: BLE001 — keep the conn alive
                     reply = _error_reply(e)
+                    # failed solves re-join the caller's trace too: the
+                    # error-status daemon spans are exactly what a user
+                    # debugging the failure drills into
+                    self._attach_spans(reply, msg.get("trace"))
                 try:
                     send_frame(conn, reply)
                 except OSError:
                     return
+
+    @staticmethod
+    def _attach_spans(reply: dict, trace) -> None:
+        """Span backhaul: hand the caller's trace its daemon-side spans
+        (taken, not copied — each span ships home exactly once)."""
+        if isinstance(trace, dict) and trace.get("trace_id"):
+            from karpenter_tpu import tracing
+
+            reply["spans"] = tracing.tracer().ring.take_trace(trace["trace_id"])
 
     def _process(self, msg: dict) -> dict:
         if msg.get("op") == "stats":
@@ -419,6 +450,7 @@ class SolverDaemon:
             except Exception:  # noqa: BLE001 — host path is decision-identical
                 scheduler.engine = None
         deadline_rel = msg.get("deadline_rel")
+        trace = msg.get("trace")
         request = SolveRequest(
             kind=msg.get("kind", api.KIND_SOLVE),
             scheduler=scheduler,
@@ -428,13 +460,16 @@ class SolverDaemon:
             if deadline_rel is None
             else self.service.clock.now() + deadline_rel,
             client="socket",
+            trace_context=trace,
         )
         results = self.service.solve(request)
         # the result graph references the daemon's engine through the claim
         # objects — detach before pickling (device arrays don't travel)
         for nc in results.new_node_claims:
             nc.engine = None
-        return {"ok": True, "payload": _pack(results)}
+        reply = {"ok": True, "payload": _pack(results)}
+        self._attach_spans(reply, trace)
+        return reply
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,78 @@
+"""Kernel wall-time attribution: compile vs execute, per solve.
+
+The solve span wants to answer "was this solve slow because XLA compiled a
+new executable, or because the device executed a big cube?" — the split
+the ROADMAP's solver tuning needs. JAX exposes no per-dispatch hook, so the
+attribution is structural: every device dispatch in the solver goes through
+``dispatch()``, which fences with ``block_until_ready`` and classifies the
+wall time by the jitted callable's compile-cache delta (a dispatch that
+grew the cache paid a compile; one that didn't ran a warm executable).
+
+Measurements accumulate into a contextvar-scoped dict opened by
+``measure()`` (the solverd coalescer wraps each request's solve in one), so
+nested dispatches attribute to the request that triggered them and
+concurrent daemon threads never mix accounts. All numbers here are
+wall-clock — span code must record them as VOLATILE attrs, never in the
+deterministic digest.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+_ACC: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "karpenter_kernel_acc", default=None
+)
+
+
+def _fresh() -> dict:
+    return {"compile_s": 0.0, "execute_s": 0.0, "dispatches": 0, "compiles": 0}
+
+
+@contextmanager
+def measure() -> Iterator[dict]:
+    """Collect kernel dispatch timings for everything run inside."""
+    acc = _fresh()
+    token = _ACC.set(acc)
+    try:
+        yield acc
+    finally:
+        _ACC.reset(token)
+
+
+def _cache_size(fn) -> Optional[int]:
+    try:
+        return fn._cache_size()  # jax.jit wrappers expose this
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        return None
+
+
+def dispatch(fn, *args):
+    """Call a jitted function, block until its outputs are ready, and
+    attribute the wall time to compile or execute. Transparent (returns the
+    outputs) and free when no measurement context is open."""
+    acc = _ACC.get()
+    if acc is None:
+        return fn(*args)
+    before = _cache_size(fn)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:  # noqa: BLE001 — host twins return plain numpy
+        pass
+    elapsed = time.perf_counter() - t0
+    after = _cache_size(fn)
+    compiled = before is not None and after is not None and after > before
+    acc["dispatches"] += 1
+    if compiled:
+        acc["compiles"] += 1
+        acc["compile_s"] += elapsed
+    else:
+        acc["execute_s"] += elapsed
+    return out

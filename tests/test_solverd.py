@@ -428,3 +428,58 @@ class TestSocketReconnect:
         client.close()
         assert stats["transport"] == "socket"
         assert "error" in stats
+
+
+class TestStatsConsistency:
+    """/debug/solverd snapshots are taken under the service's stats lock:
+    a concurrent reader must never observe counters torn mid-batch (e.g.
+    `executed` ahead of `requests`, or `batches` ahead of `executed`)."""
+
+    def test_concurrent_reads_see_consistent_counters(self):
+        import threading
+
+        svc = SolverService(clock=FakeClock())
+        stop = threading.Event()
+        violations = []
+
+        def reader():
+            while not stop.is_set():
+                s = svc.stats()
+                if not (
+                    s["rejected"] + s["executed"] <= s["requests"]
+                    and s["batches"] <= s["executed"] + 1
+                ):
+                    violations.append(s)
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(20):
+                scheduler, pods = build_scheduler(n_pods=1)
+                svc.submit(SolveRequest(KIND_SOLVE, scheduler, pods, timeout=60.0))
+                svc.run_pending()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            svc.close()
+        assert not violations, violations[:3]
+        final = svc.stats()
+        assert final["requests"] == final["executed"] == 20
+        assert final["batches"] == 20
+
+    def test_snapshot_invariants_after_rejections(self):
+        svc = SolverService(clock=FakeClock(), max_queue_depth=1)
+        s1, p1 = build_scheduler(n_pods=1)
+        svc.submit(SolveRequest(KIND_SOLVE, s1, p1, timeout=60.0))
+        s2, p2 = build_scheduler(n_pods=1)
+        with pytest.raises(QueueFullError):
+            svc.submit(SolveRequest(KIND_SOLVE, s2, p2, timeout=60.0))
+        svc.run_pending()
+        svc.close()
+        stats = svc.stats()
+        assert stats["requests"] == 1
+        assert stats["executed"] == 1
+        assert stats["rejected"] == 1
+        assert stats["executed"] <= stats["requests"]

@@ -1,0 +1,463 @@
+"""End-to-end scheduling traces (karpenter_tpu/tracing): span core +
+sampling, ring-buffer eviction order, journey assembly, trace-context
+propagation across the socket transport (daemon-side spans re-join the
+caller's trace), same-seed sim span-digest equality, and the
+/debug/traces serving surface (200 / 404 / drill-down / slowest view)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import tracing
+from karpenter_tpu.apis import core as apicore
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.operator.serving import Server, ServingConfig
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.sim.harness import run_scenario
+from karpenter_tpu.solverd.api import KIND_SOLVE
+from karpenter_tpu.solverd.service import SolverService
+from karpenter_tpu.solverd.transport import SocketClient, SolverDaemon
+from karpenter_tpu.tracing.core import Tracer
+from karpenter_tpu.tracing.export import RingBufferExporter, canonical
+from karpenter_tpu.tracing.journey import JourneyRecorder
+from karpenter_tpu.utils.clock import Clock, FakeClock
+from random import Random
+
+from helpers import nodepool, unschedulable_pod
+from test_solverd import build_scheduler
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Each test gets a clean process-global tracer (and leaves one)."""
+    tracing.configure()
+    yield
+    tracing.configure()
+
+
+class TestSpanCore:
+    def test_nesting_links_parent_child(self):
+        tr = Tracer(clock=FakeClock())
+        with tr.span("outer") as outer:
+            with tr.span("inner") as inner:
+                assert inner.context.trace_id == outer.context.trace_id
+                assert inner.parent_id == outer.context.span_id
+        spans = tr.ring.spans()
+        assert [s["name"] for s in spans] == ["inner", "outer"]
+        assert spans[1]["parent"] is None
+
+    def test_explicit_root_breaks_ambient_chain(self):
+        tr = Tracer(clock=FakeClock())
+        with tr.span("outer") as outer:
+            with tr.span("fresh", parent=None) as fresh:
+                assert fresh.context.trace_id != outer.context.trace_id
+                assert fresh.parent_id is None
+
+    def test_exception_marks_span_failed_and_reraises(self):
+        tr = Tracer(clock=FakeClock())
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("nope")
+        (span,) = tr.ring.spans()
+        assert span["status"] == "error"
+        assert "ValueError" in span["attrs"]["error"]
+
+    def test_timestamps_come_from_injected_clock(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        with tr.span("timed"):
+            clock.step(5.0)
+        (span,) = tr.ring.spans()
+        assert span["end"] - span["start"] == 5.0
+
+    def test_sample_rate_zero_exports_nothing(self):
+        tr = Tracer(clock=FakeClock(), sample_rate=0.0)
+        with tr.span("dropped") as sp:
+            # children of an unsampled span are unsampled too, for free
+            with tr.span("child") as child:
+                assert not child.sampled
+            assert not sp.sampled
+        assert len(tr.ring) == 0
+
+    def test_volatile_attrs_dropped_only_in_deterministic_mode(self):
+        live = Tracer(clock=FakeClock())
+        with live.span("s") as sp:
+            sp.set_attr(pods=3)
+            sp.set_volatile(wall_s=0.123)
+        assert live.ring.spans()[0]["attrs"] == {"pods": 3, "wall_s": 0.123}
+
+        det = Tracer(clock=FakeClock(), deterministic=True)
+        with det.span("s") as sp:
+            sp.set_attr(pods=3)
+            sp.set_volatile(wall_s=0.123)
+        assert det.ring.spans()[0]["attrs"] == {"pods": 3}
+
+    def test_seeded_uid_source_yields_identical_ids(self):
+        def run():
+            apicore.set_uid_source(Random("tracing-test"))
+            try:
+                tr = Tracer(clock=FakeClock())
+                with tr.span("a"):
+                    with tr.span("b"):
+                        pass
+                return [canonical(s) for s in tr.ring.spans()]
+            finally:
+                apicore.set_uid_source(None)
+
+        assert run() == run()
+
+
+class TestRingBuffer:
+    def test_eviction_is_strictly_oldest_first(self):
+        ring = RingBufferExporter(capacity=3)
+        for i in range(5):
+            ring.export({"trace": "t", "name": f"s{i}", "start": float(i)})
+        assert [s["name"] for s in ring.spans()] == ["s2", "s3", "s4"]
+
+    def test_take_trace_removes_exactly_that_trace(self):
+        ring = RingBufferExporter(capacity=10)
+        for i in range(4):
+            ring.export(
+                {"trace": "a" if i % 2 else "b", "name": f"s{i}", "start": float(i)}
+            )
+        taken = ring.take_trace("a")
+        assert [s["name"] for s in taken] == ["s1", "s3"]
+        assert [s["trace"] for s in ring.spans()] == ["b", "b"]
+        assert ring.take_trace("a") == []
+
+
+class TestJourneyAssembly:
+    def test_stages_assemble_from_spans(self):
+        rec = JourneyRecorder()
+        t = "trace-1"
+        rec.export({"trace": t, "name": "pod.pending", "start": 0.0, "end": 2.0,
+                    "status": "ok", "attrs": {"pod": "p1"}})
+        rec.export({"trace": t, "name": "solverd.queue", "start": 2.0, "end": 2.5,
+                    "status": "ok", "attrs": {}})
+        rec.export({"trace": t, "name": "solverd.solve", "start": 2.5, "end": 3.0,
+                    "status": "ok", "attrs": {}})
+        rec.export({"trace": t, "name": "nodeclaim.create", "start": 3.0,
+                    "end": 3.0, "status": "ok", "attrs": {"nodeclaim": "nc1"}})
+        rec.export({"trace": t, "name": "pod.schedule", "start": 3.0, "end": 3.0,
+                    "status": "ok", "attrs": {"pod": "p1", "nodeclaim": "nc1"}})
+        rec.export({"trace": t, "name": "nodeclaim.launch", "start": 3.0,
+                    "end": 4.0, "status": "ok", "attrs": {"nodeclaim": "nc1"}})
+        rec.export({"trace": t, "name": "nodeclaim.registration", "start": 4.0,
+                    "end": 6.0, "status": "ok", "attrs": {"nodeclaim": "nc1"}})
+        rec.export({"trace": t, "name": "pod.bind", "start": 7.0, "end": 7.0,
+                    "status": "ok", "attrs": {"pod": "p1", "node": "n1"}})
+        (journey,) = rec.completed()
+        assert journey["pod"] == "p1"
+        assert journey["nodeclaim"] == "nc1"
+        assert journey["total"] == 7.0
+        got = list(journey["stages"])
+        assert got == ["pending", "admit", "solve", "create", "launch",
+                       "registration", "bind"]
+        stats = rec.stats()
+        assert stats["completed"] == 1
+        assert stats["stages"]["registration"]["p50"] == 2.0
+
+
+    def test_same_name_different_uids_stay_separate(self):
+        """Names collide across namespaces and pod lifetimes; uids never
+        do — two in-flight pods named 'web-0' must not merge journeys."""
+        rec = JourneyRecorder()
+        for i, (trace, uid) in enumerate((("t-a", "uid-a"), ("t-b", "uid-b"))):
+            rec.export({"trace": trace, "name": "pod.pending",
+                        "start": float(i), "end": float(i) + 1.0,
+                        "status": "ok",
+                        "attrs": {"pod": "web-0", "pod_uid": uid}})
+        for i, (trace, uid) in enumerate((("t-a", "uid-a"), ("t-b", "uid-b"))):
+            rec.export({"trace": trace, "name": "pod.bind",
+                        "start": float(i) + 2.0, "end": float(i) + 2.0,
+                        "status": "ok",
+                        "attrs": {"pod": "web-0", "pod_uid": uid,
+                                  "node": f"n{i}"}})
+        journeys = rec.completed()
+        assert len(journeys) == 2
+        assert {j["trace"] for j in journeys} == {"t-a", "t-b"}
+        # each journey kept ITS OWN pending window
+        assert journeys[0]["stages"]["pending"]["start"] == 0.0
+        assert journeys[1]["stages"]["pending"]["start"] == 1.0
+
+
+class _ExplodingScheduler:
+    """Picklable scheduler whose solve always raises (daemon error path)."""
+
+    engine = None
+
+    def solve(self, pods, timeout=None):
+        raise RuntimeError("boom")
+
+
+class TestSocketPropagation:
+    def test_daemon_spans_rejoin_callers_trace(self):
+        """The acceptance-criteria linkage: a solve over the socket
+        transport produces daemon-side solverd spans whose trace id is the
+        CALLER's trace and whose parent is the caller's active span — the
+        carrier rides the JSON frame out, the spans ride the reply home."""
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        tr = tracing.tracer()
+        try:
+            scheduler, pods = build_scheduler(n_pods=2)
+            with tr.span("provisioner.batch", parent=None) as batch:
+                client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+                trace_id = batch.context.trace_id
+                caller_span = batch.context.span_id
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+        spans = tr.ring.trace(trace_id)
+        by_name = {s["name"]: s for s in spans}
+        assert "solverd.solve" in by_name, [s["name"] for s in spans]
+        assert "solverd.queue" in by_name
+        for name in ("solverd.solve", "solverd.queue"):
+            assert by_name[name]["trace"] == trace_id
+            assert by_name[name]["parent"] == caller_span
+
+    def test_failed_solve_spans_still_ship_home(self):
+        """A solve that FAILS daemon-side is exactly the one a user debugs:
+        the error reply must carry the daemon spans back too."""
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        tr = tracing.tracer()
+        try:
+            with tr.span("provisioner.batch", parent=None) as batch:
+                with pytest.raises(Exception):
+                    client.solve(
+                        KIND_SOLVE, _ExplodingScheduler(), [], timeout=60.0
+                    )
+                trace_id = batch.context.trace_id
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+        solves = [
+            s for s in tr.ring.trace(trace_id) if s["name"] == "solverd.solve"
+        ]
+        assert solves, "daemon-side solve span did not ship home on error"
+        assert solves[0]["status"] == "error"
+
+    def test_in_process_transport_propagates_context(self):
+        svc = SolverService(clock=FakeClock())
+        from karpenter_tpu.solverd.transport import InProcessClient
+
+        client = InProcessClient(svc)
+        tr = tracing.tracer()
+        scheduler, pods = build_scheduler(n_pods=2)
+        with tr.span("provisioner.batch", parent=None) as batch:
+            client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            trace_id = batch.context.trace_id
+        svc.close()
+        names = {s["name"] for s in tr.ring.trace(trace_id)}
+        assert {"solverd.queue", "solverd.solve"} <= names
+
+
+class TestKernelTiming:
+    def test_dispatch_classifies_compile_vs_execute(self):
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.tracing import kernel as ktime
+
+        @jax.jit
+        def f(x):
+            return x * 2.0
+
+        with ktime.measure() as acc:
+            f_x = ktime.dispatch(f, jnp.ones((4,)))  # cold: compiles
+            ktime.dispatch(f, jnp.ones((4,)))  # warm: executes
+        assert f_x is not None
+        assert acc["dispatches"] == 2
+        assert acc["compiles"] == 1
+        assert acc["compile_s"] > 0.0
+        assert acc["execute_s"] > 0.0
+
+    def test_dispatch_is_transparent_outside_measure(self):
+        from karpenter_tpu.tracing import kernel as ktime
+
+        assert ktime.dispatch(lambda x: x + 1, 41) == 42
+
+    def test_solve_span_carries_kernel_and_cache_attrs(self):
+        """The LIVE (non-deterministic) tracer keeps the volatile solve
+        attribution: wall compile/execute split + cache-hit deltas."""
+        svc = SolverService(clock=FakeClock())
+        from karpenter_tpu.solverd.transport import InProcessClient
+
+        client = InProcessClient(svc)
+        tr = tracing.tracer()
+        scheduler, pods = build_scheduler(n_pods=2)
+        with tr.span("provisioner.batch", parent=None) as batch:
+            client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            trace_id = batch.context.trace_id
+        svc.close()
+        (solve,) = [
+            s for s in tr.ring.trace(trace_id) if s["name"] == "solverd.solve"
+        ]
+        attrs = solve["attrs"]
+        for key in ("wall_compile_s", "wall_execute_s", "kernel_dispatches",
+                    "joint_cache_hits", "pack_cache_hits"):
+            assert key in attrs, (key, attrs)
+
+
+class TestSimDeterminism:
+    TRACE = {
+        "version": 1,
+        "name": "tracing-mini",
+        "duration": 60.0,
+        "tick": 1.0,
+        "nodepools": [{"name": "workers"}],
+        "events": [
+            {"at": 2.0, "kind": "submit", "group": "job", "count": 3,
+             "pod": {"cpu": "1"}},
+        ],
+    }
+
+    def test_same_seed_runs_emit_identical_span_digests(self, tmp_path):
+        out1, out2 = tmp_path / "s1.jsonl", tmp_path / "s2.jsonl"
+        r1 = run_scenario(dict(self.TRACE), seed=11, trace_export=str(out1))
+        r2 = run_scenario(dict(self.TRACE), seed=11, trace_export=str(out2))
+        t1, t2 = r1.report["tracing"], r2.report["tracing"]
+        assert t1["spans"] > 0
+        assert t1["span_digest"] == t2["span_digest"]
+        assert out1.read_bytes() == out2.read_bytes()  # byte-identical JSONL
+
+    def test_report_carries_per_stage_percentiles(self):
+        result = run_scenario(dict(self.TRACE), seed=11)
+        journeys = result.report["tracing"]["journeys"]
+        assert journeys["completed"] == 3
+        for stage in ("pending", "create", "launch", "registration", "bind"):
+            assert journeys["stages"][stage]["p50"] is not None, stage
+            assert journeys["stages"][stage]["p99"] is not None, stage
+
+    def test_every_bound_pod_has_a_complete_journey(self, tmp_path):
+        out = tmp_path / "spans.jsonl"
+        result = run_scenario(dict(self.TRACE), seed=11, trace_export=str(out))
+        spans = [json.loads(line) for line in out.read_text().splitlines()]
+        binds = [s for s in spans if s["name"] == "pod.bind"]
+        bound = {e["pod"] for e in result.log.entries("pod-bound")}
+        assert {s["attrs"]["pod"] for s in binds} == bound
+        for s in binds:  # no orphan spans: every bind joined a trace
+            assert s["parent"] is not None, s
+
+
+class TestDebugTraces:
+    def _operator_with_traffic(self):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(
+            store, KwokCloudProvider(store, clock), clock=clock,
+            options=Options(),
+        )
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        for _ in range(8):
+            clock.step(2.0)
+            op.run_once()
+        return op
+
+    @pytest.fixture
+    def traced_server(self):
+        op = self._operator_with_traffic()
+        cfg = ServingConfig(
+            metrics_text=lambda: "",
+            healthy=lambda: True,
+            ready=lambda: True,
+            trace_snapshot=op.trace_snapshot,
+        )
+        server = Server(0, cfg, host="127.0.0.1").start()
+        yield op, server
+        server.stop()
+        op.shutdown()
+
+    def _get(self, server, path):
+        url = f"http://127.0.0.1:{server.port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_index_lists_recent_traces_and_journey_stats(self, traced_server):
+        op, server = traced_server
+        code, body = self._get(server, "/debug/traces")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["traces"], "expected at least one recent trace"
+        assert doc["journeys"]["completed"] >= 1
+        entry = doc["traces"][0]
+        assert {"trace_id", "root", "spans", "errors", "duration"} <= set(entry)
+
+    def test_trace_id_drilldown_returns_full_journey(self, traced_server):
+        op, server = traced_server
+        # find the batch trace that scheduled the pod
+        journey = op.tracer.journeys.completed()[0]
+        code, body = self._get(
+            server, f"/debug/traces?trace_id={journey['trace']}"
+        )
+        assert code == 200
+        doc = json.loads(body)
+        names = {s["name"] for s in doc["spans"]}
+        assert {"provisioner.batch", "pod.schedule", "nodeclaim.create",
+                "pod.bind"} <= names
+        assert doc["journeys"][0]["pod"] == journey["pod"]
+
+    def test_unknown_trace_id_is_404(self, traced_server):
+        _, server = traced_server
+        code, body = self._get(server, "/debug/traces?trace_id=deadbeef")
+        assert code == 404
+        assert "unknown trace_id" in body
+
+    def test_slowest_view(self, traced_server):
+        _, server = traced_server
+        code, body = self._get(server, "/debug/traces?view=slowest&limit=5")
+        assert code == 200
+        doc = json.loads(body)
+        assert len(doc["slowest_journeys"]) >= 1
+        totals = [j["total"] for j in doc["slowest_journeys"]]
+        assert totals == sorted(totals, reverse=True)
+
+    def test_without_snapshot_fn_is_404(self):
+        cfg = ServingConfig(
+            metrics_text=lambda: "", healthy=lambda: True, ready=lambda: True
+        )
+        server = Server(0, cfg, host="127.0.0.1").start()
+        try:
+            code, _ = self._get(server, "/debug/traces")
+            assert code == 404
+        finally:
+            server.stop()
+
+
+class TestLogCorrelation:
+    def test_log_lines_inside_span_carry_trace_ids(self):
+        import io
+        import sys
+
+        from karpenter_tpu.operator import logging as klog
+
+        buf = io.StringIO()
+        klog.configure("info", stream=buf)
+        try:
+            log = klog.logger("tracing-test")
+            tr = tracing.tracer()
+            with tr.span("corr") as sp:
+                log.info("inside")
+                trace_id, span_id = sp.context.trace_id, sp.context.span_id
+            log.info("outside")
+        finally:
+            klog.configure("error", stream=sys.stderr)
+        entries = [json.loads(line) for line in buf.getvalue().splitlines()]
+        inside = next(e for e in entries if e["message"] == "inside")
+        outside = next(e for e in entries if e["message"] == "outside")
+        assert inside["trace_id"] == trace_id
+        assert inside["span_id"] == span_id
+        assert "trace_id" not in outside
