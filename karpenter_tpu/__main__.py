@@ -43,6 +43,7 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             solverd_stats=operator.solver_stats,
             health_snapshot=operator.health_snapshot,
             trace_snapshot=operator.trace_snapshot,
+            heap_stats=operator.heap_stats,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())

@@ -313,6 +313,8 @@ class Provisioner:
             preference_policy=self.options.preferences_policy,
         )
         engine = self.engine_factory(instance_types) if self.engine_factory else None
+        if engine is not None:
+            self._alert_native_fallback()
         return Scheduler(
             self.store,
             node_pools,
@@ -328,6 +330,35 @@ class Provisioner:
             reserved_offering_mode=reserved_offering_mode,
             reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             engine=engine,
+        )
+
+    def _alert_native_fallback(self) -> None:
+        """Warning event when the native FFD kernel failed to build and the
+        ~100x slower pure-Python steady-state loop is serving solves
+        (ops/native.py logs the line; this surfaces it in the event stream
+        — an alert, not just a counter). Once per process: the failure is
+        permanent for the process lifetime."""
+        if getattr(self, "_native_alerted", False):
+            return
+        from karpenter_tpu.ops import native
+
+        reason = native.build_failure()
+        if reason is None:
+            # loaded, still unbuilt (first solve builds lazily), or
+            # deliberately disabled — nothing to alert on yet
+            if native._tried and native._lib is not None:
+                self._native_alerted = True
+            return
+        self._native_alerted = True
+        self.recorder.publish(
+            Event(
+                None,
+                "Warning",
+                "NativeKernelUnavailable",
+                "native FFD kernel failed to build; scheduling runs the "
+                f"pure-Python steady-state loop (~100x slower): {reason}",
+                dedupe_values=("native-kernel",),
+            )
         )
 
     def _gather_instance_types(self, node_pools) -> dict:

@@ -505,6 +505,30 @@ class Operator:
             reasons.append("no successful reconcile pass recently")
         return reasons
 
+    def heap_stats(self) -> dict:
+        """Sizes of the process's interning/memo caches — the operator's
+        only unbounded-by-default memory consumers (see
+        ffd.set_memory_budget). Served inside /debug/heap so a memory
+        investigation sees tracemalloc's allocation sites and the cache
+        populations in one response."""
+        from karpenter_tpu.controllers.provisioning import provisioner as provmod
+        from karpenter_tpu.ops import ffd, ffd_topo
+        from karpenter_tpu.scheduler import topology as topomod
+
+        out = {
+            "ffd_shape_sigs": len(ffd._SIG_IDS),
+            "ffd_topo_shape_sigs": len(ffd_topo._TSIG_IDS),
+            "topology_domain_groups_memo": len(topomod._domain_groups_cache),
+            "engine_content_cache": len(provmod._ENGINE_CONTENT_CACHE),
+        }
+        joint = fam = 0
+        for engine in provmod._ENGINE_CONTENT_CACHE.values():
+            joint += len(getattr(engine, "solver_joint_cache", ()))
+            fam += len(getattr(engine, "solver_fam_trans", ()))
+        out["engine_joint_mask_cache"] = joint
+        out["engine_fam_transition_cache"] = fam
+        return out
+
     def health_snapshot(self) -> dict:
         """Structured health for /healthz and /debug/health: pass liveness,
         per-controller consecutive-failure counts, breaker state, and

@@ -29,11 +29,16 @@ class ServingConfig:
         solverd_stats: Optional[Callable[[], dict]] = None,
         health_snapshot: Optional[Callable[[], dict]] = None,
         trace_snapshot: Optional[Callable[..., Optional[dict]]] = None,
+        heap_stats: Optional[Callable[[], dict]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
         self.ready = ready
         self.enable_profiling = enable_profiling
+        # interning-cache sizes (operator.heap_stats): folded into
+        # /debug/heap so allocation hotspots and the solver's unbounded-by-
+        # default caches show up in one place
+        self.heap_stats = heap_stats
         # solverd introspection (queue depth, batches, coalesce stats);
         # served at /debug/solverd when wired (operator.solver_stats)
         self.solverd_stats = solverd_stats
@@ -84,6 +89,68 @@ def _profile_sample(seconds: float, interval: float = 0.01) -> str:
     for stack, n in stack_counts.most_common(15):
         out.append(f"{n:6d} {stack}")
     return "\n".join(out)
+
+
+def _heap_snapshot(cfg: "ServingConfig", top: int = 15, stop: bool = False) -> dict:
+    """tracemalloc-backed heap introspection (profiling surface, like
+    /debug/profile). The first request arms tracemalloc and returns only
+    the interning-cache sizes; subsequent requests add the top allocation
+    sites recorded since. Arming on demand keeps the steady-state operator
+    free of tracemalloc's overhead unless someone is actually looking —
+    and `?stop=1` disarms it again (the final snapshot is returned), so an
+    investigation's tracing cost ends with the investigation instead of
+    persisting until restart."""
+    import tracemalloc
+
+    was_tracing = tracemalloc.is_tracing()
+    if stop:
+        payload = {"tracing": False, "armed_now": False, "stopped_now": was_tracing}
+        if was_tracing:
+            current, peak = tracemalloc.get_traced_memory()
+            payload["traced_current_bytes"] = current
+            payload["traced_peak_bytes"] = peak
+            stats = tracemalloc.take_snapshot().statistics("lineno")[: max(top, 1)]
+            payload["top_allocations"] = [
+                {
+                    "site": (
+                        f"{s.traceback[0].filename}:{s.traceback[0].lineno}"
+                        if len(s.traceback) else "?"
+                    ),
+                    "size_bytes": s.size,
+                    "count": s.count,
+                }
+                for s in stats
+            ]
+            tracemalloc.stop()
+        if cfg.heap_stats is not None:
+            payload["interning_caches"] = cfg.heap_stats()
+        return payload
+    if not was_tracing:
+        tracemalloc.start()
+    payload = {"tracing": True, "armed_now": not was_tracing}
+    if was_tracing:
+        current, peak = tracemalloc.get_traced_memory()
+        payload["traced_current_bytes"] = current
+        payload["traced_peak_bytes"] = peak
+        stats = tracemalloc.take_snapshot().statistics("lineno")[: max(top, 1)]
+        payload["top_allocations"] = [
+            {
+                "site": (
+                    f"{s.traceback[0].filename}:{s.traceback[0].lineno}"
+                    if len(s.traceback) else "?"
+                ),
+                "size_bytes": s.size,
+                "count": s.count,
+            }
+            for s in stats
+        ]
+    else:
+        payload["note"] = (
+            "tracemalloc armed; re-query to see allocations recorded since"
+        )
+    if cfg.heap_stats is not None:
+        payload["interning_caches"] = cfg.heap_stats()
+    return payload
 
 
 def _stacks() -> str:
@@ -160,6 +227,21 @@ class _Handler(BaseHTTPRequestHandler):
 
                 self._respond(
                     200, json.dumps(cfg.solverd_stats()), "application/json"
+                )
+            elif url.path == "/debug/heap" and cfg.enable_profiling:
+                import json
+
+                q = parse_qs(url.query)
+                self._respond(
+                    200,
+                    json.dumps(
+                        _heap_snapshot(
+                            cfg,
+                            top=int(q.get("top", ["15"])[0]),
+                            stop=q.get("stop", ["0"])[0] == "1",
+                        )
+                    ),
+                    "application/json",
                 )
             elif url.path == "/debug/stacks" and cfg.enable_profiling:
                 self._respond(200, _stacks())

@@ -241,6 +241,35 @@ def encode_requirement_sets(
     return EncodedReqSets(present, complement, has_values, gt, lt, mask)
 
 
+@dataclass
+class DomainVocab:
+    """Interning table for topology DOMAIN strings (zone names, hostnames,
+    custom-key values): one dense id-space per topology group, so the
+    group's occupancy lives in a count vector indexed by domain id instead
+    of a str-keyed dict (ops/topo_counts.py). Ids are append-only — a
+    domain keeps its slot for the vocabulary's lifetime, so count tensors
+    survive re-syncs without re-indexing."""
+
+    ids: dict[str, int] = field(default_factory=dict)
+    domains: list[str] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.domains)
+
+    def id(self, domain: str) -> int:
+        """Interned id for `domain`, assigning the next slot on first use."""
+        did = self.ids.get(domain)
+        if did is None:
+            did = len(self.domains)
+            self.ids[domain] = did
+            self.domains.append(domain)
+        return did
+
+    def lookup(self, domain: str) -> Optional[int]:
+        """Id for `domain` without interning (None when never seen)."""
+        return self.ids.get(domain)
+
+
 def encode_resource_dims(resource_names: Sequence[str]) -> dict[str, int]:
     return {name: i for i, name in enumerate(resource_names)}
 

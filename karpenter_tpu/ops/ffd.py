@@ -111,8 +111,13 @@ PACK_CACHE_MISSES = 0
 
 def solver_cache_counters() -> dict:
     """Snapshot of the solver's cumulative cache/dispatch counters (delta
-    two snapshots to attribute one solve)."""
-    return {
+    two snapshots to attribute one solve). Includes the topology count-gate
+    counters (ops/topo_counts.py) so solverd solve spans can attribute a
+    slow topo solve to oracle fallbacks / tensor resyncs the same way they
+    attribute cold joint/pack caches."""
+    from karpenter_tpu.ops import topo_counts
+
+    out = {
         "joint_cache_hits": JOINT_CACHE_HITS,
         "joint_cache_misses": JOINT_CACHE_MISSES,
         "pack_cache_hits": PACK_CACHE_HITS,
@@ -121,6 +126,8 @@ def solver_cache_counters() -> dict:
         "device_solves": DEVICE_SOLVES,
         "device_fallbacks": DEVICE_FALLBACKS,
     }
+    out.update(topo_counts.gate_counters())
+    return out
 
 # Tests set this to make simulation bugs fail loudly instead of silently
 # falling back to the host loop.
@@ -283,7 +290,7 @@ class _Group:
     __slots__ = (
         "reqs", "strict_reqs", "requests", "req_f", "div_dims", "div_req",
         "tier", "fit_floor", "sort_cpu", "sort_mem", "n_pods", "rowset",
-        "has_hostname",
+        "has_hostname", "req_list", "floor_list",
     )
 
     def __init__(self, data, dims: dict):
@@ -300,6 +307,11 @@ class _Group:
         self.tier = self.req_f.tobytes()
         # Fit threshold: usage + req <= alloc + eps  ⟺  rem >= req - eps
         self.fit_floor = self.req_f - 1e-9
+        # Python-scalar mirrors for the deferred-claim fast path (the
+        # per-join admission/commit run scalar loops over D dims — cheaper
+        # than numpy dispatch at D ~ 8)
+        self.req_list = self.req_f.tolist()
+        self.floor_list = self.fit_floor.tolist()
         self.sort_cpu = data.requests.get(wk.RESOURCE_CPU, 0.0)
         self.sort_mem = data.requests.get(wk.RESOURCE_MEMORY, 0.0)
         self.n_pods = 0
@@ -411,7 +423,7 @@ class _Claim:
     __slots__ = (
         "ti", "fam", "hostname", "type_mask", "u_ids", "rem", "count", "rank",
         "members", "group_counts", "gdrop", "gknown", "reserved",
-        "min_specs", "min_relaxed",
+        "min_specs", "min_relaxed", "hn_epoch", "defer",
     )
 
     def __init__(self, ti, fam, hostname, type_mask, u_ids, rem, rank):
@@ -440,6 +452,21 @@ class _Claim:
         # every later join.
         self.min_specs: list[tuple[str, int]] = []
         self.min_relaxed = False
+        # hostname-register epoch (topo driver): the epoch of the hostname
+        # topology-group set this claim's hostname was last registered into.
+        # Registration is idempotent, so each (claim, group-set epoch) pays
+        # exactly one pass over the hostname groups instead of one per join.
+        self.hn_epoch = -1
+        # Deferred row-pruning state (topo driver fast path), or None.
+        # (pareto_rows, extra): `pareto_rows` are the Pareto-maximal rows of
+        # the OPEN-time headroom matrix as Python lists; `extra` accumulates
+        # the requests joined since open. Row pruning telescopes — a row
+        # survives all joins iff alloc >= final usage - eps per dim — so
+        # admission is a pareto check against (row - extra) and the full
+        # rem/u_ids narrowing is materialized only when a slow path, a
+        # minValues/reserved gate, or emit actually reads the rows
+        # (_DeviceSolve._materialize).
+        self.defer = None
 
 
 class _Node:
@@ -813,6 +840,10 @@ class _DeviceSolve:
         self.pod_errors: dict[Pod, Exception] = {}
         self.timed_out = False
         self._native: Optional[_NativeDriver] = None
+        # deferred-claim machinery (enabled by the topo driver when no
+        # per-join row reads are needed; see _Claim.defer)
+        self._defer_ok = False
+        self._pareto_cache: dict[int, tuple] = {}
         # per-claim-index HostPortUsage; populated only by the topo driver
         # when host ports are in play (plain solves gate ports shapes out)
         self._claim_hp: dict[int, HostPortUsage] = {}
@@ -924,6 +955,42 @@ class _DeviceSolve:
             if o.reservation_id not in updated_ids:
                 rm.release(c.hostname, o)
         c.reserved = updated
+
+    def _materialize(self, c: "_Claim") -> None:
+        """Collapse a claim's deferred joins into the standard rem/u_ids
+        narrowing. Exact: a row survives the iterative per-join pruning iff
+        it fits the accumulated usage (the prune criterion telescopes dim by
+        dim — usage only grows), so one vectorized pass reproduces the whole
+        sequence."""
+        extra = c.defer[1]
+        c.defer = None
+        if any(extra):
+            cur = c.rem - np.asarray(extra)
+            keep = (cur >= -_EPS).all(axis=1)
+            if keep.all():
+                c.rem = cur
+            else:
+                c.rem = cur[keep]
+                c.u_ids = c.u_ids[keep]
+
+    def _pareto_for(self, rem: np.ndarray) -> list:
+        """Pareto-maximal rows of an open-time headroom matrix as Python
+        lists — any-row-fits is equivalent to any-PARETO-row-fits, and the
+        maximal set is tiny. Cached by matrix identity: memoized openings
+        share one matrix across thousands of claims."""
+        cache = self._pareto_cache
+        hit = cache.get(id(rem))
+        if hit is not None:
+            return hit[0]
+        rows = rem.tolist()
+        pareto: list = []
+        for r in sorted(rows, key=sum, reverse=True):
+            if not any(
+                all(p[d] >= r[d] for d in range(len(r))) for p in pareto
+            ):
+                pareto.append(r)
+        cache[id(rem)] = (pareto, rem)  # hold rem so its id can't recycle
+        return pareto
 
     def _order_hook_add(self, ci: int) -> None:
         """Claim-order observer: a claim was opened (index ci). The topo
@@ -1289,6 +1356,8 @@ class _DeviceSolve:
         while heap:
             count, rank, ci = heap[0]
             c = claims[ci]
+            if c.defer is not None:
+                self._materialize(c)
             if gi in c.gdrop:
                 heapq.heappop(heap)
                 continue
@@ -1645,6 +1714,7 @@ class _DeviceSolve:
         hostname: Optional[str] = None,
         min_specs: Optional[list] = None,
         min_relaxed: bool = False,
+        pareto: Optional[list] = None,
     ) -> None:
         """Register a freshly opened claim with the active driver (Python
         loop or native kernel); the opening pod is its first member.
@@ -1664,6 +1734,11 @@ class _DeviceSolve:
         c = _Claim(ti, fam, hostname, candidate, u_ids, rem, self.seq)
         c.min_specs = self.tmpl_min[ti] if min_specs is None else min_specs
         c.min_relaxed = min_relaxed
+        if self._defer_ok:
+            c.defer = (
+                pareto if pareto is not None else self._pareto_for(rem),
+                [0.0] * self.D,
+            )
         c.count = 1
         c.members.append(pod)
         c.group_counts[gi] = 1
@@ -1923,17 +1998,28 @@ class _DeviceSolve:
         empty_hostports = {
             nct: not s.daemon_hostports[nct] for nct in s.nodeclaim_templates
         }
+        # claims sharing (template, surviving-type set) share one options
+        # list — anti-affinity-heavy solves open thousands of identical
+        # claims and the per-claim list build dominated emit. Downstream
+        # only ever REASSIGNS instance_type_options, never mutates in place.
+        options_cache: dict[tuple, list] = {}
         for ci, c in enumerate(self.claims):
+            if c.defer is not None:
+                self._materialize(c)
             nct = s.nodeclaim_templates[c.ti]
             tracked_hp = self._claim_hp.get(ci)
             surv_u = np.zeros(self.U, dtype=bool)
             surv_u[c.u_ids] = True
             final_types = c.type_mask & surv_u[self.uid_of_type]
-            tmpl_opts = self.tmpl_options[c.ti]
-            options = [
-                tmpl_opts[j]
-                for j in np.nonzero(final_types[opt_index_arr[c.ti]])[0]
-            ]
+            okey = (c.ti, final_types.tobytes())
+            options = options_cache.get(okey)
+            if options is None:
+                tmpl_opts = self.tmpl_options[c.ti]
+                options = [
+                    tmpl_opts[j]
+                    for j in np.nonzero(final_types[opt_index_arr[c.ti]])[0]
+                ]
+                options_cache[okey] = options
             fam_vals = self.fam_reqs[c.fam].values()
             if c.min_relaxed:
                 # BestEffort wrote the claim's minValues down to the

@@ -40,6 +40,7 @@ preserving EXACT host-decision parity:
 from __future__ import annotations
 
 import copy
+import heapq
 import time
 from bisect import bisect_left
 from typing import Optional, Sequence
@@ -57,8 +58,14 @@ from karpenter_tpu.ops.ffd import (
     _IneligibleShape,
     _raw_sig,
 )
+from karpenter_tpu.ops import topo_counts
+from karpenter_tpu.ops.topo_counts import GroupCounts, build_gate
 from karpenter_tpu.scheduler import nodeclaim as ncmod
-from karpenter_tpu.scheduler.topology import TYPE_ANTI_AFFINITY
+from karpenter_tpu.scheduler.topology import (
+    TYPE_AFFINITY,
+    TYPE_ANTI_AFFINITY,
+    TYPE_SPREAD,
+)
 from karpenter_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
     Operator,
@@ -239,12 +246,15 @@ class _ScanOrder:
         self.add(ci, new_key)
 
 
-# sentinel domain in join/record plans: resolve to the claim's hostname
+# sentinel domain in record plans: resolve to the claim's hostname
 _HOSTNAME_DOMAIN = object()
-# hostname ANTI-affinity collapses further: admission is one count lookup
-# (domains[claim.hostname] == 0), no Requirement objects touched at all —
-# the O(pods x claims) probe on anti-affinity-heavy solves
-_HOSTNAME_ANTI = object()
+
+# claim-entry kinds in compiled join plans (hostname-keyed groups: the
+# domain is the claim's own hostname, so admission is per claim, not per
+# family — each collapses to a count lookup against the host dict)
+_CE_ANTI = 0  # reject unless domains[hostname] == 0 (topologygroup.go:380-387)
+_CE_SPREAD = 1  # admit iff count(+self) <= maxSkew (topologygroup.go:215-227)
+_CE_AFFINITY = 2  # HostAffinityGate (count > 0, or gen-cached self-seed)
 
 
 class _TopoSolve(_DeviceSolve):
@@ -278,8 +288,7 @@ class _TopoSolve(_DeviceSolve):
             if tg.key == wk.LABEL_HOSTNAME
         ]
         self._hostname_tgs = bool(self._hn_tgs)
-        self._saved_counts: list[tuple] = []
-        self._saved_group_dicts: Optional[tuple] = None
+        self._saved_topology: Optional[tuple] = None
         self._saved_node_hp: list[tuple] = []
         self._saved_node_vols: list[tuple] = []
         self._relax_restore: dict[str, Pod] = {}
@@ -289,8 +298,33 @@ class _TopoSolve(_DeviceSolve):
         self._join_plans: dict[tuple[int, int], Optional[list]] = {}
         # record plans per (gi, ti, fam)
         self._rec_plans: dict[tuple[int, int, int], tuple] = {}
-        # per-claim hostname Requirement (by claim index)
-        self._hn_req: dict[int, Requirement] = {}
+        # -- device count-tensor state (ops/topo_counts.py) -----------------
+        # count tensors per live TopologyGroup (keyed by object identity;
+        # groups outlive the solve via the topology dicts / snapshot)
+        self._tg_counts: dict[int, GroupCounts] = {}
+        # compiled admission gates per (gi, topology group): the pod-domain
+        # row and self-selection are shape-static, so one gate serves every
+        # family/claim probe of the pair
+        self._gates: dict[tuple[int, int], object] = {}
+        # fam-level admission verdicts per (gi, fam), validated against the
+        # matched groups' count generations: (ok, gen0, gen1, ...) — a probe
+        # between placements is a dict hit plus integer compares
+        self._fam_adm: dict[tuple[int, int], tuple] = {}
+        # claim-opening memo per shape group: (tokens, gens, outcomes) —
+        # the host template loop replayed as placeholder draws + a cached
+        # opening while the matched groups' count generations stand still
+        # (see _new_claim_topo)
+        self._open_memo: dict[int, tuple] = {}
+        self._fresh_hostnames_safe = False
+        # monotone-scan classification per shape group (None = undecided):
+        # True when every matched topology group is hostname anti-affinity
+        # and no per-candidate state accumulates (ports/volumes/hostname/
+        # strict-reserved) — then ALL rejection reasons are permanent and
+        # the claim scan runs over a lazily-synced heap with pop-on-reject,
+        # killing the O(pods x claims) probe on anti-affinity-heavy solves
+        self.g_mono: list[Optional[bool]] = []
+        # hostname-group-set epoch for once-per-claim hostname registration
+        self._hn_epoch = 0
 
     # -- incremental host scan order ----------------------------------------
 
@@ -305,9 +339,14 @@ class _TopoSolve(_DeviceSolve):
 
     def _group_pods(self) -> Optional[np.ndarray]:
         pods = self.pods
-        sigs = np.empty(len(pods), dtype=np.int64)
-        for i, pod in enumerate(pods):
-            sigs[i] = _intern_tsig(pod)
+        # warm fast path: pods persist across provisioner passes and carry
+        # their interned topo-signature (mirrors ffd._group_pods)
+        try:
+            sigs = np.asarray([p._kt_tsig for p in pods], dtype=np.int64)
+        except AttributeError:
+            sigs = np.empty(len(pods), dtype=np.int64)
+            for i, pod in enumerate(pods):
+                sigs[i] = _intern_tsig(pod)
         _, first_idx, inverse, counts = np.unique(
             sigs, return_index=True, return_inverse=True, return_counts=True
         )
@@ -395,7 +434,8 @@ class _TopoSolve(_DeviceSolve):
         )
         # host matching order: owned groups in dict order, then matching
         # inverse groups (topology.py _matching_topologies)
-        self.g_matched.append(owned + inv_matched)
+        matched = owned + inv_matched
+        self.g_matched.append(matched)
         self.g_rec.append(
             [tg for tg in topo.topology_groups.values() if tg.selects(pod)]
         )
@@ -405,6 +445,20 @@ class _TopoSolve(_DeviceSolve):
                 for tg in topo.inverse_topology_groups.values()
                 if tg.is_owned_by(pod.metadata.uid)
             ]
+        )
+        # monotone classification: hostname anti-affinity counts only grow
+        # during a solve, so every rejection reason on the claim scan is
+        # permanent and the scan can pop claims from a per-group heap
+        self.g_mono.append(
+            bool(matched)
+            and not ports
+            and not has_volumes
+            and not has_hostname
+            and not self.strict_res
+            and all(
+                tg.type == TYPE_ANTI_AFFINITY and tg.key == wk.LABEL_HOSTNAME
+                for tg in matched
+            )
         )
 
     def _shape_owned(self, pod: Pod) -> list:
@@ -447,16 +501,30 @@ class _TopoSolve(_DeviceSolve):
             if tg.key == wk.LABEL_HOSTNAME
         ]
         self._hostname_tgs = bool(self._hn_tgs)
+        # claims lazily re-register their hostnames into the grown group set
+        # on their next join (the host registers on every NodeClaim.add, so a
+        # claim that never joins again never registers — epoch-lazy matches)
+        self._hn_epoch += 1
         self.g_volatile.clear()
         self.g_matched.clear()
         self.g_rec.clear()
         self.g_inv_owned.clear()
+        self.g_mono.clear()
         for rep, ports, has_vols, group in zip(
             self.g_rep, self.g_ports, self.g_volumes, self.groups
         ):
             self._append_group_meta(rep, ports, has_vols, group.has_hostname)
         self._rec_plans.clear()
         self._join_plans.clear()
+        self._fam_adm.clear()
+        self._open_memo.clear()
+        # matched sets (and volatility itself) may have changed: rebuild
+        # every group's claim heap from scratch so claims popped under the
+        # OLD gates are re-probed under the new ones (plain-path drops are
+        # re-derived from the per-claim gdrop sets on the first rescan)
+        for gi in range(len(self.gheaps)):
+            self.gheaps[gi] = []
+            self.gsynced[gi] = 0
         # (no snapshot extension needed: abort() restores the pre-solve group
         # DICTS, discarding mid-solve-created groups entirely)
 
@@ -508,20 +576,21 @@ class _TopoSolve(_DeviceSolve):
     # -- topology state management ------------------------------------------
 
     def _snapshot_topology(self) -> None:
-        topo = self.topology
-        self._saved_counts = [
-            (tg, dict(tg.domains), set(tg.empty_domains))
-            for tg in (
-                list(topo.topology_groups.values())
-                + list(topo.inverse_topology_groups.values())
-            )
-        ]
-        # relaxation can CREATE groups mid-solve; a fallback must also remove
-        # them (a pure host run would re-create them with fresh counts)
-        self._saved_group_dicts = (
-            dict(topo.topology_groups),
-            dict(topo.inverse_topology_groups),
-            dict(topo._shape_groups),
+        # counts + group dicts via the engine's snapshot/rollback contract
+        # (scheduler/topology.py): a restore also stamps fresh count
+        # generations, so device count tensors can never alias rolled-back
+        # state
+        self._saved_topology = self.topology.snapshot_counts()
+        # Freshly drawn hostname placeholders have occupancy 0 in every
+        # hostname group UNLESS the cluster pathologically contains
+        # placeholder-shaped domains already (store pods / node names):
+        # every placeholder recorded mid-solve comes from the monotonic
+        # counter and is strictly older than any future draw. The flag
+        # gates the claim-opening memo's hostname-freshness assumption.
+        self._fresh_hostnames_safe = not any(
+            d.startswith("hostname-placeholder-")
+            for tg in self._hn_tgs
+            for d in tg.domains
         )
         # port/volume joins on existing nodes mutate the SHARED state_node
         # usage; a fallback must not leave phantom entries behind
@@ -544,14 +613,8 @@ class _TopoSolve(_DeviceSolve):
         self._aborted = True
         self._restore_rm()
         topo = self.topology
-        if self._saved_group_dicts is not None:
-            groups, inverse, shapes = self._saved_group_dicts
-            topo.topology_groups = dict(groups)
-            topo.inverse_topology_groups = dict(inverse)
-            topo._shape_groups = dict(shapes)
-        for tg, domains, empty in self._saved_counts:
-            tg.domains = domains
-            tg.empty_domains = empty
+        if self._saved_topology is not None:
+            topo.restore_counts(self._saved_topology)
         for sn, usage in self._saved_node_hp:
             sn.hostport_usage = usage
         for sn, usage in self._saved_node_vols:
@@ -579,6 +642,9 @@ class _TopoSolve(_DeviceSolve):
     # compiles that once; applying it is a handful of dict increments.
 
     def _build_rec_plan(self, gi: int, ti: int, fam: int) -> tuple:
+        """Entries carry the group's count tensor directly (created on
+        first record if the group has none yet) so applying a plan is a
+        straight-line scatter into tensor + host dict per entry."""
         reqs = self.fam_reqs[fam]
         taints = self.s.nodeclaim_templates[ti].spec.taints
         entries: list[tuple] = []
@@ -588,16 +654,19 @@ class _TopoSolve(_DeviceSolve):
             ):
                 continue
             if tg.key == wk.LABEL_HOSTNAME:
-                # the claim's hostname row is always single-valued
+                # the claim's hostname row is always single-valued. Hostname
+                # groups stay dict-backed (their gates are single lookups and
+                # per-claim registrations would churn a tensor), so the entry
+                # carries the group itself — record() has the same shape.
                 entries.append((tg, _HOSTNAME_DOMAIN))
                 continue
             row = reqs.get(tg.key) if reqs.has(tg.key) else None
             if tg.type == TYPE_ANTI_AFFINITY:
                 vals = tuple(row.values_list()) if row is not None else ()
                 if vals:
-                    entries.append((tg, vals))
+                    entries.append((self._group_counts(tg), vals))
             elif row is not None and not row.complement and len(row.values) == 1:
-                entries.append((tg, next(iter(row.values))))
+                entries.append((self._group_counts(tg), next(iter(row.values))))
         inv: list[tuple] = []
         for tg in self.g_inv_owned[gi]:
             if tg.key == wk.LABEL_HOSTNAME:
@@ -606,30 +675,36 @@ class _TopoSolve(_DeviceSolve):
             row = reqs.get(tg.key) if reqs.has(tg.key) else None
             vals = tuple(row.values_list()) if row is not None else ()
             if vals:
-                inv.append((tg, vals))
+                inv.append((self._group_counts(tg), vals))
         plan = (entries, inv)
         self._rec_plans[(gi, ti, fam)] = plan
         return plan
 
     def _apply_record_plan(self, gi: int, c) -> None:
-        for tg in self._hn_tgs:
-            tg.register(c.hostname)
+        if self._hostname_tgs and c.hn_epoch != self._hn_epoch:
+            # register once per (claim, hostname-group-set epoch): the host
+            # registers on every NodeClaim.add, but registration of a known
+            # domain is a no-op, and hostnames are never unregistered
+            # mid-solve — so the first registration per epoch is exact
+            for tg in self._hn_tgs:
+                tg.register(c.hostname)
+            c.hn_epoch = self._hn_epoch
         plan = self._rec_plans.get((gi, c.ti, c.fam))
         if plan is None:
             plan = self._build_rec_plan(gi, c.ti, c.fam)
         entries, inv = plan
-        for tg, dom in entries:
+        for gc, dom in entries:
             if dom is _HOSTNAME_DOMAIN:
-                tg.record(c.hostname)
+                gc.record(c.hostname)
             elif type(dom) is tuple:
-                tg.record(*dom)
+                gc.record(*dom)
             else:
-                tg.record(dom)
-        for tg, vals in inv:
+                gc.record(dom)
+        for gc, vals in inv:
             if vals is _HOSTNAME_DOMAIN:
-                tg.record(c.hostname)
+                gc.record(c.hostname)
             else:
-                tg.record(*vals)
+                gc.record(*vals)
 
     # -- volatile paths ------------------------------------------------------
 
@@ -703,20 +778,51 @@ class _TopoSolve(_DeviceSolve):
     #
     # When a group's rows are subsumed by the claim family (_SAME) and every
     # matched topology group's key has a single-valued family row (or is the
-    # hostname), the full host evaluation collapses: tg.get() with the very
-    # same Requirement objects decides admission, and admission implies the
-    # joint is unchanged (chosen ∋ v ⇒ {v} ∩ chosen = {v}), so no
-    # Requirements are built at all. Rejection is exact too: chosen missing
-    # v is precisely the host's compatibility error (or the empty-domain
-    # raise). Anything else takes the slow path below, which mirrors
-    # nodeclaim.go:114-163 verbatim.
+    # hostname), the full host evaluation collapses: admission is a read
+    # against the group's device count tensor (ops/topo_counts.py) — the
+    # same verdict tg.get() would compute, served from a masked reduction
+    # cached per count generation — and admission implies the joint is
+    # unchanged (chosen ∋ v ⇒ {v} ∩ chosen = {v}), so no Requirements are
+    # built at all. Rejection is exact too: chosen missing v is precisely
+    # the host's compatibility error (or the empty-domain raise). Anything
+    # else takes the slow path below, which calls the real host oracle
+    # (Topology.add_requirements) and mirrors nodeclaim.go:114-163 verbatim.
+
+    def _group_counts(self, tg) -> GroupCounts:
+        gc = self._tg_counts.get(id(tg))
+        if gc is None:
+            gc = self._tg_counts[id(tg)] = GroupCounts(tg)
+        return gc
+
+    def _gate(self, gi: int, tg, pod_dom):
+        """Compiled count-tensor admission gate per (shape group, topology
+        group) — the pod-domain row and self-selection are shape-static."""
+        key = (gi, id(tg))
+        gate = self._gates.get(key)
+        if gate is None:
+            rep = self.g_rep[gi]
+            gate = build_gate(
+                self._group_counts(tg), pod_dom, tg.selects(rep), rep
+            )
+            self._gates[key] = gate
+        return gate
+
+    def _host_aff_gate(self, gi: int, tg, pod_dom):
+        key = ("hn", gi, id(tg))
+        gate = self._gates.get(key)
+        if gate is None:
+            gate = topo_counts.HostAffinityGate(
+                tg, pod_dom, tg.selects(self.g_rep[gi])
+            )
+            self._gates[key] = gate
+        return gate
 
     def _build_join_plan(self, fam: int, gi: int):
         """Compiled plan split into FAM-LEVEL entries (single-valued family
-        rows — the tg.get() outcome is identical for every claim of the
-        family, so the probe loop evaluates them once per fam per scan) and
-        PER-CLAIM entries (hostname ops, which read the claim's own
-        hostname). Returns (fam_entries, claim_entries) or None."""
+        rows — the verdict is identical for every claim of the family, so
+        one gen-cached gate read serves the whole scan) and PER-CLAIM
+        entries (hostname ops, which read the claim's own hostname).
+        Returns (fam_entries, claim_entries) or None."""
         reqs = self.fam_reqs[fam]
         g = self.groups[gi]
         fam_entries: list[tuple] = []
@@ -725,27 +831,69 @@ class _TopoSolve(_DeviceSolve):
         for tg in self.g_matched[gi]:
             pod_dom = g.strict_reqs.get(tg.key)
             if tg.key == wk.LABEL_HOSTNAME:
-                op = (
-                    _HOSTNAME_ANTI
-                    if tg.type == TYPE_ANTI_AFFINITY
-                    else _HOSTNAME_DOMAIN
-                )
-                claim_entries.append((tg, pod_dom, op, None))
+                if tg.type == TYPE_ANTI_AFFINITY:
+                    claim_entries.append((_CE_ANTI, tg, 0))
+                elif tg.type == TYPE_SPREAD:
+                    s = 1 if tg.selects(self.g_rep[gi]) else 0
+                    claim_entries.append((_CE_SPREAD, tg, s))
+                else:
+                    claim_entries.append(
+                        (_CE_AFFINITY, self._host_aff_gate(gi, tg, pod_dom), 0)
+                    )
                 continue
             row = reqs.get(tg.key) if reqs.has(tg.key) else None
             if row is None or row.complement or len(row.values) != 1:
                 plan = None
                 break
-            fam_entries.append((tg, pod_dom, next(iter(row.values)), row))
+            z = next(iter(row.values))
+            gate = self._gate(gi, tg, pod_dom)
+            fam_entries.append((gate, gate.intern(z), z, row, tg))
         self._join_plans[(fam, gi)] = plan
         return plan
 
-    def _hostname_req(self, ci: int, c) -> Requirement:
-        hn = self._hn_req.get(ci)
-        if hn is None:
-            hn = Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname])
-            self._hn_req[ci] = hn
-        return hn
+    def _fam_admission(self, gi: int, fam: int, fam_entries: list) -> bool:
+        """Fam-level verdict over the compiled gates, cached per (gi, fam)
+        and validated against the matched groups' count generations — the
+        probe between two placements is a dict hit plus an integer compare.
+        Single-gate fams (the dominant case) store a flat (ok, gen, tg)
+        triple; multi-gate fams a (ok, None, entries, gens) record."""
+        akey = (gi, fam)
+        cached = self._fam_adm.get(akey)
+        if cached is not None:
+            tg0 = cached[1]
+            if tg0 is not None:  # flat single-gate form
+                if cached[2] == tg0._gen:
+                    return cached[0]
+            else:
+                entries, gens = cached[3], cached[4]
+                k = 0
+                for entry in entries:
+                    if gens[k] != entry[4]._gen:
+                        break
+                    k += 1
+                else:
+                    return cached[0]
+        ok = True
+        for gate, zid, z, row, _tg in fam_entries:
+            if type(gate) is topo_counts.AffinityGate:
+                good = gate.ok_with_row(zid, z, row)
+            else:
+                good = gate.ok(zid)
+            if not good:
+                ok = False
+                break
+        if len(fam_entries) == 1:
+            tg0 = fam_entries[0][4]
+            self._fam_adm[akey] = (ok, tg0, tg0._gen, fam_entries)
+        else:
+            self._fam_adm[akey] = (
+                ok,
+                None,
+                None,
+                fam_entries,
+                tuple(e[4]._gen for e in fam_entries),
+            )
+        return ok
 
     def _commit_join(self, c, ci: int, pod: Pod, g: _Group, gi: int, fitrows) -> None:
         """Join tail shared by fast and slow paths: usage grows, rows that
@@ -766,215 +914,349 @@ class _TopoSolve(_DeviceSolve):
             self._apply_reserved(c, self._pending_reserved)
             self._pending_reserved = None
 
-    def _try_claims_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
-        topo = self.topology
+    def _probe_claim(self, pod: Pod, g: _Group, gi: int, c, ci: int) -> bool:
+        """One host can_add evaluation of claim `ci` for `pod`
+        (nodeclaim.go:114-163), committing the join on success. Under a
+        monotone-classified group (g_mono) every False returned here is a
+        PERMANENT rejection — the callers rely on that to pop claims."""
         templates = self.s.nodeclaim_templates
-        claims = self.claims
-        cis = self._scan.cis
-        join_plans = self._join_plans
-        tg_tol = self.tg_tol
-        fam_join = self.fam_join
-        _MISS = self._MISSING
-        # call-local int-keyed memos: the probe loop runs O(pods x claims)
-        # on anti-affinity-heavy solves, and tuple-keyed global dict gets
-        # are its dominant constant — resolve each (ti|fam, gi) once per
-        # call and hit small int-keyed dicts thereafter
-        tol_by_ti: dict = {}
-        ent_by_fam: dict = {}
-        plan_by_fam: dict = {}
-        fam_adm: dict = {}  # fam -> fam-level plan admission this scan
-        i = 0
-        n = len(cis)
+        tol = self.tg_tol.get((c.ti, gi))
+        if tol is None:
+            tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
+            self.tg_tol[(c.ti, gi)] = tol
+        if not tol:
+            return False
         gp = self.g_ports[gi]
-        while i < n:
-            ci = cis[i]
-            i += 1
-            c = claims[ci]
-            tol = tol_by_ti.get(c.ti)
-            if tol is None:
-                tol = tg_tol.get((c.ti, gi))
-                if tol is None:
-                    tol = Taints(templates[c.ti].spec.taints).tolerates_pod(pod) is None
-                    tg_tol[(c.ti, gi)] = tol
-                tol_by_ti[c.ti] = tol
-            if not tol:
-                continue
-            # host ports (nodeclaim.go:280-283): conflicts against the
-            # claim's accumulated usage reject this candidate
-            if gp and self._claim_hp[ci].conflicts(pod, gp) is not None:
-                continue
-            # hostname-constrained shapes: the host's compat gate sees the
-            # claim's placeholder hostname row vs the pod's hostname row
-            # (nodeclaim.go:285-291) — reject unless the placeholder
-            # satisfies the pod's requirement (NotIn rows usually pass,
-            # In[real-node] rows never do)
-            if g.has_hostname and not g.reqs.get(wk.LABEL_HOSTNAME).has(c.hostname):
-                continue
-            ent = ent_by_fam.get(c.fam)
-            if ent is None:
-                ent = fam_join.get((c.fam, gi))
-                if ent is None:
-                    ent = self._build_fam_join(c.fam, gi)
-                ent_by_fam[c.fam] = ent
-            if ent[0] == self._REJECT:
-                continue
-            if ent[0] == self._SAME:
-                plan = plan_by_fam.get(c.fam, _MISS)
-                if plan is _MISS:
-                    plan = join_plans.get((c.fam, gi), _MISS)
-                    if plan is _MISS:
-                        plan = self._build_join_plan(c.fam, gi)
-                    plan_by_fam[c.fam] = plan
-                if plan is not None:
-                    fam_entries, claim_entries = plan
-                    # fam-level entries: one evaluation per fam per scan —
-                    # every claim of the family shares the outcome
-                    if fam_entries:
-                        fam_ok = fam_adm.get(c.fam)
-                        if fam_ok is None:
-                            fam_ok = True
-                            for tg, pod_dom, expected, node_row in fam_entries:
-                                if not tg.get(pod, pod_dom, node_row).has(expected):
-                                    fam_ok = False
-                                    break
-                            fam_adm[c.fam] = fam_ok
-                        if not fam_ok:
-                            continue
-                    ok = True
-                    for tg, pod_dom, expected, _node_row in claim_entries:
-                        if expected is _HOSTNAME_ANTI:
-                            # the host's anti-affinity hostname gate is
-                            # exactly "no matching pod on this host yet"
-                            # (topologygroup.go:380-387 fast path)
-                            if tg.domains.get(c.hostname, 0) != 0:
-                                ok = False
-                                break
-                        else:  # _HOSTNAME_DOMAIN
-                            hn = self._hostname_req(ci, c)
-                            if not tg.get(pod, pod_dom, hn).has(c.hostname):
-                                ok = False
-                                break
-                    if not ok:
-                        continue
-                    fitrows = (c.rem >= g.fit_floor).all(axis=1)
-                    if not fitrows.any():
-                        continue
-                    if (
-                        self.min_active
-                        and not fitrows.all()
-                        and not self._min_join_ok(c, c.u_ids[fitrows])
-                    ):
-                        continue
-                    if self.strict_res:
-                        # host can_add position: a ReservedOfferingError here
-                        # rejects THIS candidate only — the inflight scan
-                        # swallows per-candidate errors (scheduler.go:519-534)
-                        try:
-                            self._pending_reserved = self._reserved_eval(
-                                c.hostname,
-                                self.fam_reqs[c.fam],
-                                self._final_types(c.type_mask, c.u_ids[fitrows]),
-                                fam=c.fam,
-                                current_reserved=c.reserved,
-                            )
-                        except ncmod.ReservedOfferingError:
-                            continue
-                    self._commit_join(c, ci, pod, g, gi, fitrows)
+        # host ports (nodeclaim.go:280-283): conflicts against the claim's
+        # accumulated usage reject this candidate
+        if gp and self._claim_hp[ci].conflicts(pod, gp) is not None:
+            return False
+        # hostname-constrained shapes: the host's compat gate sees the
+        # claim's placeholder hostname row vs the pod's hostname row
+        # (nodeclaim.go:285-291) — reject unless the placeholder satisfies
+        # the pod's requirement (NotIn rows usually pass, In[real] never do)
+        if g.has_hostname and not g.reqs.get(wk.LABEL_HOSTNAME).has(c.hostname):
+            return False
+        ent = self.fam_join.get((c.fam, gi))
+        if ent is None:
+            ent = self._build_fam_join(c.fam, gi)
+        if ent[0] == self._REJECT:
+            return False
+        if ent[0] == self._SAME:
+            plan = self._join_plans.get((c.fam, gi), self._MISSING)
+            if plan is self._MISSING:
+                plan = self._build_join_plan(c.fam, gi)
+            if plan is not None:
+                fam_entries, claim_entries = plan
+                # fam-level gates: one gen-validated tensor read serves
+                # every claim of the family until a count changes
+                if fam_entries and not self._fam_admission(gi, c.fam, fam_entries):
+                    return False
+                h = c.hostname
+                for kind, obj, s in claim_entries:
+                    if kind == _CE_ANTI:
+                        # "no matching pod on this host yet"
+                        # (topologygroup.go:380-387 fast path)
+                        if obj.domains.get(h, 0) != 0:
+                            return False
+                    elif kind == _CE_SPREAD:
+                        # hostname spread fast path: a fresh hostname is
+                        # always a valid new domain (min count 0), so the
+                        # bound is count(+self) <= maxSkew
+                        # (topologygroup.go:215-227, 269-273)
+                        if obj.domains.get(h, 0) + s > obj.max_skew:
+                            return False
+                    elif not obj.ok(h):  # _CE_AFFINITY
+                        return False
+                d = c.defer
+                if d is not None:
+                    # deferred fast commit: any-fit over the OPEN-time
+                    # pareto rows against accumulated usage (row pruning
+                    # telescopes — see _Claim.defer); no row arrays touched
+                    pareto, extra = d
+                    floor = g.floor_list
+                    nd_ = len(floor)
+                    for row in pareto:
+                        k = 0
+                        while k < nd_ and row[k] - extra[k] >= floor[k]:
+                            k += 1
+                        if k == nd_:
+                            break
+                    else:
+                        return False
+                    req = g.req_list
+                    for k in range(nd_):
+                        extra[k] += req[k]
+                    old_key = (c.count, c.rank, ci)
+                    c.count += 1
+                    self.seq += 1
+                    c.rank = -self.seq
+                    c.members.append(pod)
+                    c.group_counts[gi] = c.group_counts.get(gi, 0) + 1
+                    self._scan.move(ci, old_key, (c.count, c.rank, ci))
                     self._apply_record_plan(gi, c)
                     if gp:
                         self._claim_hp[ci].add(pod, gp)
                     return True
-            # slow path: full host gate sequence with real Requirements.
-            # joint BEFORE topology = claim reqs + pod reqs, hostname row
-            # included (nodeclaim.go:285-291)
-            base = self.fam_reqs[c.fam] if ent[0] == self._SAME else ent[3]
-            joint = Requirements(*base.values())
-            joint.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
-            try:
-                topo_reqs = topo.add_requirements(
-                    pod,
-                    templates[c.ti].spec.taints,
-                    g.strict_reqs,
-                    joint,
-                    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
-                )
-            except ValueError:
-                continue
-            if joint.compatible(topo_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
-                continue
-            joint.add(*topo_reqs.values())
-            final_rows = self._rows_sans_hostname(joint)
-            if final_rows == self.fam_rows[c.fam]:
                 fitrows = (c.rem >= g.fit_floor).all(axis=1)
                 if not fitrows.any():
-                    continue
+                    return False
                 if (
                     self.min_active
                     and not fitrows.all()
                     and not self._min_join_ok(c, c.u_ids[fitrows])
                 ):
-                    continue
+                    return False
                 if self.strict_res:
+                    # host can_add position: a ReservedOfferingError here
+                    # rejects THIS candidate only — the inflight scan
+                    # swallows per-candidate errors (scheduler.go:519-534)
                     try:
-                        # rows unchanged ⟹ content equals the fam's — the
-                        # (fam, offering) compat memo applies
                         self._pending_reserved = self._reserved_eval(
                             c.hostname,
-                            joint,
+                            self.fam_reqs[c.fam],
                             self._final_types(c.type_mask, c.u_ids[fitrows]),
                             fam=c.fam,
                             current_reserved=c.reserved,
                         )
                     except ncmod.ReservedOfferingError:
-                        continue
-            else:
-                compat_v, offer_v = self._joint_masks(final_rows, joint)
-                new_mask = c.type_mask & compat_v & offer_v
-                surv_u = np.zeros(self.U, dtype=bool)
-                surv_u[self.uid_of_type[new_mask]] = True
-                keep = surv_u[c.u_ids]
-                fitrows = keep & (c.rem >= g.fit_floor).all(axis=1)
-                if not fitrows.any():
+                        return False
+                self._commit_join(c, ci, pod, g, gi, fitrows)
+                self._apply_record_plan(gi, c)
+                if gp:
+                    self._claim_hp[ci].add(pod, gp)
+                return True
+        # slow path: full host gate sequence with real Requirements.
+        # joint BEFORE topology = claim reqs + pod reqs, hostname row
+        # included (nodeclaim.go:285-291)
+        if c.defer is not None:
+            self._materialize(c)
+        topo = self.topology
+        base = self.fam_reqs[c.fam] if ent[0] == self._SAME else ent[3]
+        joint = Requirements(*base.values())
+        joint.add(Requirement(wk.LABEL_HOSTNAME, Operator.IN, [c.hostname]))
+        try:
+            topo_reqs = topo.add_requirements(
+                pod,
+                templates[c.ti].spec.taints,
+                g.strict_reqs,
+                joint,
+                ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+            )
+        except ValueError:
+            return False
+        if joint.compatible(topo_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS) is not None:
+            return False
+        joint.add(*topo_reqs.values())
+        final_rows = self._rows_sans_hostname(joint)
+        if final_rows == self.fam_rows[c.fam]:
+            fitrows = (c.rem >= g.fit_floor).all(axis=1)
+            if not fitrows.any():
+                return False
+            if (
+                self.min_active
+                and not fitrows.all()
+                and not self._min_join_ok(c, c.u_ids[fitrows])
+            ):
+                return False
+            if self.strict_res:
+                try:
+                    # rows unchanged ⟹ content equals the fam's — the
+                    # (fam, offering) compat memo applies
+                    self._pending_reserved = self._reserved_eval(
+                        c.hostname,
+                        joint,
+                        self._final_types(c.type_mask, c.u_ids[fitrows]),
+                        fam=c.fam,
+                        current_reserved=c.reserved,
+                    )
+                except ncmod.ReservedOfferingError:
+                    return False
+        else:
+            compat_v, offer_v = self._joint_masks(final_rows, joint)
+            new_mask = c.type_mask & compat_v & offer_v
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[self.uid_of_type[new_mask]] = True
+            keep = surv_u[c.u_ids]
+            fitrows = keep & (c.rem >= g.fit_floor).all(axis=1)
+            if not fitrows.any():
+                return False
+            if self.min_active and not self._min_join_ok(
+                c, c.u_ids[fitrows], new_mask
+            ):
+                return False
+            if self.strict_res:
+                try:
+                    self._pending_reserved = self._reserved_eval(
+                        c.hostname,
+                        joint,
+                        self._final_types(new_mask, c.u_ids[fitrows]),
+                        current_reserved=c.reserved,
+                    )
+                except ncmod.ReservedOfferingError:
+                    return False
+            c.type_mask = new_mask
+            c.rem = c.rem[keep]
+            c.u_ids = c.u_ids[keep]
+            c.fam = self._intern_fam(final_rows, self._sans_hostname(joint))
+            fitrows = fitrows[keep]
+        self._commit_join(c, ci, pod, g, gi, fitrows)
+        self._apply_record_plan(gi, c)
+        if gp:
+            self._claim_hp[ci].add(pod, gp)
+        return True
+
+    def _try_claims_topo(self, pod: Pod, g: _Group, gi: int) -> bool:
+        if self.g_mono[gi]:
+            return self._try_claims_mono(pod, g, gi)
+        # general scan: skew/affinity admission is not monotone (counts
+        # elsewhere can re-admit a claim), so every attempt rescans the
+        # in-flight claims in host order. Claims whose family is CACHED
+        # inadmissible (and whose gate generations haven't moved) are
+        # skipped without paying the probe.
+        claims = self.claims
+        cis = self._scan.cis
+        fam_adm = self._fam_adm
+        i = 0
+        n = len(cis)
+        while i < n:
+            ci = cis[i]
+            i += 1
+            c = claims[ci]
+            cached = fam_adm.get((gi, c.fam))
+            if cached is not None:
+                # resolve the fam verdict HERE (re-evaluating stale entries
+                # through the count gates) so inadmissible claims skip the
+                # whole probe prefix; the probe's own check then hits warm.
+                # Only the flat single-gate fresh path is decoded inline —
+                # everything else defers to _fam_admission, the one place
+                # that understands the cache layout.
+                tg0 = cached[1]
+                if tg0 is not None and cached[2] == tg0._gen:
+                    ok = cached[0]
+                else:
+                    ok = self._fam_admission(gi, c.fam, cached[3])
+                if not ok:
                     continue
-                if self.min_active and not self._min_join_ok(
-                    c, c.u_ids[fitrows], new_mask
-                ):
-                    continue
-                if self.strict_res:
-                    try:
-                        self._pending_reserved = self._reserved_eval(
-                            c.hostname,
-                            joint,
-                            self._final_types(new_mask, c.u_ids[fitrows]),
-                            current_reserved=c.reserved,
-                        )
-                    except ncmod.ReservedOfferingError:
-                        continue
-                c.type_mask = new_mask
-                c.rem = c.rem[keep]
-                c.u_ids = c.u_ids[keep]
-                c.fam = self._intern_fam(final_rows, self._sans_hostname(joint))
-                fitrows = fitrows[keep]
-            self._commit_join(c, ci, pod, g, gi, fitrows)
-            self._apply_record_plan(gi, c)
-            if gp:
-                self._claim_hp[ci].add(pod, gp)
-            return True
+            if self._probe_claim(pod, g, gi, c, ci):
+                return True
         return False
+
+    def _try_claims_mono(self, pod: Pod, g: _Group, gi: int) -> bool:
+        """Monotone claim scan: every matched group is hostname
+        anti-affinity, whose domains only fill during a solve — so every
+        rejection reason in the probe (tolerance, family compat, the
+        anti-affinity count, fit, minValues) is permanent, and the scan can
+        pop rejected claims from a lazily-synced (count, rank, ci) heap
+        exactly like the plain driver's _try_claims. This turns the
+        O(pods x claims) probe storm on anti-affinity-heavy solves into
+        O(pods + claims) amortized, with the same first-admitting claim as
+        the host's full rescan."""
+        claims = self.claims
+        heap = self.gheaps[gi]
+        synced = self.gsynced[gi]
+        if synced < len(claims):
+            for ci in range(synced, len(claims)):
+                c = claims[ci]
+                heapq.heappush(heap, (c.count, c.rank, ci))
+            self.gsynced[gi] = len(claims)
+        while heap:
+            count, rank, ci = heap[0]
+            c = claims[ci]
+            if c.count != count or c.rank != rank:
+                heapq.heapreplace(heap, (c.count, c.rank, ci))
+                continue
+            if self._probe_claim(pod, g, gi, c, ci):
+                return True
+            heapq.heappop(heap)
+        return False
+
+    def _open_memo_tokens(self, gi: int) -> Optional[list]:
+        """Topology groups whose count generations validate a memoized
+        opening of shape group `gi`, or None when the opening is
+        memo-ineligible. Hostname spread/anti groups contribute no token:
+        their verdict on a FRESH placeholder (occupancy 0) is structurally
+        count-independent — guarded by the freshness flag. Hostname
+        affinity groups and every non-hostname group are gen-tracked."""
+        if self.strict_res or self.res_active or self.groups[gi].has_hostname:
+            return None
+        toks: list = []
+        for tg in self.g_matched[gi]:
+            if tg.key == wk.LABEL_HOSTNAME and tg.type != TYPE_AFFINITY:
+                if not self._fresh_hostnames_safe:
+                    return None
+            else:
+                toks.append(tg)
+        return toks
+
+    def _replay_open(self, pod: Pod, gi: int, outcomes: list) -> None:
+        """Replay a validated opening: consume one placeholder per failing
+        template attempt (host parity — the counter advances on every
+        retry) and open the memoized claim on the successful one."""
+        s = self.s
+        for out in outcomes:
+            if out is None:  # template attempt that drew and failed
+                next(ncmod._hostname_counter)
+                continue
+            ti, fam, candidate, u_ids, rem0_fit, min_specs, min_relaxed = out
+            hostname = f"hostname-placeholder-{next(ncmod._hostname_counter):04d}"
+            self._open_claim(
+                ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy(),
+                hostname=hostname, min_specs=min_specs, min_relaxed=min_relaxed,
+                pareto=self._pareto_for(rem0_fit) if self._defer_ok else None,
+            )
+            if self._any_ports:
+                nct = s.nodeclaim_templates[ti]
+                gp = self.g_ports[gi]
+                hp = s.daemon_hostports[nct].copy()
+                if gp:
+                    hp.add(pod, gp)
+                self._claim_hp[len(self.claims) - 1] = hp
+            self._apply_record_plan(gi, self.claims[-1])
+            # no _subtract_max: memo eligibility requires limitless pools
 
     def _new_claim_topo(self, pod: Pod, g: _Group, gi: int) -> Optional[Exception]:
         """New-claim opening with host-identical hostname-counter consumption
         and topology narrowing (scheduler.go:478-556 + nodeclaim.go:114-163).
-        No memoized error short-circuit: the host re-runs the template loop
+        No memoized ERROR short-circuit: the host re-runs the template loop
         (and consumes placeholder hostnames) on every retry, and hostname
-        STRINGS are decision-relevant under sorted-domain iteration."""
+        STRINGS are decision-relevant under sorted-domain iteration.
+        SUCCESSFUL openings are memoized per shape group and replayed while
+        the matched groups' count generations stand still — repeat openings
+        (the dominant cost on anti-affinity-heavy solves, where claims
+        saturate after a few pods) cost two dict hits and the placeholder
+        draws instead of the full template loop."""
+        memo = self._open_memo.get(gi)
+        if memo is not None:
+            toks, gens, outcomes = memo
+            k = 0
+            for tg in toks:
+                if gens[k] != tg._gen:
+                    break
+                k += 1
+            else:
+                self._replay_open(pod, gi, outcomes)
+                return None
         s, topo = self.s, self.topology
         gp = self.g_ports[gi]
         errs: list[Exception] = []
+        outcomes: list = []
+        memo_ok = True
+        # gens are captured at ENTRY: the memo is valid only while the
+        # counts the evaluation below actually SAW stand still. The
+        # opening's own records then invalidate it for the next open —
+        # exactly when the next-domain choice could differ.
+        memo_toks = self._open_memo_tokens(gi)
+        entry_gens = (
+            [tg._gen for tg in memo_toks] if memo_toks is not None else None
+        )
         for ti, nct in enumerate(s.nodeclaim_templates):
             remaining = self.remaining_resources.get(nct.nodepool_name)
             limits_mask = None
             if remaining:
+                # active limits shift per open; the opening memo only covers
+                # limitless pools
+                memo_ok = False
                 limits_mask = self._limits_mask(nct.nodepool_name, remaining)
                 if not (limits_mask & self.tmpl_mask[ti]).any():
                     errs.append(
@@ -987,6 +1269,7 @@ class _TopoSolve(_DeviceSolve):
             # the host constructs the NodeClaim here, consuming a hostname
             # placeholder even when can_add then fails
             hostname = f"hostname-placeholder-{next(ncmod._hostname_counter):04d}"
+            outcomes.append(None)  # assume draw-and-fail; success overwrites
             tol = self.tg_tol.get((ti, gi))
             if tol is None:
                 tol = Taints(nct.spec.taints).tolerates_pod(pod) is None
@@ -1084,9 +1367,11 @@ class _TopoSolve(_DeviceSolve):
             elif self.res_active:
                 self._pending_reserved = None
             fam = self._intern_fam(final_rows, self._sans_hostname(joint))
+            rem0_fit = rem0[fitrows]
             self._open_claim(
-                ti, fam, pod, gi, candidate, u_ids, rem0[fitrows].copy(),
+                ti, fam, pod, gi, candidate, u_ids, rem0_fit.copy(),
                 hostname=hostname, min_specs=min_specs, min_relaxed=min_relaxed,
+                pareto=self._pareto_for(rem0_fit) if self._defer_ok else None,
             )
             if self._any_ports:
                 hp = s.daemon_hostports[nct].copy()
@@ -1095,6 +1380,12 @@ class _TopoSolve(_DeviceSolve):
                 self._claim_hp[len(self.claims) - 1] = hp
             self._apply_record_plan(gi, self.claims[-1])
             self._subtract_max(nct, final)
+            if memo_ok and memo_toks is not None:
+                outcomes[-1] = (
+                    ti, fam, candidate, u_ids, rem0_fit,
+                    min_specs, min_relaxed,
+                )
+                self._open_memo[gi] = (memo_toks, entry_gens, outcomes)
             return None
         if not errs:
             errs.append(ValueError("no nodepool can host the pod"))
@@ -1186,6 +1477,9 @@ class _TopoSolve(_DeviceSolve):
         if gi_arr is None:
             raise _IneligibleShape("ineligible pod shape")
         self._prepare_templates()
+        # deferred row-pruning: legal whenever no per-join row reads exist —
+        # minValues gates and reserved bookkeeping both read u_ids per join
+        self._defer_ok = not (self.min_active or self.res_active)
         order = self._order(gi_arr)
         self._snapshot_topology()
         qpods = [(self.pods[i], int(gi_arr[i])) for i in order]
@@ -1194,9 +1488,17 @@ class _TopoSolve(_DeviceSolve):
         pod_errors = self.pod_errors
         start = time.perf_counter()
         check = 0
+        # fast-lane conditions hoisted out of the loop: with no existing
+        # nodes and a non-relaxable shape, one attempt is exactly
+        # claim-scan → new-claim (no _attempt/_try_once dispatch)
+        relaxable = self.g_relaxable
+        volatile = self.g_volatile
+        has_nodes = bool(self.nodes)
+        has_templates = bool(self.s.nodeclaim_templates)
+        groups = self.groups
         while head < len(qpods):
             pod, gi = qpods[head]
-            if last_len.get(pod.metadata.uid) == len(qpods) - head:
+            if last_len and last_len.get(pod.metadata.uid) == len(qpods) - head:
                 break
             check += 1
             if timeout is not None and not (check & 0x3F):
@@ -1208,9 +1510,16 @@ class _TopoSolve(_DeviceSolve):
                         )
                     return
             head += 1
-            err = self._attempt(pod, gi)
+            if not has_nodes and not relaxable[gi] and has_templates and volatile[gi]:
+                if self._try_claims_topo(pod, groups[gi], gi):
+                    err = None
+                else:
+                    err = self._new_claim_topo(pod, groups[gi], gi)
+            else:
+                err = self._attempt(pod, gi)
             if err is None:
-                pod_errors.pop(pod, None)
+                if pod_errors:
+                    pod_errors.pop(pod, None)
             else:
                 pod_errors[pod] = err
                 qpods.append((pod, gi))

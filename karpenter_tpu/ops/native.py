@@ -5,7 +5,13 @@ and cached beside the source, keyed by a source hash — mirroring how the
 reference ships a compiled scheduler core while we stay pip-less. Loading is
 best-effort: any failure (no compiler, unwritable dir, exotic platform)
 degrades to the pure-Python loop in ops/ffd.py, which computes identical
-decisions. Set KARPENTER_TPU_NATIVE=0 to force the Python loop.
+decisions — BUT it is ~100x slower in steady state, so the degradation is
+ALERTED, not just counted: a warning log line fires here the moment the
+fallback engages, and the provisioner publishes a Warning event
+(NativeKernelUnavailable) so operators see it in the event stream.
+
+Set KARPENTER_TPU_NATIVE=0 to force the Python loop (deliberate — no
+alert). Set KARPENTER_TPU_CXX to pin (or poison, in tests) the compiler.
 """
 
 from __future__ import annotations
@@ -23,6 +29,7 @@ _SRC = os.path.join(_DIR, "ffd_kernel.cc")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_build_error: Optional[str] = None
 
 i32, i64, u8, u64, f64 = (
     ctypes.c_int32,
@@ -51,26 +58,36 @@ JOIN_NARROW = 3
 
 
 def _build() -> Optional[str]:
+    global _build_error
     with open(_SRC, "rb") as f:
         src = f.read()
     tag = hashlib.sha256(src).hexdigest()[:16]
     so = os.path.join(_DIR, f"ffd_kernel_{tag}.so")
     if os.path.exists(so):
         return so
+    override = os.environ.get("KARPENTER_TPU_CXX")
+    compilers = (override,) if override else ("g++", "c++", "clang++")
     tmp = f"{so}.{os.getpid()}.tmp"  # unique per process: concurrent builders
+    failures = []
     try:
-        for cxx in ("g++", "c++", "clang++"):
+        for cxx in compilers:
             try:
                 r = subprocess.run(
                     [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
                     capture_output=True,
                     timeout=120,
                 )
-            except (OSError, subprocess.TimeoutExpired):
+            except (OSError, subprocess.TimeoutExpired) as e:
+                failures.append(f"{cxx}: {e}")
                 continue
             if r.returncode == 0:
                 os.replace(tmp, so)
                 return so
+            failures.append(
+                f"{cxx}: exit {r.returncode}: "
+                f"{r.stderr.decode(errors='replace')[:200].strip()}"
+            )
+        _build_error = "; ".join(failures) or "no C++ compiler found"
         return None
     finally:
         if os.path.exists(tmp):
@@ -111,7 +128,7 @@ def _sigs(lib: ctypes.CDLL) -> None:
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded kernel library, or None when unavailable/disabled."""
-    global _lib, _tried
+    global _lib, _tried, _build_error
     if os.environ.get("KARPENTER_TPU_NATIVE", "1") == "0":
         return None
     if _tried:
@@ -125,7 +142,32 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 lib = ctypes.CDLL(so)
                 _sigs(lib)
                 _lib = lib
-        except Exception:  # noqa: BLE001 — degrade to the Python loop
+        except Exception as e:  # noqa: BLE001 — degrade to the Python loop
             _lib = None
+            _build_error = _build_error or f"{type(e).__name__}: {e}"
+        if _lib is None:
+            if _build_error is None:
+                _build_error = "native kernel build failed"
+            # alert, don't just degrade: the pure-Python steady-state loop
+            # is ~100x slower — operators must see this, not discover it
+            # in a latency graph
+            from karpenter_tpu.operator import logging as klog
+
+            klog.logger("native").warning(
+                "native FFD kernel unavailable; scheduling falls back to "
+                "the pure-Python steady-state loop (~100x slower)",
+                error=_build_error,
+            )
         _tried = True
     return _lib
+
+
+def build_failure() -> Optional[str]:
+    """Why the native kernel is unavailable (None when it loaded, was
+    never tried, or was deliberately disabled via KARPENTER_TPU_NATIVE=0).
+    The provisioner turns this into a Warning event once per process."""
+    if os.environ.get("KARPENTER_TPU_NATIVE", "1") == "0":
+        return None
+    if not _tried or _lib is not None:
+        return None
+    return _build_error or "native kernel build failed"

@@ -222,6 +222,27 @@ class GroupSolver:
         )
 
 
+def scatter_add_counts(
+    counts: np.ndarray, idx: Sequence[int], amount: int = 1
+) -> np.ndarray:
+    """Unbuffered scatter-add of `amount` into `counts` at `idx` (duplicate
+    indices accumulate, matching `jnp.ndarray.at[].add` semantics), growing
+    the vector geometrically when an index lands past the end. This is the
+    update primitive behind the topology count tensors (ops/topo_counts.py):
+    one placement batch scatters its (group, domain) increments in a single
+    call instead of a per-domain dict walk."""
+    idx = np.asarray(idx, dtype=np.int64)
+    if idx.size == 0:
+        return counts
+    hi = int(idx.max())
+    if hi >= counts.shape[0]:
+        grown = np.zeros(max(hi + 1, counts.shape[0] * 2), dtype=counts.dtype)
+        grown[: counts.shape[0]] = counts
+        counts = grown
+    np.add.at(counts, idx, amount)
+    return counts
+
+
 def encode_pods_for_packer(
     engine: CatalogEngine, pods_requirements: Sequence[Requirements], requests: np.ndarray
 ) -> GroupedPods:

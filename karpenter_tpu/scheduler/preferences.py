@@ -21,13 +21,20 @@ class Preferences:
 
     def relax(self, pod: Pod) -> bool:
         """Mutates the pod, removing one soft constraint. True if relaxed."""
-        # the device fast path caches spec-shape signatures on the object;
-        # any in-place spec mutation must invalidate them (ops/ffd._raw_sig,
-        # ops/ffd_topo._topo_sig)
+        # the device fast path and topology engine cache spec-shape
+        # signatures on the object; any in-place spec mutation must
+        # invalidate them (ops/ffd._raw_sig, ops/ffd_topo._topo_sig,
+        # scheduler/topology._pod_shape_key). The topology COUNT state is
+        # deliberately untouched: ladder retries re-enter the solver with
+        # the same TopologyGroup objects, so the device count tensors keyed
+        # on them stay warm across rungs — only the relaxed pod's shape
+        # identity is recomputed.
         if hasattr(pod, "_kt_sig"):
             del pod._kt_sig
         if hasattr(pod, "_kt_tsig"):
             del pod._kt_tsig
+        if hasattr(pod, "_kt_topo_key"):
+            del pod._kt_topo_key
         relaxations = [
             self.remove_required_node_affinity_term,
             self.remove_preferred_pod_affinity_term,

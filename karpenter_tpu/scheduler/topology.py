@@ -11,6 +11,7 @@ semantic oracle.
 from __future__ import annotations
 
 import copy
+import itertools
 from typing import Iterable, Optional, Sequence
 
 from karpenter_tpu.apis import labels as wk
@@ -41,6 +42,13 @@ IGNORE = "Ignore"
 
 PREFERENCE_POLICY_RESPECT = "Respect"
 PREFERENCE_POLICY_IGNORE = "Ignore"
+
+# Process-global generation source for TopologyGroup count state. Every
+# domain-count mutation stamps the group with a FRESH value (never reused),
+# so the device solver's count tensors (ops/topo_counts.py) can validate
+# their sync with one integer compare — and a snapshot restore can't alias
+# a stale tensor onto restored counts (the restored stamp is new too).
+_count_gen = itertools.count(1)
 
 
 def ignored_for_topology(p: Pod) -> bool:
@@ -172,6 +180,7 @@ class TopologyGroup:
         self.owners: set[str] = set()
         self.domains: dict[str, int] = {}
         self.empty_domains: set[str] = set()
+        self._gen = next(_count_gen)  # count-state generation (see _count_gen)
         self._domain_reqs: dict[str, Requirement] = {}
         self._anti_reqs: dict[str, Requirement] = {}
         self._empty_anti: Optional[Requirement] = None
@@ -187,17 +196,27 @@ class TopologyGroup:
         for d in domains:
             self.domains[d] = self.domains.get(d, 0) + 1
             self.empty_domains.discard(d)
+        if domains:
+            self._gen = next(_count_gen)
 
     def register(self, *domains: str) -> None:
+        changed = False
         for d in domains:
             if d not in self.domains:
                 self.domains[d] = 0
                 self.empty_domains.add(d)
+                changed = True
+        if changed:
+            self._gen = next(_count_gen)
 
     def unregister(self, *domains: str) -> None:
+        changed = False
         for d in domains:
-            self.domains.pop(d, None)
+            if self.domains.pop(d, None) is not None:
+                changed = True
             self.empty_domains.discard(d)
+        if changed:
+            self._gen = next(_count_gen)
 
     def add_owner(self, uid: str) -> None:
         self.owners.add(uid)
@@ -459,7 +478,25 @@ def _pod_shape_key(p: Pod) -> tuple:
     """Value key over every pod field that shapes its topology groups:
     namespace + labels (matchLabelKeys, selects), node selector / required
     node affinity / tolerations (the spread node filter), and the spread +
-    pod (anti-)affinity constraint content."""
+    pod (anti-)affinity constraint content.
+
+    Cached on the pod object (pods persist across provisioner passes, and
+    Topology is rebuilt every batch — the key is the dominant cost of that
+    rebuild at 20k+ pods). Every in-place spec mutation site must invalidate
+    `_kt_topo_key` alongside the other shape-signature caches
+    (scheduler/preferences.py relax, scheduler/volumetopology.py inject)."""
+    cached = getattr(p, "_kt_topo_key", None)
+    if cached is not None:
+        return cached
+    key = _pod_shape_key_compute(p)
+    try:
+        p._kt_topo_key = key
+    except Exception:  # noqa: BLE001 — slotted/frozen pod
+        pass
+    return key
+
+
+def _pod_shape_key_compute(p: Pod) -> tuple:
     spec = p.spec
     aff = spec.affinity
     na_sig: tuple = ()
@@ -611,33 +648,78 @@ class Topology:
         # labels via matchLabelKeys/selects, selector/affinity/tolerations
         # via the spread node filter, and the constraint terms themselves)
         self._shape_groups: dict[tuple, list[TopologyGroup]] = {}
+        # per-shape flag: does update() run the inverse anti-affinity
+        # bookkeeping for this shape? (the __init__ fast path replays it
+        # per pod — it registers per-uid ownership)
+        self._shape_inverse: dict[tuple, bool] = {}
         # Pods being scheduled are excluded from live-cluster counting — the
-        # simulation itself records them (topology.go:78-80).
-        self.excluded_pods: set[str] = {p.metadata.uid for p in pods}
+        # simulation itself records them (topology.go:78-80). The set is
+        # materialized lazily (see the excluded_pods property): plain solves
+        # never consult it, and building 100k uids per batch is measurable.
+        self._batch_pods = pods
+        self._excluded_pods: Optional[set[str]] = None
         self._update_inverse_affinities()
+        shape_groups = self._shape_groups
+        shape_inverse = self._shape_inverse
         for p in pods:
             # plain pods (no spread constraints, no affinity) can neither
             # create nor own topology groups — skipping them keeps the init
-            # scan O(1) per pod on large batches
-            if p.spec.topology_spread_constraints or p.spec.affinity is not None:
-                self.update(p)
+            # scan O(1) per pod on large batches (the verdict is cached on
+            # the pod; spec-mutation sites invalidate it like the other
+            # shape caches). Each pod is seen exactly once here, so the
+            # remove-owner sweep update() runs for re-relaxed pods is
+            # skipped (fresh=True). Pods whose shape already passed through
+            # update() take the memo fast path: ownership registration only
+            # (plus the per-pod inverse anti-affinity bookkeeping for
+            # shapes that need it).
+            if getattr(p, "_kt_topo_plain", False):
+                continue
+            spec = p.spec
+            if not spec.topology_spread_constraints and spec.affinity is None:
+                try:
+                    p._kt_topo_plain = True
+                except Exception:  # noqa: BLE001 — slotted/frozen pod
+                    pass
+                continue
+            key = getattr(p, "_kt_topo_key", None)
+            owned = shape_groups.get(key) if key is not None else None
+            if owned is None or key not in shape_inverse:
+                self.update(p, fresh=True)
+                continue
+            if shape_inverse[key]:
+                self._update_inverse_anti_affinity(p, None)
+            uid = p.metadata.uid
+            for tg in owned:
+                tg.add_owner(uid)
+
+    @property
+    def excluded_pods(self) -> set[str]:
+        s = self._excluded_pods
+        if s is None:
+            s = self._excluded_pods = {
+                p.metadata.uid for p in self._batch_pods
+            }
+        return s
 
     # -- group construction (topology.go:143-169, 432-474) ------------------
 
-    def update(self, p: Pod) -> None:
-        for tg in self.topology_groups.values():
-            tg.remove_owner(p.metadata.uid)
+    def update(self, p: Pod, fresh: bool = False) -> None:
+        if not fresh:
+            for tg in self.topology_groups.values():
+                tg.remove_owner(p.metadata.uid)
 
-        if (
+        needs_inverse = (
             self.preference_policy == PREFERENCE_POLICY_IGNORE
             and podutil.has_required_pod_anti_affinity(p)
         ) or (
             self.preference_policy == PREFERENCE_POLICY_RESPECT
             and podutil.has_pod_anti_affinity(p)
-        ):
+        )
+        if needs_inverse:
             self._update_inverse_anti_affinity(p, None)
 
         memo_key = _pod_shape_key(p)
+        self._shape_inverse[memo_key] = needs_inverse
         owned = self._shape_groups.get(memo_key)
         if owned is None:
             owned = []
@@ -888,6 +970,43 @@ class Topology:
                 )
             requirements.add(domains)
         return requirements
+
+    # -- count snapshot / rollback (device-solver contract) -----------------
+    #
+    # The device fast path (ops/ffd_topo.py) mutates live group counts and
+    # ownership during its simulation; a fallback abort must hand the host
+    # loop EXACTLY the pre-solve state. The contract: snapshot_counts()
+    # before the first mutation, restore_counts() on abort. Restoring stamps
+    # every group with a FRESH generation so device count tensors synced
+    # mid-solve (ops/topo_counts.py) can never alias the rolled-back counts.
+
+    def snapshot_counts(self) -> tuple:
+        """Snapshot per-group domain counts plus the group dictionaries
+        themselves — relaxation can CREATE groups mid-solve (a relaxed
+        shape's node-filter hash differs), and a pure host run would
+        re-create them with fresh counts, so rollback removes them."""
+        return (
+            [
+                (tg, dict(tg.domains), set(tg.empty_domains))
+                for tg in (
+                    list(self.topology_groups.values())
+                    + list(self.inverse_topology_groups.values())
+                )
+            ],
+            dict(self.topology_groups),
+            dict(self.inverse_topology_groups),
+            dict(self._shape_groups),
+        )
+
+    def restore_counts(self, snapshot: tuple) -> None:
+        counts, groups, inverse, shapes = snapshot
+        self.topology_groups = dict(groups)
+        self.inverse_topology_groups = dict(inverse)
+        self._shape_groups = dict(shapes)
+        for tg, domains, empty in counts:
+            tg.domains = domains
+            tg.empty_domains = empty
+            tg._gen = next(_count_gen)
 
     def register(self, topology_key: str, domain: str) -> None:
         for tg in self.topology_groups.values():

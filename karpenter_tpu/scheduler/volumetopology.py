@@ -34,9 +34,16 @@ class VolumeTopology:
         if not requirements:
             return
         # in-place spec mutation invalidates the cached device-path shape
-        # signature (ops/ffd._raw_sig)
+        # signatures (ops/ffd._raw_sig, ops/ffd_topo._topo_sig) and the
+        # topology shape key (scheduler/topology._pod_shape_key)
         if hasattr(pod, "_kt_sig"):
             del pod._kt_sig
+        if hasattr(pod, "_kt_tsig"):
+            del pod._kt_tsig
+        if hasattr(pod, "_kt_topo_key"):
+            del pod._kt_topo_key
+        if hasattr(pod, "_kt_topo_plain"):
+            del pod._kt_topo_plain
         if pod.spec.affinity is None:
             pod.spec.affinity = Affinity()
         if pod.spec.affinity.node_affinity is None:

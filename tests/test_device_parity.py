@@ -14,8 +14,12 @@ across runs requires identical metadata). Run the long fuzz directly:
 """
 
 import itertools
+import os
 import random
 import sys
+
+if __name__ == "__main__":  # direct fuzz runs (CI smoke job, soak scripts)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest
 

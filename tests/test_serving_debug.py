@@ -10,13 +10,14 @@ import pytest
 from karpenter_tpu.operator.serving import Server, ServingConfig
 
 
-def make_server(enable_profiling=False, solverd_stats=None):
+def make_server(enable_profiling=False, solverd_stats=None, heap_stats=None):
     cfg = ServingConfig(
         metrics_text=lambda: "karpenter_test_metric 1\n",
         healthy=lambda: True,
         ready=lambda: True,
         enable_profiling=enable_profiling,
         solverd_stats=solverd_stats,
+        heap_stats=heap_stats,
     )
     return Server(0, cfg, host="127.0.0.1").start()
 
@@ -77,7 +78,7 @@ class TestDebugEndpoints:
         assert "not found" in body
 
     def test_profiling_disabled_hides_debug(self, plain_server):
-        for path in ("/debug/stacks", "/debug/profile?seconds=0.1"):
+        for path in ("/debug/stacks", "/debug/profile?seconds=0.1", "/debug/heap"):
             code, body = get(plain_server, path)
             assert code == 404, f"{path} must 404 when profiling is off"
             assert "not found" in body
@@ -86,6 +87,87 @@ class TestDebugEndpoints:
         assert get(plain_server, "/metrics")[0] == 200
         assert get(plain_server, "/healthz")[0] == 200
         assert get(plain_server, "/readyz")[0] == 200
+
+
+class TestHeapEndpoint:
+    def test_heap_arms_then_reports_allocations(self):
+        """First hit arms tracemalloc (no overhead until someone looks);
+        the second reports allocation sites and traced totals."""
+        import tracemalloc
+
+        server = make_server(
+            enable_profiling=True,
+            heap_stats=lambda: {"ffd_shape_sigs": 7, "engine_joint_mask_cache": 3},
+        )
+        try:
+            code, body = get(server, "/debug/heap")
+            assert code == 200
+            first = json.loads(body)
+            assert first["tracing"] is True
+            # interning-cache sizes surface on every response
+            assert first["interning_caches"]["ffd_shape_sigs"] == 7
+            if first["armed_now"]:
+                assert "re-query" in first["note"]
+            list(range(50_000))  # some allocations to record
+            code, body = get(server, "/debug/heap?top=5")
+            assert code == 200
+            second = json.loads(body)
+            assert second["armed_now"] is False
+            assert second["traced_current_bytes"] >= 0
+            assert len(second["top_allocations"]) <= 5
+            for site in second["top_allocations"]:
+                assert ":" in site["site"] and site["size_bytes"] >= 0
+            assert second["interning_caches"]["engine_joint_mask_cache"] == 3
+            # ?stop=1 disarms: the final snapshot comes back and the
+            # tracing overhead ends with the investigation
+            code, body = get(server, "/debug/heap?stop=1")
+            assert code == 200
+            final = json.loads(body)
+            assert final["stopped_now"] is True
+            assert final["tracing"] is False
+            assert "top_allocations" in final
+            assert not tracemalloc.is_tracing()
+        finally:
+            server.stop()
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
+
+    def test_heap_without_stats_callable(self):
+        import tracemalloc
+
+        server = make_server(enable_profiling=True)
+        try:
+            code, body = get(server, "/debug/heap")
+            assert code == 200
+            assert "interning_caches" not in json.loads(body)
+            get(server, "/debug/heap?stop=1")
+            assert not tracemalloc.is_tracing()
+        finally:
+            server.stop()
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
+
+    def test_operator_heap_stats_shape(self):
+        """The operator's collector names every interning cache the memory
+        budget governs (ffd.set_memory_budget)."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(store, FakeCloudProvider(), clock=clock)
+        stats = op.heap_stats()
+        for key in (
+            "ffd_shape_sigs",
+            "ffd_topo_shape_sigs",
+            "topology_domain_groups_memo",
+            "engine_content_cache",
+            "engine_joint_mask_cache",
+            "engine_fam_transition_cache",
+        ):
+            assert isinstance(stats[key], int)
 
 
 class TestSolverdEndpoint:

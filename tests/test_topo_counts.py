@@ -1,0 +1,234 @@
+"""Unit specs for the device-resident topology count tensors
+(ops/topo_counts.py): vocabulary interning, scatter-add updates, the
+generation sync contract with the host TopologyGroup oracle, rollback
+freshness, and gate-vs-oracle agreement on randomized count states."""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.core import LabelSelector, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.ops.encoding import DomainVocab
+from karpenter_tpu.ops.packer import scatter_add_counts
+from karpenter_tpu.ops.topo_counts import (
+    AntiGate,
+    GroupCounts,
+    HostAffinityGate,
+    SpreadGate,
+    build_gate,
+)
+from karpenter_tpu.scheduler.topology import (
+    MAX_SKEW_UNBOUNDED,
+    TYPE_AFFINITY,
+    TYPE_ANTI_AFFINITY,
+    TYPE_SPREAD,
+    TopologyDomainGroup,
+    TopologyGroup,
+)
+from karpenter_tpu.scheduling.requirements import Operator, Requirement
+
+ZONES = ["z1", "z2", "z3", "z4"]
+
+
+def make_pod(labels=None):
+    return Pod(
+        metadata=ObjectMeta(name="p", uid="uid-p", labels=labels or {"app": "a"}),
+        spec=PodSpec(),
+    )
+
+
+def make_group(type_=TYPE_SPREAD, key=wk.LABEL_TOPOLOGY_ZONE, max_skew=1,
+               min_domains=None, domains=ZONES):
+    dg = TopologyDomainGroup()
+    for d in domains:
+        dg.insert(d, [])
+    tg = TopologyGroup(
+        type_,
+        key,
+        make_pod(),
+        {"default"},
+        LabelSelector(match_labels={"app": "a"}),
+        max_skew if type_ == TYPE_SPREAD else MAX_SKEW_UNBOUNDED,
+        min_domains,
+        None,
+        None,
+        dg,
+    )
+    return tg
+
+
+class TestScatterAdd:
+    def test_accumulates_duplicates(self):
+        counts = np.zeros(4, dtype=np.int64)
+        counts = scatter_add_counts(counts, [1, 1, 3])
+        assert counts.tolist() == [0, 2, 0, 1]
+
+    def test_grows_past_capacity(self):
+        counts = np.zeros(2, dtype=np.int64)
+        counts = scatter_add_counts(counts, [5])
+        assert len(counts) >= 6 and counts[5] == 1
+
+    def test_empty_batch_is_noop(self):
+        counts = np.ones(2, dtype=np.int64)
+        assert scatter_add_counts(counts, []) is counts
+
+
+class TestDomainVocab:
+    def test_ids_are_stable_and_append_only(self):
+        v = DomainVocab()
+        a = v.id("z1")
+        b = v.id("z2")
+        assert (a, b) == (0, 1)
+        assert v.id("z1") == a  # re-intern keeps the slot
+        assert v.lookup("z3") is None
+        assert len(v) == 2
+
+
+class TestGroupCounts:
+    def test_mirrors_host_counts(self):
+        tg = make_group()
+        tg.record("z1", "z1", "z2")
+        gc = GroupCounts(tg)
+        assert gc.count("z1") == 2
+        assert gc.count("z2") == 1
+        assert gc.count("z3") == 0  # seeded empty domain
+        assert gc.count("nope") == -1
+
+    def test_record_keeps_generations_aligned(self):
+        tg = make_group()
+        gc = GroupCounts(tg)
+        gc.record("z1")
+        gc.record("z1", "z2")
+        assert gc.synced_gen == tg._gen
+        assert gc.count("z1") == tg.domains["z1"] == 2
+        assert "z1" not in tg.empty_domains
+
+    def test_out_of_band_mutation_resyncs(self):
+        tg = make_group()
+        gc = GroupCounts(tg)
+        tg.record("z4")  # host oracle path, tensor not told
+        assert gc.synced_gen != tg._gen
+        gc.fresh()
+        assert gc.count("z4") == 1
+        assert gc.synced_gen == tg._gen
+
+    def test_tensor_export(self):
+        tg = make_group()
+        tg.record("z2")
+        gc = GroupCounts(tg)
+        t = gc.tensor()
+        assert t.dtype == np.int64
+        assert t[gc.vocab.lookup("z2")] == 1
+        assert t.min() >= 0  # absent domains export as 0, not -1
+
+    def test_restore_counts_freshens_generations(self):
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.state.cluster import Cluster
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.scheduler.topology import Topology
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        cluster = Cluster(clock, store, cloud_provider=None)
+        topo = Topology(store, cluster, [], [], {}, [])
+        tg = make_group()
+        topo.topology_groups[("k",)] = tg
+        snap = topo.snapshot_counts()
+        gc = GroupCounts(tg)
+        gc.record("z1")
+        gen_before = tg._gen
+        topo.restore_counts(snap)
+        assert tg.domains["z1"] == 0  # rolled back
+        assert tg._gen != gen_before  # fresh stamp: tensors cannot alias
+        assert gc.synced_gen != tg._gen
+        gc.fresh()
+        assert gc.count("z1") == 0
+
+
+def _exists():
+    return Requirement("x", Operator.EXISTS)
+
+
+class TestGatesMatchOracle:
+    """The gates must answer exactly what `tg.get(pod, pod_dom, In[z]).has(z)`
+    answers, across randomized count states (the whole-solve guarantee is
+    the parity fuzz; this pins the per-gate contract)."""
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_spread_gate(self, seed):
+        rng = random.Random(seed)
+        tg = make_group(max_skew=rng.choice([1, 2, 3]),
+                        min_domains=rng.choice([None, 2, 5]))
+        pod = make_pod()
+        pod_dom = (
+            _exists()
+            if rng.random() < 0.5
+            else Requirement(tg.key, Operator.IN, rng.sample(ZONES, rng.randint(1, 4)))
+        )
+        gate = SpreadGate(GroupCounts(tg), pod_dom, tg.selects(pod))
+        for _ in range(30):
+            gate.gc.record(rng.choice(ZONES))
+            z = rng.choice(ZONES + ["unknown"])
+            node_row = Requirement(tg.key, Operator.IN, [z])
+            want = tg.get(pod, pod_dom, node_row).has(z)
+            assert gate.ok(gate.intern(z)) == want, (z, tg.domains)
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_anti_gate(self, seed):
+        rng = random.Random(seed)
+        tg = make_group(type_=TYPE_ANTI_AFFINITY)
+        pod = make_pod()
+        pod_dom = (
+            _exists()
+            if rng.random() < 0.5
+            else Requirement(tg.key, Operator.IN, rng.sample(ZONES, rng.randint(1, 4)))
+        )
+        gate = AntiGate(GroupCounts(tg), pod_dom, tg.selects(pod))
+        for _ in range(20):
+            if rng.random() < 0.5:
+                gate.gc.record(rng.choice(ZONES))
+            z = rng.choice(ZONES)
+            node_row = Requirement(tg.key, Operator.IN, [z])
+            want = tg.get(pod, pod_dom, node_row).has(z)
+            assert gate.ok(gate.intern(z)) == want
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_affinity_gate(self, seed):
+        rng = random.Random(seed)
+        tg = make_group(type_=TYPE_AFFINITY)
+        pod = make_pod()
+        pod_dom = (
+            _exists()
+            if rng.random() < 0.5
+            else Requirement(tg.key, Operator.IN, rng.sample(ZONES, rng.randint(1, 4)))
+        )
+        gate = build_gate(GroupCounts(tg), pod_dom, tg.selects(pod), pod)
+        for _ in range(20):
+            if rng.random() < 0.6:
+                gate.gc.record(rng.choice(ZONES))
+            z = rng.choice(ZONES)
+            node_row = Requirement(tg.key, Operator.IN, [z])
+            want = tg.get(pod, pod_dom, node_row).has(z)
+            assert gate.ok_with_row(gate.intern(z), z, node_row) == want
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_hostname_affinity_gate(self, seed):
+        rng = random.Random(seed)
+        hosts = [f"h{i}" for i in range(4)]
+        tg = make_group(type_=TYPE_AFFINITY, key=wk.LABEL_HOSTNAME, domains=hosts)
+        pod = make_pod()
+        pod_dom = (
+            _exists()
+            if rng.random() < 0.5
+            else Requirement(tg.key, Operator.IN, rng.sample(hosts, rng.randint(1, 4)))
+        )
+        gate = HostAffinityGate(tg, pod_dom, tg.selects(pod))
+        for _ in range(20):
+            if rng.random() < 0.5:
+                tg.record(rng.choice(hosts))
+            h = rng.choice(hosts + ["h-new"])
+            node_row = Requirement(tg.key, Operator.IN, [h])
+            want = tg.get(pod, pod_dom, node_row).has(h)
+            assert gate.ok(h) == want
