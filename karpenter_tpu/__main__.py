@@ -44,6 +44,7 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             health_snapshot=operator.health_snapshot,
             trace_snapshot=operator.trace_snapshot,
             heap_stats=operator.heap_stats,
+            kernel_snapshot=operator.kernel_snapshot,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())

@@ -197,6 +197,18 @@ class Provisioner:
         ) as batch_span:
             try:
                 results = self.schedule(pending_since=pending_since)
+                if results is not None and not getattr(
+                    self, "_kernels_sealed", False
+                ):
+                    # the first EXECUTED solve closes the warmup window: its
+                    # residual shape-keyed compiles are the known cold start
+                    # (prewarm cannot prepay them — executables are keyed by
+                    # the batch's padded cube shape); any compile after this
+                    # is a steady-state recompile and trips the contract
+                    self._kernels_sealed = True
+                    from karpenter_tpu.observability import kernels as kobs
+
+                    kobs.registry().seal()
             except (SolverRejection, TransportError) as e:
                 # Shed/unreachable solver: degrade, don't crash the loop. The
                 # operator re-triggers every provisionable pod each pass, so
@@ -378,7 +390,19 @@ class Provisioner:
         encode cold cost (the multi-second part — see CatalogEngine.warmup)
         is paid before the first batch instead of inside the first
         scheduling pass. Idempotent and cheap once warm (engines are
-        content-cached; warmup is a flag check)."""
+        content-cached; warmup is a flag check).
+
+        Observability: the FIRST prewarm that obtains an engine runs under
+        a `solverd.prewarm` root span — its ~seconds of compiles used to be
+        invisible in /debug/traces — and registers the KernelRecompiled
+        event publisher on the kernel observatory. The span is emitted once
+        per Provisioner regardless of whether the content-cached engine was
+        already warm, so deterministic-mode span logs are a pure function
+        of the scenario, not of process history. The observatory SEAL
+        (reconcile) closes after the first executed solve, because warmup
+        deliberately does not prepay shape-keyed compiles — the first batch
+        pays the residual (see CatalogEngine.warmup); everything after it
+        is steady state and must not compile."""
         if self.engine_factory is None:
             return
         instance_types = self._gather_instance_types(
@@ -387,8 +411,45 @@ class Provisioner:
         if not instance_types:
             return
         engine = self.engine_factory(instance_types)
-        if engine is not None:
+        if engine is None:
+            return
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.tracing import kernel as ktime
+
+        if not getattr(self, "_prewarm_traced", False):
+            self._prewarm_traced = True
+            tracer = tracing.tracer()
+            with tracer.span(
+                "solverd.prewarm",
+                parent=None,
+                catalog_instances=engine.num_instances,
+            ) as span:
+                with ktime.measure() as kernels:
+                    engine.warmup()
+                span.set_volatile(
+                    wall_compile_s=round(kernels["compile_s"], 6),
+                    wall_execute_s=round(kernels["execute_s"], 6),
+                    kernel_dispatches=kernels["dispatches"],
+                    kernel_compiles=kernels["compiles"],
+                )
+        else:
             engine.warmup()
+        kobs.registry().on_recompile(self._on_kernel_recompiled, key="recorder")
+
+    def _on_kernel_recompiled(self, kernel: str, shape: str) -> None:
+        """The zero-recompile steady-state contract tripping: a kernel
+        compiled after the observatory was sealed post-prewarm."""
+        self.recorder.publish(
+            Event(
+                None,
+                "Warning",
+                "KernelRecompiled",
+                f"kernel {kernel} recompiled in steady state for shape "
+                f"bucket [{shape}] — the zero-recompile contract is "
+                "violated; check /debug/kernels for the bucket ladder",
+                dedupe_values=("kernel-recompile", kernel, shape),
+            )
+        )
 
     def schedule(self, pending_since: Optional[dict] = None) -> Optional[Results]:
         """provisioner.go:281-383."""

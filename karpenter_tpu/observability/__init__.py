@@ -1,0 +1,7 @@
+"""Device observability: the kernel observatory (kernels.py).
+
+Where tracing/ answers "where did this request's time go", this package
+answers "what is the device itself doing" — per-kernel compile/execute
+accounting, shape-bucket telemetry, device memory, and the zero-recompile
+steady-state contract.
+"""

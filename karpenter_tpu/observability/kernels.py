@@ -1,0 +1,432 @@
+"""The kernel observatory: per-kernel compile/memory accounting behind one
+instrumented-dispatch choke point.
+
+Every jitted entry point in the repo (the packer solve block, the
+feasibility cubes, the catalog row kernel — and their host twins and the
+topo count-tensor resyncs) reports into one process-global
+``KernelRegistry`` via ``tracing/kernel.dispatch(..., kernel=...)``. Per
+kernel it records: compile count and compile wall, execute wall, the
+padded input shape signature (the bucket key), jit-cache hit/miss, and a
+phase label — ``warmup`` until the registry is **sealed** post-prewarm,
+``steady`` after.
+
+The seal is the zero-recompile steady-state contract (ROADMAP item 2's
+measurement floor): any compile observed after ``seal()`` is a
+*recompile* — it increments ``karpenter_kernel_recompiles_total{kernel=}``
+and fires the registered callbacks (the provisioner publishes a
+``KernelRecompiled`` warning event), making "steady-state never
+recompiles" a machine-checked invariant instead of a hope.
+
+Determinism contract (same as tracing/): dispatch COUNTS per
+(kernel, shape bucket, phase) are pure functions of the scenario under
+the sim's pinned routing, so the sim's ``report["kernels"]`` is built from
+``counts_snapshot()`` deltas and digested; WALL measurements and compile
+counts are process history (a warm second run legitimately skips the
+compile a cold first run paid) and live only in the report's ``volatile``
+section and on ``/debug/kernels``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import threading
+from typing import Callable, Optional, Sequence
+
+from karpenter_tpu.metrics import global_registry
+
+_DISPATCHES = global_registry.counter(
+    "karpenter_kernel_dispatches_total",
+    "device kernel dispatches through the instrumented choke point",
+    labels=["kernel", "phase"],
+)
+_COMPILES = global_registry.counter(
+    "karpenter_kernel_compiles_total",
+    "XLA compiles per kernel (a dispatch that grew the jit cache)",
+    labels=["kernel", "phase"],
+)
+_RECOMPILES = global_registry.counter(
+    "karpenter_kernel_recompiles_total",
+    "compiles observed AFTER the registry was sealed post-prewarm — the "
+    "zero-recompile steady-state contract being violated",
+    labels=["kernel"],
+)
+_COMPILE_WALL = global_registry.histogram(
+    "karpenter_kernel_compile_seconds",
+    "wall time of compiling dispatches per kernel",
+    labels=["kernel"],
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+# per-shape-bucket execute latency: the data that chooses the AOT bucket
+# ladder (ROADMAP item 2) — which padded shapes run, how often, how slow
+_EXECUTE_WALL = global_registry.histogram(
+    "karpenter_kernel_execute_seconds",
+    "fenced execute wall time per kernel and padded-shape bucket",
+    labels=["kernel", "bucket"],
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5),
+)
+_LIVE_BYTES = global_registry.gauge(
+    "karpenter_device_live_array_bytes",
+    "total bytes of live jax arrays held by the process (engine matrices, "
+    "cached device uploads)",
+)
+_DEVICE_MEM = global_registry.gauge(
+    "karpenter_device_memory_bytes",
+    "per-device allocator stats (bytes_in_use / peak_bytes_in_use / "
+    "bytes_limit) where the backend reports them",
+    labels=["device", "stat"],
+)
+
+_PHASES = ("warmup", "steady", "host")
+
+
+class _Shape:
+    """Per-(kernel, padded-shape-bucket) accounting."""
+
+    __slots__ = ("dispatches", "compiles", "fenced", "execute_s", "max_s",
+                 "phases")
+
+    def __init__(self):
+        self.dispatches = 0
+        self.compiles = 0
+        self.fenced = 0  # dispatches whose execute wall was fence-measured
+        self.execute_s = 0.0
+        self.max_s = 0.0
+        self.phases = {"warmup": 0, "steady": 0, "host": 0}
+
+
+class _Kernel:
+    __slots__ = ("name", "dispatches", "compiles", "recompiles",
+                 "host_dispatches", "compile_s", "execute_s", "phases",
+                 "shapes")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.dispatches = 0
+        self.compiles = 0
+        self.recompiles = 0
+        self.host_dispatches = 0
+        self.compile_s = 0.0
+        self.execute_s = 0.0
+        self.phases = {"warmup": 0, "steady": 0}
+        self.shapes: dict[str, _Shape] = {}
+
+
+def shape_signature(args: Sequence) -> str:
+    """The padded input shape signature — the bucket key jit executables
+    are effectively keyed by. Array-shaped args contribute their dims;
+    everything else is ignored (static scalars don't select executables
+    for the repo's kernels)."""
+    dims = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            continue
+        dims.append("x".join(str(int(d)) for d in shape) or "1")
+    return ",".join(dims) or "scalar"
+
+
+class KernelRegistry:
+    """Process-global per-kernel accounting + the seal contract."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict[str, _Kernel] = {}
+        self._sealed = False
+        self._recompile_cbs: dict[str, Callable[[str, str], None]] = {}
+        self._recompile_events: list[dict] = []
+        self._last_memory: Optional[dict] = None
+
+    # -- phase / seal --------------------------------------------------------
+
+    @property
+    def sealed(self) -> bool:
+        return self._sealed
+
+    @property
+    def phase(self) -> str:
+        return "steady" if self._sealed else "warmup"
+
+    def seal(self) -> None:
+        """Close the warmup window: from here on every compile is a contract
+        violation. Idempotent — the provisioner calls it after every
+        prewarm pass."""
+        with self._lock:
+            self._sealed = True
+
+    def unseal(self) -> None:
+        """Reopen the warmup window (sim run start, daemon restart tests)."""
+        with self._lock:
+            self._sealed = False
+
+    def reset(self) -> None:
+        """Tests only: drop all records, callbacks, and the seal."""
+        with self._lock:
+            self._kernels.clear()
+            self._sealed = False
+            self._recompile_cbs.clear()
+            self._recompile_events.clear()
+            self._last_memory = None
+
+    def on_recompile(self, cb: Callable[[str, str], None], key: str = "default") -> None:
+        """Register a (kernel, shape) callback fired on post-seal compiles.
+        Keyed replace semantics: re-registration (a new Operator in the same
+        process) swaps the slot instead of accumulating dead callbacks."""
+        with self._lock:
+            self._recompile_cbs[key] = cb
+
+    # -- recording (called from tracing/kernel.dispatch) ---------------------
+
+    def record(
+        self, kernel: str, shape: str, seconds: float, compiled: bool,
+        fenced: bool,
+    ) -> None:
+        cbs: tuple = ()
+        recompiled = False
+        with self._lock:
+            k = self._kernels.get(kernel)
+            if k is None:
+                k = self._kernels[kernel] = _Kernel(kernel)
+            phase = "steady" if self._sealed else "warmup"
+            k.dispatches += 1
+            k.phases[phase] += 1
+            s = k.shapes.get(shape)
+            if s is None:
+                s = k.shapes[shape] = _Shape()
+            s.dispatches += 1
+            s.phases[phase] += 1
+            if compiled:
+                k.compiles += 1
+                k.compile_s += seconds
+                s.compiles += 1
+                if self._sealed:
+                    recompiled = True
+                    k.recompiles += 1
+                    self._recompile_events.append(
+                        {"kernel": kernel, "shape": shape}
+                    )
+                    del self._recompile_events[:-50]
+                    cbs = tuple(self._recompile_cbs.values())
+            elif fenced:
+                k.execute_s += seconds
+                s.fenced += 1
+                s.execute_s += seconds
+                s.max_s = max(s.max_s, seconds)
+        # metrics + callbacks outside the registry lock (they take their own)
+        _DISPATCHES.inc({"kernel": kernel, "phase": phase})
+        if compiled:
+            _COMPILES.inc({"kernel": kernel, "phase": phase})
+            _COMPILE_WALL.observe(seconds, {"kernel": kernel})
+            if recompiled:
+                _RECOMPILES.inc({"kernel": kernel})
+                for cb in cbs:
+                    try:
+                        cb(kernel, shape)
+                    except Exception:  # noqa: BLE001 — observers never break dispatch
+                        pass
+        elif fenced:
+            _EXECUTE_WALL.observe(seconds, {"kernel": kernel, "bucket": shape})
+
+    def record_host(self, kernel: str, shape: str) -> None:
+        """A host-twin run of a device-parity kernel (small cube under the
+        RTT threshold): counted so shape-bucket telemetry covers BOTH sides
+        of the routing decision; host twins never compile."""
+        with self._lock:
+            k = self._kernels.get(kernel)
+            if k is None:
+                k = self._kernels[kernel] = _Kernel(kernel)
+            k.host_dispatches += 1
+            s = k.shapes.get(shape)
+            if s is None:
+                s = k.shapes[shape] = _Shape()
+            s.phases["host"] += 1
+        _DISPATCHES.inc({"kernel": kernel, "phase": "host"})
+
+    def steady_recompiles(self) -> int:
+        with self._lock:
+            return sum(k.recompiles for k in self._kernels.values())
+
+    # -- snapshots -----------------------------------------------------------
+
+    def counts_snapshot(self) -> dict:
+        """The DETERMINISTIC counts: per (kernel, shape bucket) dispatch
+        counts by phase, plus recompiles. Everything here is a pure function
+        of the dispatched work (no walls, no jit-cache history), so two
+        same-seed sim runs produce identical deltas."""
+        with self._lock:
+            return {
+                name: {
+                    "shapes": {
+                        shape: dict(s.phases)
+                        for shape, s in k.shapes.items()
+                    },
+                    "recompiles": k.recompiles,
+                }
+                for name, k in self._kernels.items()
+            }
+
+    def report(self, baseline: dict) -> dict:
+        """The sim's ``report["kernels"]`` section: the counts delta since
+        `baseline` (a prior counts_snapshot), digested. ONLY deterministic
+        facts appear — wall splits and jit-cache compile counts are process
+        history (a warm process legitimately skips a cold one's compiles)
+        and live on /debug/kernels instead, the same split the sim applies
+        to solverd's last_batch_seconds."""
+        now = self.counts_snapshot()
+        kernels_out: dict[str, dict] = {}
+        recompiles = 0
+        for name in sorted(now):
+            cur = now[name]
+            base = baseline.get(name, {})
+            base_shapes = base.get("shapes", {})
+            shapes_out: dict[str, dict] = {}
+            totals = {ph: 0 for ph in _PHASES}
+            for shape in sorted(cur["shapes"]):
+                b = base_shapes.get(shape, {})
+                delta = {
+                    ph: cur["shapes"][shape][ph] - b.get(ph, 0)
+                    for ph in _PHASES
+                }
+                if any(delta.values()):
+                    shapes_out[shape] = {
+                        ph: v for ph, v in delta.items() if v
+                    }
+                    for ph, v in delta.items():
+                        totals[ph] += v
+            if shapes_out:
+                kernels_out[name] = {
+                    "dispatches": totals["warmup"] + totals["steady"],
+                    "host_dispatches": totals["host"],
+                    "phases": {
+                        "warmup": totals["warmup"],
+                        "steady": totals["steady"],
+                    },
+                    "shapes": shapes_out,
+                }
+            recompiles += cur["recompiles"] - base.get("recompiles", 0)
+        deterministic = {
+            "kernels": kernels_out,
+            "steady_recompiles": recompiles,
+        }
+        digest = hashlib.sha256(
+            json.dumps(deterministic, sort_keys=True).encode()
+        ).hexdigest()
+        out = dict(deterministic)
+        out["digest"] = digest
+        return out
+
+    def debug_snapshot(self, kernel: Optional[str] = None) -> Optional[dict]:
+        """/debug/kernels: the per-kernel table, or a single kernel's
+        per-shape drill-down (None for an unknown kernel → 404)."""
+        with self._lock:
+            if kernel is not None:
+                k = self._kernels.get(kernel)
+                if k is None:
+                    return None
+                shapes = [
+                    {
+                        "shape": shape,
+                        "dispatches": s.dispatches,
+                        "compiles": s.compiles,
+                        "phases": dict(s.phases),
+                        "execute_wall_s": round(s.execute_s, 6),
+                        "mean_execute_s": round(s.execute_s / s.fenced, 6)
+                        if s.fenced
+                        else None,
+                        "max_execute_s": round(s.max_s, 6),
+                    }
+                    for shape, s in k.shapes.items()
+                ]
+                # slowest buckets first: this ordering IS the AOT-ladder view
+                shapes.sort(key=lambda d: (-(d["max_execute_s"] or 0.0), d["shape"]))
+                return {
+                    "kernel": k.name,
+                    "dispatches": k.dispatches,
+                    "host_dispatches": k.host_dispatches,
+                    "compiles": k.compiles,
+                    "cache_hits": k.dispatches - k.compiles,
+                    "recompiles": k.recompiles,
+                    "phases": dict(k.phases),
+                    "compile_wall_s": round(k.compile_s, 6),
+                    "execute_wall_s": round(k.execute_s, 6),
+                    "shapes": shapes,
+                }
+            table = [
+                {
+                    "kernel": k.name,
+                    "dispatches": k.dispatches,
+                    "host_dispatches": k.host_dispatches,
+                    "compiles": k.compiles,
+                    "cache_hits": k.dispatches - k.compiles,
+                    "recompiles": k.recompiles,
+                    "phases": dict(k.phases),
+                    "compile_wall_s": round(k.compile_s, 6),
+                    "execute_wall_s": round(k.execute_s, 6),
+                    "shapes_seen": len(k.shapes),
+                }
+                for k in self._kernels.values()
+            ]
+            table.sort(key=lambda d: (-d["execute_wall_s"], d["kernel"]))
+            return {
+                "sealed": self._sealed,
+                "phase": self.phase,
+                "steady_recompiles": sum(
+                    k.recompiles for k in self._kernels.values()
+                ),
+                "recompile_events": list(self._recompile_events),
+                "device_memory": self._last_memory,
+                "kernels": table,
+            }
+
+
+_REGISTRY = KernelRegistry()
+
+
+def registry() -> KernelRegistry:
+    return _REGISTRY
+
+
+def sample_device_memory() -> dict:
+    """Live-array bytes + per-device allocator stats, pushed into the
+    gauges and cached on the registry for /debug/kernels. Sampled after
+    each solve batch (solverd/service.py) and per solve span
+    (solverd/coalescer.py). A no-op shell when jax was never imported —
+    telemetry must not be the thing that pays backend init."""
+    out: dict = {"live_array_bytes": 0, "live_arrays": 0, "devices": []}
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            total = count = 0
+            for a in jax.live_arrays():
+                try:
+                    total += int(a.nbytes)
+                except Exception:  # noqa: BLE001 — deleted/donated buffers
+                    continue
+                count += 1
+            out["live_array_bytes"] = total
+            out["live_arrays"] = count
+            _LIVE_BYTES.set(float(total))
+            for d in jax.devices():
+                try:
+                    stats = d.memory_stats()
+                except Exception:  # noqa: BLE001 — backend without stats
+                    stats = None
+                if not stats:
+                    continue
+                entry: dict = {"device": str(d)}
+                for stat in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                    if stat in stats:
+                        entry[stat] = int(stats[stat])
+                        _DEVICE_MEM.set(
+                            float(stats[stat]),
+                            {"device": str(d), "stat": stat},
+                        )
+                out["devices"].append(entry)
+        except Exception:  # noqa: BLE001 — sampling must never break a solve
+            pass
+    with _REGISTRY._lock:
+        _REGISTRY._last_memory = out
+    return out

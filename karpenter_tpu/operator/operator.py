@@ -427,6 +427,15 @@ class Operator:
         """solverd introspection for /debug/solverd (operator/serving.py)."""
         return self.provisioner.solver.stats()
 
+    def kernel_snapshot(self, kernel: Optional[str] = None) -> Optional[dict]:
+        """/debug/kernels (operator/serving.py): the kernel observatory's
+        per-kernel table (compile/execute split, shapes seen, phase counts,
+        recompiles, last device-memory sample), or a single kernel's
+        per-shape-bucket drill-down. None => unknown kernel (404)."""
+        from karpenter_tpu.observability import kernels as kobs
+
+        return kobs.registry().debug_snapshot(kernel)
+
     def trace_snapshot(
         self,
         trace_id: Optional[str] = None,

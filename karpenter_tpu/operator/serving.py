@@ -30,6 +30,7 @@ class ServingConfig:
         health_snapshot: Optional[Callable[[], dict]] = None,
         trace_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         heap_stats: Optional[Callable[[], dict]] = None,
+        kernel_snapshot: Optional[Callable[..., Optional[dict]]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -50,6 +51,10 @@ class ServingConfig:
         # the last-N traces, ?trace_id= drill-down (404 when unknown), and
         # ?view=slowest for the slowest pod journeys
         self.trace_snapshot = trace_snapshot
+        # kernel observatory (operator.kernel_snapshot): /debug/kernels
+        # serves the per-kernel compile/execute table, ?kernel= drill-down
+        # into per-shape-bucket stats (404 when unknown); unwired => 404
+        self.kernel_snapshot = kernel_snapshot
 
 
 def _profile_sample(seconds: float, interval: float = 0.01) -> str:
@@ -218,6 +223,18 @@ class _Handler(BaseHTTPRequestHandler):
                 if snap is None:
                     self._respond(
                         404, json.dumps({"error": "unknown trace_id"}),
+                        "application/json",
+                    )
+                else:
+                    self._respond(200, json.dumps(snap), "application/json")
+            elif url.path == "/debug/kernels" and cfg.kernel_snapshot is not None:
+                import json
+
+                q = parse_qs(url.query)
+                snap = cfg.kernel_snapshot(kernel=q.get("kernel", [None])[0])
+                if snap is None:
+                    self._respond(
+                        404, json.dumps({"error": "unknown kernel"}),
                         "application/json",
                     )
                 else:

@@ -23,6 +23,7 @@ import numpy as np
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.observability import kernels as kobs
 from karpenter_tpu.ops import encoding as enc
 from karpenter_tpu.ops import feasibility as feas
 from karpenter_tpu.tracing import kernel as ktime
@@ -46,6 +47,13 @@ def _req_cache_key(r: Requirement) -> tuple:
 
 _RTT_CACHE: dict[str, float] = {}
 
+# Deterministic-routing override: when set, device_rtt_s returns this value
+# instead of measuring, so the host-vs-device dispatch decision becomes a
+# pure function of cube sizes. The simulator pins it (sim/harness.py) so
+# same-seed runs — and CI runs on different machines — route identically
+# and report["kernels"] dispatch counts stay byte-deterministic.
+PINNED_RTT: Optional[float] = None
+
 
 def device_rtt_s() -> float:
     """Measured round-trip latency of one tiny dispatch+fetch on the default
@@ -57,6 +65,8 @@ def device_rtt_s() -> float:
     chip an RTT can be ~100 ms and small cubes must take the exact host twin
     instead. Measuring beats guessing — the same binary runs in both worlds.
     """
+    if PINNED_RTT is not None:
+        return PINNED_RTT
     import jax
 
     try:
@@ -87,6 +97,15 @@ _HOST_ROW_CELLS_PER_S = 0.5e9
 
 # "device" / "host" pin the dispatch for tests and benchmarks; None = adaptive.
 FORCE_BACKEND: Optional[str] = None
+
+# Row batches below this stay on the exact numpy twin REGARDLESS of the RTT
+# cost model. The row kernel's inputs are unpadded — the row count and the
+# set tables' word capacity vary — so small steady-state dispatches (joint
+# requirement rows interned a few per claim family) would compile a fresh
+# executable per novel shape, violating the kernel observatory's
+# zero-recompile steady-state contract for a ~ms win. Only bulk encodes
+# (catalog bootstrap) amortize a compile.
+DEVICE_MIN_ROW_BATCH = 32
 
 
 def _use_device(host_cells: float, cells_per_s: float) -> bool:
@@ -313,9 +332,23 @@ class CatalogEngine:
         host_cells = (
             len(new_rows) * (self.num_instances + self.num_offerings) * max(slots, 1)
         )
-        on_device = _use_device(host_cells, _HOST_ROW_CELLS_PER_S)
+        # FORCE_BACKEND="device" (the test/bench pin) must still reach the
+        # device row kernel for small batches — only adaptive routing gates
+        # on the batch size
+        on_device = (
+            len(new_rows) >= DEVICE_MIN_ROW_BATCH or FORCE_BACKEND == "device"
+        ) and _use_device(host_cells, _HOST_ROW_CELLS_PER_S)
         cast = jnp.asarray if on_device else np.asarray
-        kernel = feas.req_rows_vs_sets if on_device else feas.req_rows_vs_sets_np
+        if on_device:
+            kernel = lambda *a: ktime.dispatch(  # noqa: E731 — dispatch shim
+                feas.req_rows_vs_sets, *a, kernel="catalog.row_compat"
+            )
+        else:
+            kernel = feas.req_rows_vs_sets_np
+            kobs.registry().record_host(
+                "catalog.row_compat",
+                f"{len(new_rows)}r,{self.num_instances}i,{self.num_offerings}o",
+            )
         row_args = (
             cast(er.key),
             cast(er.complement),
@@ -524,8 +557,11 @@ class CatalogEngine:
         if on_device:
             if self.num_offerings == 0:
                 compat = np.asarray(
-                    feas.membership_all(
-                        jnp.asarray(membership), jnp.asarray(req_compat_h)
+                    ktime.dispatch(
+                        feas.membership_all,
+                        jnp.asarray(membership),
+                        jnp.asarray(req_compat_h),
+                        kernel="feasibility.membership",
                     )
                 )[:P]
                 return Feasibility(
@@ -551,6 +587,7 @@ class CatalogEngine:
                     key_present_p,
                     self._mesh_dev("available", self.offering_available),
                     self._mesh_dev("owner_onehot", self._owner_onehot),
+                    kernel="feasibility.cube_sharded",
                 )
             else:
                 compat_d, offering_d = ktime.dispatch(
@@ -562,16 +599,39 @@ class CatalogEngine:
                     jnp.asarray(key_present_p),
                     self._dev("available", self.offering_available),
                     self._dev("owner_onehot", self._owner_onehot),
+                    kernel="feasibility.cube",
                 )
             return Feasibility(
                 np.asarray(compat_d)[:P], fits, np.asarray(offering_d)[:P]
             )
 
+        # host-twin records mirror the device kernel they stand in for, with
+        # the SAME bucket key the device dispatch would produce, so the
+        # /debug/kernels drill-down shows both sides of the routing decision
+        # under one shape bucket
         compat = feas.membership_all_np(membership, req_compat_h)[:P]
         if self.num_offerings == 0:
+            kobs.registry().record_host(
+                "feasibility.membership",
+                kobs.shape_signature((membership, req_compat_h)),
+            )
             return Feasibility(
                 compat, fits, np.zeros((P, self.num_instances), dtype=bool)
             )
+        kobs.registry().record_host(
+            "feasibility.cube",
+            kobs.shape_signature(
+                (
+                    membership,
+                    req_compat_h,
+                    offer_compat_h,
+                    self.offering_custom_need,
+                    key_present_p,
+                    self.offering_available,
+                    self._owner_onehot,
+                )
+            ),
+        )
         has_offering = feas.offering_reduce_np(
             membership,
             offer_compat_h,

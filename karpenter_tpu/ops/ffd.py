@@ -129,6 +129,37 @@ def solver_cache_counters() -> dict:
     out.update(topo_counts.gate_counters())
     return out
 
+
+# /metrics mirror of solver_cache_counters: the module-global ints above are
+# span-visible only (volatile solve attrs); operators alerting on e.g. the
+# affinity self-seed host-delegation path regressing need topo_oracle_calls
+# as a scrapeable counter. publish_cache_counters() diffs the cumulative
+# snapshot against the last published values and inc()s the delta — called
+# after every solverd batch (solverd/service.run_pending), so the series
+# lag a batch at most.
+_CACHE_EVENTS_CTR = global_registry.counter(
+    "karpenter_solver_cache_events_total",
+    "cumulative solver cache/dispatch/delegation events "
+    "(ffd.solver_cache_counters: joint/pack cache hits+misses, joint "
+    "sweeps, device solves/fallbacks, topo gate evals/refreshes, "
+    "topo_oracle_calls, tensor resyncs)",
+    labels=["event"],
+)
+_published_cache_counters: dict[str, int] = {}
+
+
+def publish_cache_counters() -> dict:
+    """Mirror the cumulative solver cache counters onto /metrics; returns
+    the snapshot it published."""
+    snap = solver_cache_counters()
+    for name, value in snap.items():
+        prev = _published_cache_counters.get(name, 0)
+        if value > prev:
+            _CACHE_EVENTS_CTR.inc({"event": name}, value - prev)
+            _published_cache_counters[name] = value
+    return snap
+
+
 # Tests set this to make simulation bugs fail loudly instead of silently
 # falling back to the host loop.
 STRICT = False

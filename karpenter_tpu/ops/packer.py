@@ -177,7 +177,14 @@ class GroupSolver:
         Dispatch goes through the kernel timer so the solve span can split
         wall time into compile vs execute (tracing/kernel.py)."""
         args = self._catalog_args()
-        out = np.asarray(ktime.dispatch(solve_block_jit, *_pack_groups(grouped), *args))
+        out = np.asarray(
+            ktime.dispatch(
+                solve_block_jit,
+                *_pack_groups(grouped),
+                *args,
+                kernel="packer.solve_block",
+            )
+        )
         return out[:, 0], out[:, 1].astype(bool), out[:, 2], out[:, 3]
 
     def solve_sharded(self, grouped: GroupedPods, mesh: Mesh, axis: str = "pods"):
@@ -213,7 +220,9 @@ class GroupSolver:
             jax.device_put(group_bools, sharding),
             jax.device_put(group_ints, sharding),
         ] + [jax.device_put(np.asarray(a), rep) for a in catalog_args]
-        out = np.asarray(ktime.dispatch(fn, *dev_args))
+        out = np.asarray(
+            ktime.dispatch(fn, *dev_args, kernel="packer.solve_block_sharded")
+        )
         return (
             out[:G, 0],
             out[:G, 1].astype(bool),

@@ -188,6 +188,13 @@ class Simulation:
             "device_solves": ffd.DEVICE_SOLVES,
             "device_fallbacks": ffd.DEVICE_FALLBACKS,
         }
+        # kernel observatory: same delta discipline — report["kernels"] is
+        # built from a counts_snapshot taken at run start (run() also
+        # unseals, so this run's prewarm/first-batch dispatches land in the
+        # warmup phase exactly like a cold process's would)
+        from karpenter_tpu.observability import kernels as kobs
+
+        self._kernels_base = kobs.registry().counts_snapshot()
         self._victim_rng = Random(f"{seed}:victims")
         self._groups: dict[str, _Group] = {}
         self._known_nodes: set[str] = set()
@@ -204,12 +211,40 @@ class Simulation:
 
     # -- the loop ------------------------------------------------------------
 
+    # Pinned device RTT for _use_device routing (ops/catalog.device_rtt_s):
+    # the measured RTT is wall-clock and machine-dependent, so borderline
+    # cubes could route host on one run and device on the next — and
+    # report["kernels"] dispatch counts would not be a pure function of
+    # (scenario, seed). 100µs sits at the co-located-chip scale: small
+    # cubes keep the exact host twins, large cubes keep the device.
+    PINNED_RTT_S = 100e-6
+
     def run(self) -> SimResult:
         end = self.t0 + float(self.trace["duration"])
         tick = float(self.trace.get("tick", 1.0))
         events = list(self.trace["events"])
         apicore.set_uid_source(Random(f"{self.seed}:uids"))
         self.clock.enable_blocking_sleep()
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.ops import catalog as catmod
+
+        # fresh-run kernel phases: the run's prewarm + first batch land in
+        # "warmup" (the provisioner re-seals after its first solve), so two
+        # same-seed runs — in CI, two cold processes — report identical
+        # phase splits
+        kobs.registry().unseal()
+        # hermetic engines: a content-cached engine from an earlier sim in
+        # this process would already be warm and already hold interned rows
+        # and joint masks, so its warmup/row-kernel dispatches would not
+        # repeat and report["kernels"] would depend on process history. A
+        # run always builds (and re-warms) its engines from scratch; the
+        # jit executable cache stays warm, which only affects walls — never
+        # deterministic counts.
+        from karpenter_tpu.controllers.provisioning import provisioner as provmod
+
+        provmod._ENGINE_CONTENT_CACHE.clear()
+        pinned_prev = catmod.PINNED_RTT
+        catmod.PINNED_RTT = self.PINNED_RTT_S
         try:
             for np_spec in self.trace.get("nodepools", [{"name": "workers"}]):
                 self.store.create(self._nodepool(np_spec))
@@ -251,9 +286,15 @@ class Simulation:
                 "spans": self.tracer.digest.count,
                 "journeys": self.tracer.journeys.stats(),
             }
+            # the kernel observatory section: per-(kernel, shape bucket,
+            # phase) dispatch count deltas + steady recompiles, digested —
+            # byte-deterministic across same-seed runs under the pinned RTT;
+            # walls and compile counts ride in its volatile appendix
+            report["kernels"] = kobs.registry().report(self._kernels_base)
             self.tracer.close()  # flush the JSONL export, if any
             return SimResult(report=report, digest=self.log.digest(), log=self.log)
         finally:
+            catmod.PINNED_RTT = pinned_prev
             apicore.set_uid_source(None)
             self.clock.disable_blocking_sleep()
 

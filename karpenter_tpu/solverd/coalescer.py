@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry, measure
+from karpenter_tpu.observability import kernels as kobs
 from karpenter_tpu.tracing import kernel as ktime
 
 _SOLVE_LATENCY = global_registry.histogram(
@@ -55,6 +56,11 @@ class Coalescer:
 
         self._prime(entries)
         tracer = tracing.tracer()
+        # one device-memory sample per BATCH, taken lazily at the first
+        # sampled solve and shared by every span in it: the live-array set
+        # moves per batch (requests share the engine), and jax.live_arrays
+        # is an O(live arrays) enumeration that must not run per request
+        mem_live: list = []
         for entry in entries:
             req = entry.request
             ctx = tracer.context_from(getattr(req, "trace_context", None))
@@ -68,6 +74,8 @@ class Coalescer:
                     self._solve_one(entry)
                     continue
                 base = ffd.solver_cache_counters()
+                reg = kobs.registry()
+                recompiles_base = reg.steady_recompiles()
                 with ktime.measure() as kernels:
                     err = self._solve_one(entry)
                     if err is not None:
@@ -76,11 +84,17 @@ class Coalescer:
                     name: value - base[name]
                     for name, value in ffd.solver_cache_counters().items()
                 }
+                if not mem_live:
+                    mem_live.append(
+                        kobs.sample_device_memory()["live_array_bytes"]
+                    )
                 span.set_volatile(
                     wall_compile_s=round(kernels["compile_s"], 6),
                     wall_execute_s=round(kernels["execute_s"], 6),
                     kernel_dispatches=kernels["dispatches"],
                     kernel_compiles=kernels["compiles"],
+                    kernel_recompiles=reg.steady_recompiles() - recompiles_base,
+                    device_live_array_bytes=mem_live[0],
                     **delta,
                 )
 

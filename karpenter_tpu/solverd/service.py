@@ -191,6 +191,17 @@ class SolverService:
         with self._stats_lock:
             self.executed += len(ready)
             self.last_batch_seconds = time.perf_counter() - started
+        # post-batch telemetry: device memory gauges (live-array bytes +
+        # per-device allocator stats) and the solver cache counters mirrored
+        # onto /metrics — both best-effort, never failing the batch
+        try:
+            from karpenter_tpu.observability import kernels as kobs
+            from karpenter_tpu.ops import ffd
+
+            kobs.sample_device_memory()
+            ffd.publish_cache_counters()
+        except Exception:  # noqa: BLE001 — telemetry must not fail solves
+            pass
         return len(ready)
 
     def close(self) -> None:

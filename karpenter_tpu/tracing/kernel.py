@@ -1,4 +1,5 @@
-"""Kernel wall-time attribution: compile vs execute, per solve.
+"""Kernel wall-time attribution: compile vs execute, per solve — and the
+instrumented-dispatch choke point feeding the kernel observatory.
 
 The solve span wants to answer "was this solve slow because XLA compiled a
 new executable, or because the device executed a big cube?" — the split
@@ -14,6 +15,19 @@ nested dispatches attribute to the request that triggered them and
 concurrent daemon threads never mix accounts. All numbers here are
 wall-clock — span code must record them as VOLATILE attrs, never in the
 deterministic digest.
+
+Nesting: a fenced dispatch whose callable itself dispatches (a host driver
+wrapping an inner kernel) attributes wall time to the INNERMOST dispatch
+only — each frame subtracts its children's elapsed time before recording,
+so the measure() totals and the registry's per-kernel walls never double
+count one second of device work.
+
+Named dispatches (``kernel="packer.solve_block"``) additionally report to
+``observability/kernels.KernelRegistry``: compile counts, the padded input
+shape signature, and the warmup/steady phase label — recorded even OUTSIDE
+a measurement context (prewarm compiles must be attributed), but fenced
+only when a context is open or a compile happened, so tracing-off hot
+paths keep their async dispatch pipeline.
 """
 
 from __future__ import annotations
@@ -23,8 +37,15 @@ import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
+from karpenter_tpu.observability import kernels as kobs
+
 _ACC: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
     "karpenter_kernel_acc", default=None
+)
+# per-thread-of-control dispatch nesting stack: each frame is a one-cell
+# list accumulating its CHILDREN's elapsed seconds (see dispatch)
+_NEST: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
+    "karpenter_kernel_nest", default=None
 )
 
 
@@ -50,29 +71,54 @@ def _cache_size(fn) -> Optional[int]:
         return None
 
 
-def dispatch(fn, *args):
+def dispatch(fn, *args, kernel: Optional[str] = None):
     """Call a jitted function, block until its outputs are ready, and
     attribute the wall time to compile or execute. Transparent (returns the
-    outputs) and free when no measurement context is open."""
+    outputs) and free when no measurement context is open and no kernel
+    name is given."""
     acc = _ACC.get()
-    if acc is None:
+    if acc is None and kernel is None:
         return fn(*args)
+    stack = _NEST.get()
+    if stack is None:
+        stack = []
+        _NEST.set(stack)
     before = _cache_size(fn)
+    cell = [0.0]  # children's elapsed accumulates here
+    stack.append(cell)
     t0 = time.perf_counter()
-    out = fn(*args)
     try:
-        import jax
+        out = fn(*args)
+        after = _cache_size(fn)
+        compiled = before is not None and after is not None and after > before
+        # fence when a measurement context wants exact execute wall, or when
+        # a compile happened (compile wall must be exact for the registry's
+        # recompile accounting; compiles are rare so the fence is free)
+        fenced = acc is not None or compiled
+        if fenced:
+            try:
+                import jax
 
-        jax.block_until_ready(out)
-    except Exception:  # noqa: BLE001 — host twins return plain numpy
-        pass
-    elapsed = time.perf_counter() - t0
-    after = _cache_size(fn)
-    compiled = before is not None and after is not None and after > before
-    acc["dispatches"] += 1
-    if compiled:
-        acc["compiles"] += 1
-        acc["compile_s"] += elapsed
-    else:
-        acc["execute_s"] += elapsed
+                jax.block_until_ready(out)
+            except Exception:  # noqa: BLE001 — host twins return plain numpy
+                pass
+    finally:
+        elapsed = time.perf_counter() - t0
+        stack.pop()
+    # innermost-only attribution: subtract the children's wall, credit the
+    # parent frame with our FULL elapsed so it subtracts us in turn
+    self_s = max(0.0, elapsed - cell[0])
+    if stack:
+        stack[-1][0] += elapsed
+    if acc is not None:
+        acc["dispatches"] += 1
+        if compiled:
+            acc["compiles"] += 1
+            acc["compile_s"] += self_s
+        else:
+            acc["execute_s"] += self_s
+    if kernel is not None:
+        kobs.registry().record(
+            kernel, kobs.shape_signature(args), self_s, compiled, fenced
+        )
     return out

@@ -137,6 +137,89 @@ class TestRoundTrip:
         fam = parse_exposition(reg.expose())
         assert fam["karpenter_x_total"]["help"] == "first line\nsecond \\ line"
 
+    def test_kernel_observatory_families_round_trip(self):
+        """The kernel observatory's counters/gauges/histograms on the REAL
+        global registry: dispatch/compile counters labelled by kernel+phase,
+        the per-shape-bucket execute histogram (bucket label values carry
+        commas and x's — they must survive the quote/escape round trip,
+        including an escape-worthy synthetic bucket), the recompile counter,
+        and the device-memory gauges."""
+        import jax
+        import jax.numpy as jnp
+
+        from karpenter_tpu.metrics import global_registry
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.tracing import kernel as ktime
+
+        reg = kobs.registry()
+        reg.reset()
+        try:
+
+            @jax.jit
+            def f(x):
+                return x * 2.0
+
+            with ktime.measure():  # fenced → execute histogram observes
+                ktime.dispatch(f, jnp.ones((4, 2)), kernel="expo.k")
+                ktime.dispatch(f, jnp.ones((4, 2)), kernel="expo.k")
+            reg.seal()
+            with ktime.measure():
+                ktime.dispatch(f, jnp.ones((5, 2)), kernel="expo.k")  # recompile
+            kobs.sample_device_memory()
+            # a pathological bucket value exercises label escaping on the
+            # same family production shapes flow through
+            global_registry.get("karpenter_kernel_execute_seconds").observe(
+                0.001, {"kernel": "expo.k", "bucket": 'odd"\\bucket'}
+            )
+            fam = parse_exposition(global_registry.expose())
+
+            disp = fam["karpenter_kernel_dispatches_total"]
+            assert disp["type"] == "counter"
+            key = tuple(sorted((("kernel", "expo.k"), ("phase", "warmup"))))
+            assert disp["samples"][
+                ("karpenter_kernel_dispatches_total", key)
+            ] == 2.0
+            steady = tuple(sorted((("kernel", "expo.k"), ("phase", "steady"))))
+            assert disp["samples"][
+                ("karpenter_kernel_dispatches_total", steady)
+            ] == 1.0
+
+            rec = fam["karpenter_kernel_recompiles_total"]
+            assert rec["samples"][
+                ("karpenter_kernel_recompiles_total", (("kernel", "expo.k"),))
+            ] == 1.0
+
+            execute = fam["karpenter_kernel_execute_seconds"]
+            assert execute["type"] == "histogram"
+            shape_key = tuple(
+                sorted((("bucket", "4x2"), ("kernel", "expo.k"), ("le", "+Inf")))
+            )
+            inf = execute["samples"][
+                ("karpenter_kernel_execute_seconds_bucket", shape_key)
+            ]
+            count = execute["samples"][
+                ("karpenter_kernel_execute_seconds_count",
+                 tuple(sorted((("bucket", "4x2"), ("kernel", "expo.k")))))
+            ]
+            assert inf == count >= 1.0  # at least the warm dispatch
+            # the escaped synthetic bucket value round-trips intact
+            nasty = tuple(
+                sorted(
+                    (("bucket", 'odd"\\bucket'), ("kernel", "expo.k"))
+                )
+            )
+            assert execute["samples"][
+                ("karpenter_kernel_execute_seconds_count", nasty)
+            ] == 1.0
+
+            gauge = fam["karpenter_device_live_array_bytes"]
+            assert gauge["type"] == "gauge"
+            assert gauge["samples"][
+                ("karpenter_device_live_array_bytes", ())
+            ] >= 0.0
+        finally:
+            reg.reset()
+
     def test_every_emitted_line_is_parseable(self):
         """Feed the REAL global registry (whatever tests before us
         registered) through the parser: conformance must hold for the

@@ -10,7 +10,10 @@ import pytest
 from karpenter_tpu.operator.serving import Server, ServingConfig
 
 
-def make_server(enable_profiling=False, solverd_stats=None, heap_stats=None):
+def make_server(
+    enable_profiling=False, solverd_stats=None, heap_stats=None,
+    kernel_snapshot=None,
+):
     cfg = ServingConfig(
         metrics_text=lambda: "karpenter_test_metric 1\n",
         healthy=lambda: True,
@@ -18,6 +21,7 @@ def make_server(enable_profiling=False, solverd_stats=None, heap_stats=None):
         enable_profiling=enable_profiling,
         solverd_stats=solverd_stats,
         heap_stats=heap_stats,
+        kernel_snapshot=kernel_snapshot,
     )
     return Server(0, cfg, host="127.0.0.1").start()
 
@@ -168,6 +172,79 @@ class TestHeapEndpoint:
             "engine_fam_transition_cache",
         ):
             assert isinstance(stats[key], int)
+
+
+class TestKernelsEndpoint:
+    """/debug/kernels: the kernel observatory table, ?kernel= drill-down,
+    404 for unknown kernels, and the unwired (profiling-off style) 404."""
+
+    def _registry_snapshot(self):
+        from karpenter_tpu.observability import kernels as kobs
+
+        reg = kobs.registry()
+        reg.reset()
+        reg.record_host("spec.kernel", "8x4")
+        return reg, reg.debug_snapshot
+
+    def test_table_and_drilldown(self):
+        reg, snapshot = self._registry_snapshot()
+        server = make_server(kernel_snapshot=snapshot)
+        try:
+            code, body = get(server, "/debug/kernels")
+            assert code == 200
+            table = json.loads(body)
+            assert table["sealed"] is False
+            assert any(
+                row["kernel"] == "spec.kernel" for row in table["kernels"]
+            )
+            code, body = get(server, "/debug/kernels?kernel=spec.kernel")
+            assert code == 200
+            drill = json.loads(body)
+            assert drill["kernel"] == "spec.kernel"
+            assert drill["shapes"][0]["shape"] == "8x4"
+        finally:
+            server.stop()
+            reg.reset()
+
+    def test_unknown_kernel_404(self):
+        reg, snapshot = self._registry_snapshot()
+        server = make_server(kernel_snapshot=snapshot)
+        try:
+            code, body = get(server, "/debug/kernels?kernel=missing")
+            assert code == 404
+            assert "unknown kernel" in body
+        finally:
+            server.stop()
+            reg.reset()
+
+    def test_unwired_404(self, plain_server):
+        code, body = get(plain_server, "/debug/kernels")
+        assert code == 404
+        assert "not found" in body
+
+    def test_from_operator(self):
+        """End-to-end: the operator's kernel_snapshot callable serves the
+        real registry through the endpoint."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        kobs.registry().record_host("spec.operator", "2x2")
+        clock = FakeClock()
+        operator = Operator(Store(clock=clock), FakeCloudProvider(), clock=clock)
+        server = make_server(kernel_snapshot=operator.kernel_snapshot)
+        try:
+            code, body = get(server, "/debug/kernels")
+            assert code == 200
+            snap = json.loads(body)
+            assert {"sealed", "phase", "steady_recompiles", "kernels"} <= set(snap)
+            assert any(
+                row["kernel"] == "spec.operator" for row in snap["kernels"]
+            )
+        finally:
+            server.stop()
 
 
 class TestSolverdEndpoint:
