@@ -1,0 +1,341 @@
+"""AOT compiler: walk the bucket ladder at boot, load or compile each
+executable, persist fresh compiles to the on-disk cache.
+
+``warm_start(engine)`` is the managed replacement for the lazy
+``CatalogEngine.warmup()`` cold path: it attaches the active ladder to the
+engine (so runtime dispatches pad to ladder buckets), stabilizes the
+vocabulary's key capacity (pre-interning the well-known label keys pods
+constrain with, so the padded key axis at boot equals the steady-state
+one), then for every (kernel, bucket) in the ladder either
+
+- loads a serialized executable from the persistent cache
+  (``deserialize_and_load`` — milliseconds), or
+- compiles it ahead of time (``jit(...).lower(*abstract).compile()``) and
+  serializes it into the cache for the next boot,
+
+installing each into the runtime executable table that
+``tracing/kernel.dispatch`` consults. Every bucket is recorded into the
+kernel observatory under the ``aot-warm`` phase, with ``compiled=True``
+only for fresh compiles — which is exactly what the warm-boot perf floor
+asserts is zero on a second boot against a warm cache.
+
+Cache keys embed the catalog content hash (the same fingerprint solverd
+content-addresses engines by), the jax/jaxlib versions, the backend +
+device kind, the kernel, the bucket signature, and the ladder version —
+any mismatch is a miss, so a version bump or a device swap can never load
+a stale executable. Corrupt entries evict and fall back to a fresh
+compile; nothing in this path is allowed to crash a boot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.observability import kernels as kobs
+from karpenter_tpu.operator import logging as klog
+
+from karpenter_tpu.aot import ladder as ladder_mod
+from karpenter_tpu.aot import runtime as aotrt
+from karpenter_tpu.aot.cache import ExecutableCache
+
+_log = klog.logger("aot")
+
+
+def content_hash(instance_types) -> str:
+    """The catalog content fingerprint — the same identity solverd's
+    engine factories content-address engines by (provisioner
+    _type_fingerprint), hashed for the cache key."""
+    from karpenter_tpu.controllers.provisioning.provisioner import (
+        _type_fingerprint,
+    )
+
+    fp = tuple(_type_fingerprint(it) for it in instance_types)
+    return hashlib.sha256(repr(fp).encode()).hexdigest()
+
+
+def _toolchain_fingerprint() -> str:
+    import jax
+
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:  # noqa: BLE001 — jaxlib version is advisory
+        jl = "?"
+    try:
+        backend = jax.default_backend()
+        kind = getattr(jax.devices()[0], "device_kind", "?")
+    except Exception:  # noqa: BLE001 — no usable backend
+        backend, kind = "none", "?"
+    return f"jax={jax.__version__};jaxlib={jl};backend={backend};device={kind}"
+
+
+def cache_key(
+    catalog_hash: str, kernel: str, sig: str, ladder_version: int
+) -> str:
+    parts = "\n".join(
+        (
+            catalog_hash,
+            _toolchain_fingerprint(),
+            kernel,
+            sig,
+            f"ladder-v{ladder_version}",
+        )
+    )
+    return hashlib.sha256(parts.encode()).hexdigest()
+
+
+# -- abstract-shape builders --------------------------------------------------
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(int(d) for d in shape), np.dtype(dtype))
+
+
+def _sig(args) -> str:
+    return kobs.shape_signature(args)
+
+
+def _cube_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
+    """(kernel, fn, abstract args, sig) per feasibility bucket. The engine
+    routes through production_cube when it has offerings, membership_all
+    when it has none — mirror that so only reachable executables build."""
+    from karpenter_tpu.ops import feasibility as feas
+
+    I, O, K = engine.num_instances, engine.num_offerings, engine._key_capacity
+    b = np.bool_
+    plans = []
+    if O:
+        for P, R in ladder.buckets("feasibility.cube"):
+            args = (
+                _sds((P, R), b),
+                _sds((R, I), b),
+                _sds((R, O), b),
+                _sds((O, K), b),
+                _sds((P, K), b),
+                _sds((O,), b),
+                _sds((O, I), b),
+            )
+            plans.append(
+                ("feasibility.cube", feas.production_cube, args, _sig(args))
+            )
+    else:
+        for P, R in ladder.buckets("feasibility.membership"):
+            args = (_sds((P, R), b), _sds((R, I), b))
+            plans.append(
+                ("feasibility.membership", feas.membership_all, args, _sig(args))
+            )
+    return plans
+
+
+def _row_compat_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
+    """Row-kernel buckets, one executable per (row bucket, target set):
+    instance sets and (when present) offering sets have distinct N dims."""
+    from karpenter_tpu.ops import feasibility as feas
+
+    K, W = engine._key_capacity, engine._word_capacity
+    G = W * 32
+    b, i32, u32 = np.bool_, np.int32, np.uint32
+    targets = [engine.num_instances]
+    if engine.num_offerings:
+        targets.append(engine.num_offerings)
+    plans = []
+    seen = set()
+    for (R,) in ladder.buckets("catalog.row_compat"):
+        for N in targets:
+            args = (
+                _sds((R,), i32),
+                _sds((R,), b),
+                _sds((R,), b),
+                _sds((R,), i32),
+                _sds((R,), i32),
+                _sds((R, W), u32),
+                _sds((N, K), b),
+                _sds((N, K), b),
+                _sds((N, K), b),
+                _sds((N, K), i32),
+                _sds((N, K), i32),
+                _sds((N, W), u32),
+                _sds((G,), i32),
+                _sds((G,), i32),
+            )
+            sig = _sig(args)
+            if sig in seen:
+                continue
+            seen.add(sig)
+            plans.append(
+                ("catalog.row_compat", feas.req_rows_vs_sets, args, sig)
+            )
+    return plans
+
+
+def _solve_block_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
+    """Packer buckets. The catalog-side row axis is the engine's CURRENT
+    interned row count (taken after warmup, when the probe rows exist) —
+    rows interned later shift the signature and dispatch off-table, which
+    the ladder view surfaces."""
+    from karpenter_tpu.ops import packer
+
+    I, O, K = engine.num_instances, engine.num_offerings, engine._key_capacity
+    R = max(1, engine._computed_rows)
+    D = len(engine.resource_dims)
+    b, i32, f32 = np.bool_, np.int32, np.float32
+    plans = []
+    for (G,) in ladder.buckets("packer.solve_block"):
+        args = (
+            _sds((G, R + K), b),
+            _sds((G, D + 1), i32),
+            _sds((R, I), b),
+            _sds((R, O), b),
+            _sds((O, K), b),
+            _sds((O,), b),
+            _sds((O, I), b),
+            _sds((I, D), i32),
+            _sds((I,), f32),
+        )
+        plans.append(("packer.solve_block", packer.solve_block_jit, args, _sig(args)))
+    return plans
+
+
+# -- the warm start -----------------------------------------------------------
+
+
+def _ensure_executable(
+    plan: tuple,
+    catalog_hash: str,
+    ladder: ladder_mod.Ladder,
+    cache: Optional[ExecutableCache],
+    registry,
+    summary: dict,
+) -> None:
+    """Load-or-compile one bucket; installs into the runtime table and
+    records the bucket into the observatory (phase aot-warm)."""
+    kernel, fn, abstract_args, sig = plan
+    summary["buckets"] += 1
+    if aotrt.lookup(kernel, sig) is not None:
+        # another engine with identical content already warmed this bucket
+        # this process — record it like a cache hit so warm-start telemetry
+        # is a pure function of the walk, not of process history
+        summary["already_loaded"] += 1
+        registry.record(kernel, sig, 0.0, compiled=False, fenced=False, aot=True)
+        return
+    from jax.experimental import serialize_executable as se
+
+    key = cache_key(catalog_hash, kernel, sig, ladder.version)
+    t0 = time.perf_counter()
+    if cache is not None:
+        body = cache.get(key)
+        if body is not None:
+            try:
+                payload, in_tree, out_tree = pickle.loads(body)
+                exe = se.deserialize_and_load(payload, in_tree, out_tree)
+                aotrt.install(kernel, sig, exe)
+                cache.count_hit()  # a hit = an executable actually served
+                summary["cache_hits"] += 1
+                registry.record(
+                    kernel, sig, time.perf_counter() - t0,
+                    compiled=False, fenced=False, aot=True,
+                )
+                return
+            except Exception as e:  # noqa: BLE001 — bad entry: evict, recompile
+                cache.evict(key, f"deserialize: {e}")
+    try:
+        exe = fn.lower(*abstract_args).compile()
+    except Exception as e:  # noqa: BLE001 — never crash a boot
+        summary["errors"] += 1
+        _log.warning(
+            "AOT compile failed; kernel stays on lazy JIT",
+            kernel=kernel, shape=sig, error=str(e),
+        )
+        return
+    seconds = time.perf_counter() - t0
+    aotrt.install(kernel, sig, exe)
+    summary["fresh_compiles"] += 1
+    registry.record(kernel, sig, seconds, compiled=True, fenced=True, aot=False)
+    if cache is not None:
+        try:
+            body = pickle.dumps(se.serialize(exe))
+        except Exception as e:  # noqa: BLE001 — unserializable backend
+            summary["errors"] += 1
+            _log.warning(
+                "AOT executable not serializable; next boot re-compiles",
+                kernel=kernel, shape=sig, error=str(e),
+            )
+            return
+        cache.put(key, body)
+
+
+def warm_start(
+    engine,
+    ladder: Optional[ladder_mod.Ladder] = None,
+    cache: Optional[ExecutableCache] = None,
+) -> Optional[dict]:
+    """Walk the ladder for `engine`: attach the ladder, stabilize vocab
+    capacities, load/compile every bucket, then run the engine's own warmup
+    (whose probe dispatch now rides the AOT table). Idempotent per engine.
+
+    Returns the walk summary (buckets / cache_hits / fresh_compiles /
+    already_loaded / errors), or None when AOT is disabled or the engine is
+    mesh-sharded (sharded executables are not AOT-managed yet)."""
+    if ladder is None:
+        ladder = aotrt.active_ladder()
+    if cache is None:
+        cache = aotrt.active_cache()
+    if ladder is None or engine is None or engine.mesh is not None:
+        if engine is not None:
+            engine.warmup()
+        return None
+    if getattr(engine, "_aot_warmed", False):
+        engine.warmup()
+        return getattr(engine, "_aot_summary", None)
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.ops import catalog as catmod
+
+    summary = {
+        "buckets": 0,
+        "cache_hits": 0,
+        "fresh_compiles": 0,
+        "already_loaded": 0,
+        "errors": 0,
+    }
+    engine.aot_ladder = ladder
+    # stabilize the key axis: pods constrain with well-known label keys (+
+    # hostname), so interning them now means the padded key capacity at
+    # boot equals the steady-state one — without this, the first batch's
+    # key interning grows K past the AOT'd shapes and every bucket misses
+    for key in sorted(set(wk.WELL_KNOWN_LABELS) | {wk.LABEL_HOSTNAME}):
+        engine.vocab.key_id(key)
+    engine._maybe_reencode()
+    catmod.device_rtt_s()  # backend init + routing probe (the seconds part)
+    chash = content_hash(engine.instance_types)
+    registry = kobs.registry()
+    with registry.phase_scope("aot-warm"):
+        for plan in _cube_plans(engine, ladder):
+            _ensure_executable(plan, chash, ladder, cache, registry, summary)
+        for plan in _row_compat_plans(engine, ladder):
+            _ensure_executable(plan, chash, ladder, cache, registry, summary)
+        # warmup AFTER the feasibility buckets exist (its probe dispatch
+        # rides the table) and BEFORE the packer plans (whose row axis is
+        # the post-probe interned row count)
+        engine.warmup()
+        for plan in _solve_block_plans(engine, ladder):
+            _ensure_executable(plan, chash, ladder, cache, registry, summary)
+    aotrt.note_warm_start(summary["fresh_compiles"])
+    engine._aot_warmed = True
+    engine._aot_summary = summary
+    _log.info(
+        "AOT warm start complete",
+        buckets=summary["buckets"],
+        cache_hits=summary["cache_hits"],
+        fresh_compiles=summary["fresh_compiles"],
+        already_loaded=summary["already_loaded"],
+        errors=summary["errors"],
+    )
+    return summary

@@ -413,6 +413,7 @@ class Provisioner:
         engine = self.engine_factory(instance_types)
         if engine is None:
             return
+        from karpenter_tpu.aot import runtime as aotrt
         from karpenter_tpu.observability import kernels as kobs
         from karpenter_tpu.tracing import kernel as ktime
 
@@ -425,16 +426,64 @@ class Provisioner:
                 catalog_instances=engine.num_instances,
             ) as span:
                 with ktime.measure() as kernels:
-                    engine.warmup()
+                    aot_summary = self._warm_engine(engine)
                 span.set_volatile(
                     wall_compile_s=round(kernels["compile_s"], 6),
                     wall_execute_s=round(kernels["execute_s"], 6),
                     kernel_dispatches=kernels["dispatches"],
                     kernel_compiles=kernels["compiles"],
+                    **(
+                        {
+                            "aot_buckets": aot_summary["buckets"],
+                            "aot_cache_hits": aot_summary["cache_hits"],
+                            "aot_fresh_compiles": aot_summary["fresh_compiles"],
+                        }
+                        if aot_summary
+                        else {}
+                    ),
                 )
         else:
-            engine.warmup()
+            self._warm_engine(engine)
         kobs.registry().on_recompile(self._on_kernel_recompiled, key="recorder")
+        aotrt.on_off_ladder(self._on_off_ladder_dispatch, key="recorder")
+
+    def _warm_engine(self, engine) -> Optional[dict]:
+        """Warm one engine: the AOT compile service when a ladder is
+        configured (walks the bucket ladder against the persistent
+        executable cache — aot/compiler.warm_start), the lazy
+        CatalogEngine.warmup() otherwise. Returns the AOT walk summary, or
+        None on the lazy path."""
+        from karpenter_tpu.aot import runtime as aotrt
+
+        if aotrt.enabled():
+            from karpenter_tpu import aot
+
+            try:
+                return aot.warm_start(engine)
+            except Exception as e:  # noqa: BLE001 — AOT must never block boot
+                _log.warning(
+                    "AOT warm start failed; falling back to lazy warmup",
+                    error=f"{type(e).__name__}: {e}",
+                )
+        engine.warmup()
+        return None
+
+    def _on_off_ladder_dispatch(self, kernel: str, shape: str) -> None:
+        """A device dispatch missed the AOT bucket ladder: it jit-compiles
+        a shape the warm start never prepaid. The event is the tuning
+        signal; /debug/kernels?view=ladder is the drill-down."""
+        self.recorder.publish(
+            Event(
+                None,
+                "Warning",
+                "AOTOffLadderDispatch",
+                f"kernel {kernel} dispatched shape [{shape}] outside the "
+                "configured AOT bucket ladder — it jit-compiled instead of "
+                "warm-starting; tune the ladder "
+                "(/debug/kernels?view=ladder)",
+                dedupe_values=("aot-off-ladder", kernel, shape),
+            )
+        )
 
     def _on_kernel_recompiled(self, kernel: str, shape: str) -> None:
         """The zero-recompile steady-state contract tripping: a kernel

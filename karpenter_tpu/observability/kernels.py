@@ -28,11 +28,13 @@ section and on ``/debug/kernels``.
 
 from __future__ import annotations
 
+import contextvars
 import hashlib
 import json
 import sys
 import threading
-from typing import Callable, Optional, Sequence
+from contextlib import contextmanager
+from typing import Callable, Iterator, Optional, Sequence
 
 from karpenter_tpu.metrics import global_registry
 
@@ -79,14 +81,24 @@ _DEVICE_MEM = global_registry.gauge(
     labels=["device", "stat"],
 )
 
-_PHASES = ("warmup", "steady", "host")
+# "aot-warm" is the AOT warm-start walk (aot/compiler): ladder buckets
+# loaded from the persistent cache or compiled ahead of time at boot
+_PHASES = ("warmup", "steady", "aot-warm", "host")
+
+# phase override for the CURRENT thread of control only (the AOT warm-start
+# walk): a contextvar, NOT registry state — a daemon thread warm-starting a
+# rebuilt engine must not relabel (or recompile-exempt) concurrent solve
+# threads' dispatches
+_PHASE_OVERRIDE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "karpenter_kernel_phase_override", default=None
+)
 
 
 class _Shape:
     """Per-(kernel, padded-shape-bucket) accounting."""
 
     __slots__ = ("dispatches", "compiles", "fenced", "execute_s", "max_s",
-                 "phases")
+                 "phases", "aot_served")
 
     def __init__(self):
         self.dispatches = 0
@@ -94,13 +106,14 @@ class _Shape:
         self.fenced = 0  # dispatches whose execute wall was fence-measured
         self.execute_s = 0.0
         self.max_s = 0.0
-        self.phases = {"warmup": 0, "steady": 0, "host": 0}
+        self.phases = {"warmup": 0, "steady": 0, "aot-warm": 0, "host": 0}
+        self.aot_served = 0  # dispatches served by an AOT executable
 
 
 class _Kernel:
     __slots__ = ("name", "dispatches", "compiles", "recompiles",
                  "host_dispatches", "compile_s", "execute_s", "phases",
-                 "shapes")
+                 "shapes", "aot_served")
 
     def __init__(self, name: str):
         self.name = name
@@ -110,8 +123,9 @@ class _Kernel:
         self.host_dispatches = 0
         self.compile_s = 0.0
         self.execute_s = 0.0
-        self.phases = {"warmup": 0, "steady": 0}
+        self.phases = {"warmup": 0, "steady": 0, "aot-warm": 0}
         self.shapes: dict[str, _Shape] = {}
+        self.aot_served = 0
 
 
 def shape_signature(args: Sequence) -> str:
@@ -170,6 +184,21 @@ class KernelRegistry:
             self._recompile_events.clear()
             self._last_memory = None
 
+    @contextmanager
+    def phase_scope(self, phase: str) -> Iterator[None]:
+        """Label every dispatch recorded by the CURRENT thread of control
+        inside as `phase` (one of _PHASES). The AOT warm-start walk runs
+        under phase_scope("aot-warm") so its ladder loads/compiles are
+        distinguishable from the lazy warmup path — and so a compile inside
+        the walk never counts as a steady-state recompile even on a
+        post-seal re-warm. Contextvar-scoped: a daemon thread warm-starting
+        a rebuilt engine never relabels concurrent solve threads."""
+        token = _PHASE_OVERRIDE.set(phase)
+        try:
+            yield
+        finally:
+            _PHASE_OVERRIDE.reset(token)
+
     def on_recompile(self, cb: Callable[[str, str], None], key: str = "default") -> None:
         """Register a (kernel, shape) callback fired on post-seal compiles.
         Keyed replace semantics: re-registration (a new Operator in the same
@@ -181,15 +210,16 @@ class KernelRegistry:
 
     def record(
         self, kernel: str, shape: str, seconds: float, compiled: bool,
-        fenced: bool,
+        fenced: bool, aot: bool = False,
     ) -> None:
         cbs: tuple = ()
         recompiled = False
+        override = _PHASE_OVERRIDE.get()
         with self._lock:
             k = self._kernels.get(kernel)
             if k is None:
                 k = self._kernels[kernel] = _Kernel(kernel)
-            phase = "steady" if self._sealed else "warmup"
+            phase = override or ("steady" if self._sealed else "warmup")
             k.dispatches += 1
             k.phases[phase] += 1
             s = k.shapes.get(shape)
@@ -197,11 +227,16 @@ class KernelRegistry:
                 s = k.shapes[shape] = _Shape()
             s.dispatches += 1
             s.phases[phase] += 1
+            if aot:
+                k.aot_served += 1
+                s.aot_served += 1
             if compiled:
                 k.compiles += 1
                 k.compile_s += seconds
                 s.compiles += 1
-                if self._sealed:
+                # a compile under a phase override (the AOT warm-start walk)
+                # is prepayment, not a steady-state contract violation
+                if self._sealed and override is None:
                     recompiled = True
                     k.recompiles += 1
                     self._recompile_events.append(
@@ -297,11 +332,14 @@ class KernelRegistry:
                         totals[ph] += v
             if shapes_out:
                 kernels_out[name] = {
-                    "dispatches": totals["warmup"] + totals["steady"],
+                    "dispatches": (
+                        totals["warmup"] + totals["steady"] + totals["aot-warm"]
+                    ),
                     "host_dispatches": totals["host"],
                     "phases": {
                         "warmup": totals["warmup"],
                         "steady": totals["steady"],
+                        "aot-warm": totals["aot-warm"],
                     },
                     "shapes": shapes_out,
                 }
@@ -317,9 +355,16 @@ class KernelRegistry:
         out["digest"] = digest
         return out
 
-    def debug_snapshot(self, kernel: Optional[str] = None) -> Optional[dict]:
-        """/debug/kernels: the per-kernel table, or a single kernel's
-        per-shape drill-down (None for an unknown kernel → 404)."""
+    def debug_snapshot(
+        self, kernel: Optional[str] = None, view: Optional[str] = None
+    ) -> Optional[dict]:
+        """/debug/kernels: the per-kernel table, a single kernel's
+        per-shape drill-down (None for an unknown kernel → 404), or — with
+        view="ladder" — the AOT ladder vs observed-buckets comparison."""
+        if view == "ladder":
+            from karpenter_tpu.aot import runtime as aotrt
+
+            return aotrt.ladder_view()
         with self._lock:
             if kernel is not None:
                 k = self._kernels.get(kernel)
@@ -330,6 +375,7 @@ class KernelRegistry:
                         "shape": shape,
                         "dispatches": s.dispatches,
                         "compiles": s.compiles,
+                        "aot_served": s.aot_served,
                         "phases": dict(s.phases),
                         "execute_wall_s": round(s.execute_s, 6),
                         "mean_execute_s": round(s.execute_s / s.fenced, 6)
@@ -347,6 +393,7 @@ class KernelRegistry:
                     "host_dispatches": k.host_dispatches,
                     "compiles": k.compiles,
                     "cache_hits": k.dispatches - k.compiles,
+                    "aot_served": k.aot_served,
                     "recompiles": k.recompiles,
                     "phases": dict(k.phases),
                     "compile_wall_s": round(k.compile_s, 6),
@@ -360,6 +407,7 @@ class KernelRegistry:
                     "host_dispatches": k.host_dispatches,
                     "compiles": k.compiles,
                     "cache_hits": k.dispatches - k.compiles,
+                    "aot_served": k.aot_served,
                     "recompiles": k.recompiles,
                     "phases": dict(k.phases),
                     "compile_wall_s": round(k.compile_s, 6),
@@ -369,7 +417,7 @@ class KernelRegistry:
                 for k in self._kernels.values()
             ]
             table.sort(key=lambda d: (-d["execute_wall_s"], d["kernel"]))
-            return {
+            out = {
                 "sealed": self._sealed,
                 "phase": self.phase,
                 "steady_recompiles": sum(
@@ -379,6 +427,13 @@ class KernelRegistry:
                 "device_memory": self._last_memory,
                 "kernels": table,
             }
+        # AOT compile-service state (cache traffic, loaded executables,
+        # off-ladder count) rides the same debug surface; taken outside the
+        # registry lock — the runtime takes its own
+        from karpenter_tpu.aot import runtime as aotrt
+
+        out["aot"] = aotrt.stats()
+        return out
 
 
 _REGISTRY = KernelRegistry()

@@ -80,6 +80,12 @@ class Operator:
             sample_rate=self.options.tracing_sample_rate,
             buffer_size=self.options.trace_buffer_size,
         )
+        # AOT compile service config (same process-global pattern as the
+        # tracer): --compile-cache-dir / --aot-ladder select the ladder and
+        # persistent executable cache; provisioner.prewarm() walks it
+        from karpenter_tpu import aot
+
+        aot.configure_from_options(self.options)
         # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
         # here it bounds the solver's interning/memo caches. The caps are
         # process-global, so only an EXPLICIT setting mutates them: -1 (the
@@ -427,14 +433,18 @@ class Operator:
         """solverd introspection for /debug/solverd (operator/serving.py)."""
         return self.provisioner.solver.stats()
 
-    def kernel_snapshot(self, kernel: Optional[str] = None) -> Optional[dict]:
+    def kernel_snapshot(
+        self, kernel: Optional[str] = None, view: Optional[str] = None
+    ) -> Optional[dict]:
         """/debug/kernels (operator/serving.py): the kernel observatory's
         per-kernel table (compile/execute split, shapes seen, phase counts,
-        recompiles, last device-memory sample), or a single kernel's
-        per-shape-bucket drill-down. None => unknown kernel (404)."""
+        recompiles, last device-memory sample), a single kernel's
+        per-shape-bucket drill-down, or — with ?view=ladder — the AOT
+        bucket ladder next to the observed shape buckets with off-ladder
+        dispatches flagged. None => unknown kernel (404)."""
         from karpenter_tpu.observability import kernels as kobs
 
-        return kobs.registry().debug_snapshot(kernel)
+        return kobs.registry().debug_snapshot(kernel, view=view)
 
     def trace_snapshot(
         self,

@@ -72,6 +72,14 @@ class Options:
     solverd_queue_depth: int = 256  # admission queue depth (shed past it)
     solverd_coalesce_window: float = 0.0  # seconds the batch leader waits
 
+    # AOT compile service (karpenter_tpu/aot): compile_cache_dir points at
+    # the persistent on-disk executable cache (restarts warm-start from it);
+    # aot_ladder selects the shape-bucket ladder — "off"/"" disables,
+    # "default" is the built-in ladder, anything else a JSON ladder file.
+    # A cache dir with no explicit ladder implies the default ladder.
+    compile_cache_dir: str = ""
+    aot_ladder: str = ""
+
     # tracing (karpenter_tpu/tracing): safe-on-by-default — sample every
     # trace into a BOUNDED in-memory ring buffer (spans; /debug/traces
     # reads it). Rate 0 disables span export entirely; the simulator always
@@ -126,6 +134,8 @@ class Options:
         parser.add_argument("--solver-daemon-address")
         parser.add_argument("--solverd-queue-depth", type=int)
         parser.add_argument("--solverd-coalesce-window", type=float)
+        parser.add_argument("--compile-cache-dir")
+        parser.add_argument("--aot-ladder")
         parser.add_argument("--tracing-sample-rate", type=float)
         parser.add_argument("--trace-buffer-size", type=int)
         parser.add_argument("--requeue-base-delay", type=float)
@@ -148,6 +158,8 @@ class Options:
             "solver_backend": "SOLVER_BACKEND",
             "solver_transport": "SOLVER_TRANSPORT",
             "solver_daemon_address": "SOLVER_DAEMON_ADDRESS",
+            "compile_cache_dir": "COMPILE_CACHE_DIR",
+            "aot_ladder": "AOT_LADDER",
         }
         for f in fields(cls):
             if f.name == "feature_gates":

@@ -231,7 +231,10 @@ class _Handler(BaseHTTPRequestHandler):
                 import json
 
                 q = parse_qs(url.query)
-                snap = cfg.kernel_snapshot(kernel=q.get("kernel", [None])[0])
+                snap = cfg.kernel_snapshot(
+                    kernel=q.get("kernel", [None])[0],
+                    view=q.get("view", [None])[0],
+                )
                 if snap is None:
                     self._respond(
                         404, json.dumps({"error": "unknown kernel"}),

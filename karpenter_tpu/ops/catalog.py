@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.aot import runtime as aotrt
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.observability import kernels as kobs
 from karpenter_tpu.ops import encoding as enc
@@ -195,6 +196,11 @@ class CatalogEngine:
         # Requirements are shared read-only — driver callers always copy.
         self.solver_joint_cache: dict[frozenset, Optional[tuple]] = {}
         self.solver_fam_trans: dict[tuple, tuple] = {}
+        # AOT bucket ladder (aot/ladder.py), attached by aot.warm_start:
+        # when set, device dispatches pad their variable axes to ladder
+        # buckets so they hit the prepaid executables; None = plain
+        # power-of-two padding (the pre-AOT behavior)
+        self.aot_ladder = None
 
     # -- catalog encoding ---------------------------------------------------
 
@@ -343,6 +349,31 @@ class CatalogEngine:
             kernel = lambda *a: ktime.dispatch(  # noqa: E731 — dispatch shim
                 feas.req_rows_vs_sets, *a, kernel="catalog.row_compat"
             )
+            # pad the row batch up to its AOT ladder bucket (results for the
+            # padding rows are sliced off below): bulk encodes then dispatch
+            # the warm-started executable instead of compiling per row count
+            if self.aot_ladder is not None:
+                bucket = self.aot_ladder.bucket_for(
+                    "catalog.row_compat", (len(new_rows),)
+                )
+                if bucket is None:
+                    # pow2-normalized shape key: bounded warning/event
+                    # cardinality when many distinct batch sizes overflow
+                    # the ladder
+                    aotrt.note_off_ladder(
+                        "catalog.row_compat",
+                        str(1 << max(0, (len(new_rows) - 1).bit_length())),
+                    )
+                elif bucket[0] > len(new_rows):
+                    pad = bucket[0] - len(new_rows)
+                    # edge-replicate the last row: a valid row whose
+                    # (discarded) results cost nothing extra semantically
+                    er.key = np.pad(er.key, (0, pad), mode="edge")
+                    er.complement = np.pad(er.complement, (0, pad), mode="edge")
+                    er.has_values = np.pad(er.has_values, (0, pad), mode="edge")
+                    er.gt = np.pad(er.gt, (0, pad), mode="edge")
+                    er.lt = np.pad(er.lt, (0, pad), mode="edge")
+                    er.mask = np.pad(er.mask, ((0, pad), (0, 0)), mode="edge")
         else:
             kernel = feas.req_rows_vs_sets_np
             kobs.registry().record_host(
@@ -370,7 +401,7 @@ class CatalogEngine:
                 cast(inst.mask),
                 *tables,
             )
-        )
+        )[: len(new_rows)]
         off = self._offer_sets
         if self.num_offerings:
             new_off = np.asarray(
@@ -384,7 +415,7 @@ class CatalogEngine:
                     cast(off.mask),
                     *tables,
                 )
-            )
+            )[: len(new_rows)]
         else:
             new_off = np.zeros((len(new_rows), 0), dtype=bool)
         self._req_compat = np.concatenate([self._req_compat, new_inst], axis=0)
@@ -525,15 +556,34 @@ class CatalogEngine:
         R = max(1, len(used))
         P2 = 1 << max(0, (P - 1).bit_length())
         R2 = 1 << max(0, (R - 1).bit_length())
+        # Routing is decided on the PLAIN pow2 dims (identical to pre-AOT
+        # behavior); only a sweep that actually goes to the device pads up
+        # to its AOT ladder bucket — the host twin must not compute over
+        # ladder-inflated matrices, and bucket inflation must not skew the
+        # host-vs-device decision.
+        host_cells = P2 * R2 * (self.num_instances + self.num_offerings)
+        on_device = _use_device(host_cells, _HOST_MATMUL_CELLS_PER_S)
+        ladder_kernel = (
+            "feasibility.cube" if self.num_offerings else "feasibility.membership"
+        )
+        if on_device and self.aot_ladder is not None and self.mesh is None:
+            # look up by the RAW dims, not the pow2-inflated ones: a tuned
+            # ladder may carry non-power-of-two buckets, and (P2, R2) would
+            # make them unreachable
+            bucket = self.aot_ladder.bucket_for(ladder_kernel, (P, R))
+            if bucket is None:
+                # past the largest bucket: keep pow2 padding and flag it —
+                # this dispatch jit-compiles a shape the warm start never
+                # prepaid (the ladder-tuning signal)
+                aotrt.note_off_ladder(ladder_kernel, f"{P2}x{R2}")
+            else:
+                P2, R2 = bucket
         membership = np.zeros((P2, R2), dtype=bool)
         for p, rows in enumerate(row_sets):
             for rid in rows:
                 i = colmap.get(rid)
                 if i is not None:
                     membership[p, i] = True
-
-        host_cells = P2 * R2 * (self.num_instances + self.num_offerings)
-        on_device = _use_device(host_cells, _HOST_MATMUL_CELLS_PER_S)
 
         req_compat_h = np.zeros((R2, self.num_instances), dtype=bool)
         if used:

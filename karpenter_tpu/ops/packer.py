@@ -175,16 +175,37 @@ class GroupSolver:
         """Single-device fused solve; returns host arrays
         (choice, feasible, nodes-per-group, unschedulable-per-group).
         Dispatch goes through the kernel timer so the solve span can split
-        wall time into compile vs execute (tracing/kernel.py)."""
+        wall time into compile vs execute (tracing/kernel.py). With an AOT
+        ladder attached to the engine, the group axis pads up to its bucket
+        (zero rows: counts 0 → nodes 0, sliced off) so the dispatch hits a
+        warm-started executable."""
         args = self._catalog_args()
+        group_bools, group_ints = _pack_groups(grouped)
+        G = group_bools.shape[0]
+        ladder = getattr(self.engine, "aot_ladder", None)
+        if ladder is not None:
+            from karpenter_tpu.aot import runtime as aotrt
+
+            bucket = ladder.bucket_for("packer.solve_block", (G,))
+            if bucket is None:
+                # pow2-normalized: bounded warning/event cardinality
+                aotrt.note_off_ladder(
+                    "packer.solve_block",
+                    str(1 << max(0, (G - 1).bit_length())),
+                )
+            elif bucket[0] > G:
+                pad = bucket[0] - G
+                group_bools = np.pad(group_bools, ((0, pad), (0, 0)))
+                group_ints = np.pad(group_ints, ((0, pad), (0, 0)))
         out = np.asarray(
             ktime.dispatch(
                 solve_block_jit,
-                *_pack_groups(grouped),
+                group_bools,
+                group_ints,
                 *args,
                 kernel="packer.solve_block",
             )
-        )
+        )[:G]
         return out[:, 0], out[:, 1].astype(bool), out[:, 2], out[:, 3]
 
     def solve_sharded(self, grouped: GroupedPods, mesh: Mesh, axis: str = "pods"):

@@ -43,6 +43,19 @@ def main(argv=None) -> int:
         "same-seed runs write byte-identical files",
     )
     parser.add_argument(
+        "--compile-cache-dir",
+        default="",
+        help="persistent AOT executable cache directory: the run's engines "
+        "warm-start from it (and fill it), so a second run against the "
+        "same dir boots with zero fresh ladder compiles",
+    )
+    parser.add_argument(
+        "--aot-ladder",
+        default="",
+        help="AOT shape-bucket ladder: 'default', a JSON ladder file, or "
+        "'off' (a --compile-cache-dir implies 'default')",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     parser.add_argument(
@@ -71,7 +84,18 @@ def main(argv=None) -> int:
         with open(args.dump_trace, "w", encoding="utf-8") as f:
             f.write(tracemod.dumps(trace) + "\n")
 
-    result = run_scenario(trace, args.seed, trace_export=args.trace_export)
+    options = None
+    if args.compile_cache_dir or args.aot_ladder:
+        from karpenter_tpu.operator.options import Options
+
+        options = Options(
+            compile_cache_dir=args.compile_cache_dir,
+            aot_ladder=args.aot_ladder,
+        )
+
+    result = run_scenario(
+        trace, args.seed, options=options, trace_export=args.trace_export
+    )
 
     if args.events:
         with open(args.events, "w", encoding="utf-8") as f:

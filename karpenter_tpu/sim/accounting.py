@@ -63,6 +63,7 @@ class Accountant:
             "capacity_errors": 0,
             "cloud_outage_failures": 0,
             "solver_rejections": 0,
+            "solverd_restarts": 0,
             "pods_lost": 0,
         }
         breaker = {
@@ -123,6 +124,8 @@ class Accountant:
                 faults["cloud_outage_failures"] += 1
             elif ev == "fault-solver-reject":
                 faults["solver_rejections"] += 1
+            elif ev == "solverd-restart":
+                faults["solverd_restarts"] += 1
             elif ev == "breaker":
                 to = e["to"]
                 if to == "open":

@@ -170,12 +170,16 @@ class Simulation:
                 self._rel(self.clock.now()), "breaker", **{"from": old, "to": new}
             )
         )
-        rejection_rate = faults.get("solver_rejection_rate", 0.0)
-        if rejection_rate > 0:
+        # kept for solverd-restart: the rebuilt client must re-wrap with the
+        # SAME flaky profile and the SAME rng stream (mid-stream — byte
+        # determinism depends on continuing it, not reseeding)
+        self._solver_rejection_rate = faults.get("solver_rejection_rate", 0.0)
+        self._solver_fault_rng = Random(f"{seed}:solver-faults")
+        if self._solver_rejection_rate > 0:
             self.operator.provisioner.solver = FlakySolverClient(
                 self.operator.provisioner.solver,
-                rng=Random(f"{seed}:solver-faults"),
-                rejection_rate=rejection_rate,
+                rng=self._solver_fault_rng,
+                rejection_rate=self._solver_rejection_rate,
                 on_fault=self._on_fault,
             )
         # ffd's solve counters are module globals that accumulate across
@@ -195,6 +199,14 @@ class Simulation:
         from karpenter_tpu.observability import kernels as kobs
 
         self._kernels_base = kobs.registry().counts_snapshot()
+        # AOT compile-service traffic (cache hits/misses, fresh compiles,
+        # off-ladder dispatches): snapshotted so the report carries this
+        # run's deltas; the section rides OUTSIDE the kernels digest — a
+        # warm second run legitimately hits the cache a cold first run
+        # missed, and that must not break report-digest equality
+        from karpenter_tpu.aot import runtime as aotrt
+
+        self._aot_base = aotrt.stats()
         self._victim_rng = Random(f"{seed}:victims")
         self._groups: dict[str, _Group] = {}
         self._known_nodes: set[str] = set()
@@ -291,6 +303,11 @@ class Simulation:
             # byte-deterministic across same-seed runs under the pinned RTT;
             # walls and compile counts ride in its volatile appendix
             report["kernels"] = kobs.registry().report(self._kernels_base)
+            # AOT compile-service deltas, deliberately OUTSIDE the digest
+            # (cache hits are process/disk history, not scenario facts)
+            from karpenter_tpu.aot import runtime as aotrt
+
+            report["kernels"]["aot"] = aotrt.stats_delta(self._aot_base)
             self.tracer.close()  # flush the JSONL export, if any
             return SimResult(report=report, digest=self.log.digest(), log=self.log)
         finally:
@@ -344,8 +361,69 @@ class Simulation:
                 capacity_type=ev.get("capacity_type"),
                 on_fault=self._on_fault,
             )
+        elif kind == "solverd-restart":
+            self._restart_solverd()
         else:
             raise ValueError(f"unknown trace event kind {kind!r}")
+
+    def _restart_solverd(self) -> None:
+        """Restart the solver service mid-trace (the rolling-upgrade path
+        ROADMAP item 2 hardens): the old client closes, engines and their
+        device state are dropped (a restarted daemon holds none), and the
+        next provisioning pass re-prewarms from scratch — against the
+        persistent AOT executable cache when one is configured, which is
+        exactly what the warm-start contract asserts stays fast."""
+        from karpenter_tpu.controllers.provisioning import (
+            provisioner as provmod,
+        )
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.solverd import build_solver
+
+        prov = self.operator.provisioner
+        try:
+            prov.solver.close()
+        except Exception:  # noqa: BLE001 — a dying daemon can't block the sim
+            pass
+        prov.solver = build_solver(self.operator.options, self.clock)
+        # the scenario's fault profile survives the restart: re-wrap the
+        # fresh client, continuing the established rng stream
+        if self._solver_rejection_rate > 0:
+            prov.solver = FlakySolverClient(
+                prov.solver,
+                rng=self._solver_fault_rng,
+                rejection_rate=self._solver_rejection_rate,
+                on_fault=self._on_fault,
+            )
+        # cold-engine discipline: the restarted daemon rebuilds engines from
+        # shipped catalogs, so both engine cache levels drop
+        provmod._ENGINE_CONTENT_CACHE.clear()
+        # ... and holds no executables: the AOT table empties so the
+        # re-prewarm actually drives the persistent-cache LOAD path (not the
+        # already-loaded fast path), and — when the compile service is on —
+        # the jit caches drop too, so a cacheless restart honestly repays
+        # its compiles. Deterministic: warm_start records one dispatch per
+        # bucket whichever of compile/load/already served it.
+        from karpenter_tpu.aot import runtime as aotrt
+
+        aotrt.clear_executables()
+        if aotrt.enabled():
+            try:
+                import jax
+
+                jax.clear_caches()
+            except Exception:  # noqa: BLE001 — jax never imported: nothing to clear
+                pass
+        if prov.engine_factory is not None:
+            prov.engine_factory = provmod.default_engine_factory(
+                shard_devices=prov.options.solver_pod_shard_axis
+            )
+        # a restart reopens the warmup window: the re-prewarm (and the first
+        # post-restart solve's residual compiles) are cold-start facts, not
+        # steady-state recompiles
+        prov._kernels_sealed = False
+        prov._prewarm_traced = False
+        kobs.registry().unseal()
+        self.log.append(self._rel(self.clock.now()), "solverd-restart")
 
     def _submit(self, group: _Group, name: str) -> None:
         pod = build_pod(name, group.name, group.pod_spec)

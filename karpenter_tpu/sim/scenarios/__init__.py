@@ -71,3 +71,8 @@ register(
     tracemod.flaky_cloud,
     "launch failures, capacity errors, API latency, solver rejection storm",
 )
+register(
+    "solverd-restart",
+    tracemod.solverd_restart,
+    "solver daemon restarts mid-trace; warm-starts from the AOT cache when configured",
+)

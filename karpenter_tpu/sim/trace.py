@@ -243,6 +243,49 @@ def tpu_training(rng: Random) -> dict:
     return trace
 
 
+def solverd_restart(rng: Random) -> dict:
+    """Service load with the solver daemon restarting mid-trace — the
+    rolling-upgrade path: steady demand establishes a warm solver, the
+    restart drops every engine and executable, and a scale-up lands right
+    after it so the very next solve pays the restart's cold path. With the
+    AOT compile service configured (--compile-cache-dir), that cold path
+    warm-starts from the persistent executable cache; either way the run
+    must complete deterministically with every pod bound (no SLO breach)."""
+    trace = _base("solverd-restart", duration=300.0)
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "svc",
+            "count": 4 + rng.randrange(3),
+            "pod": {"cpu": "2", "memory": "2Gi"},
+            "replace": True,
+        },
+        {
+            "at": 60.0,
+            "kind": "submit",
+            "group": "batch",
+            "count": 2 + rng.randrange(2),
+            "pod": {"cpu": "1", "memory": "1Gi"},
+            "until": 220.0,
+            "replace": True,
+        },
+        # the daemon restarts mid-stream (rolling upgrade) ...
+        {"at": 150.0, "kind": "solverd-restart"},
+        # ... and demand arrives immediately after, forcing the first
+        # post-restart solve through the rebuilt (warm-started) engine
+        {
+            "at": 160.0,
+            "kind": "submit",
+            "group": "post-restart",
+            "count": 3 + rng.randrange(2),
+            "pod": {"cpu": "1", "memory": "2Gi"},
+            "replace": True,
+        },
+    ]
+    return trace
+
+
 def flaky_cloud(rng: Random) -> dict:
     """Steady demand against a misbehaving cloud: probabilistic launch
     failures, occasional capacity errors, API latency, a solver shedding

@@ -34,10 +34,33 @@ def main(argv=None) -> int:
         "--coalesce-window", type=float, default=0.005,
         help="seconds the batch leader waits for concurrent requests",
     )
+    parser.add_argument(
+        "--compile-cache-dir", default="",
+        help="persistent AOT executable cache directory; restarts "
+        "warm-start their engines from it instead of re-compiling",
+    )
+    parser.add_argument(
+        "--aot-ladder", default="",
+        help="AOT shape-bucket ladder: 'default', a JSON ladder file, or "
+        "'off' (a --compile-cache-dir implies 'default')",
+    )
     parser.add_argument("--log-level", default="info")
     ns = parser.parse_args(argv)
     klog.configure(ns.log_level)
     log = klog.logger("solverd")
+
+    # AOT compile service: engines the daemon rebuilds from shipped catalogs
+    # warm-start against the ladder + persistent cache (transport.py's
+    # engine factory calls aot.warm_start when the runtime is enabled)
+    from types import SimpleNamespace
+
+    from karpenter_tpu.aot import runtime as aotrt
+
+    aotrt.configure_from_options(
+        SimpleNamespace(
+            aot_ladder=ns.aot_ladder, compile_cache_dir=ns.compile_cache_dir
+        )
+    )
 
     service = SolverService(
         clock=Clock(),
@@ -50,6 +73,8 @@ def main(argv=None) -> int:
         address=daemon.address,
         queue_depth=ns.queue_depth,
         coalesce_window=ns.coalesce_window,
+        aot=aotrt.enabled(),
+        compile_cache_dir=ns.compile_cache_dir or None,
     )
     try:
         while True:

@@ -523,6 +523,26 @@ def _default_engine_factory():
         engine = cache.get(key)
         if engine is None:
             engine = CatalogEngine(catalog)
+            # warm-start path for daemon restarts: with the AOT compile
+            # service configured (--compile-cache-dir / --aot-ladder), a
+            # rebuilt engine loads its ladder executables from the
+            # persistent cache instead of lazily jit-compiling inside the
+            # first solve after the restart
+            from karpenter_tpu.aot import runtime as aotrt
+
+            if aotrt.enabled():
+                from karpenter_tpu import aot
+
+                try:
+                    aot.warm_start(engine)
+                except Exception as e:  # noqa: BLE001 — never fail a solve
+                    from karpenter_tpu.operator import logging as klog
+
+                    klog.logger("solverd").warning(
+                        "AOT warm start failed for rebuilt engine; "
+                        "falling back to lazy JIT",
+                        error=f"{type(e).__name__}: {e}",
+                    )
             cache[key] = engine
         return engine
 

@@ -1,0 +1,658 @@
+"""AOT compile service (karpenter_tpu/aot): the bucket ladder, the
+persistent executable cache (incl. every pathology: corruption,
+truncation, version-mismatched keys, concurrent writers, read-only dirs —
+all degrade to JIT, never crash), the warm-start walk (second boot against
+a warm cache performs ZERO fresh ladder compiles), the dispatch-table
+interception (decisions bit-identical, broken executables fall back), the
+off-ladder warning path, the /debug/kernels?view=ladder view, and the
+solverd-restart-midstream sim scenario."""
+
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu import aot
+from karpenter_tpu.aot import cache as cachemod
+from karpenter_tpu.aot import compiler as aotc
+from karpenter_tpu.aot import ladder as lmod
+from karpenter_tpu.aot import runtime as aotrt
+from karpenter_tpu.aot.cache import ExecutableCache
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.instance_types import (
+    construct_instance_types,
+)
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.observability import kernels as kobs
+from karpenter_tpu.ops import catalog as catmod
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.scheduling.requirements import (
+    Operator,
+    Requirement,
+    Requirements,
+)
+
+TINY_LADDER = lmod.make(
+    {
+        "feasibility.cube": [(1, 4), (4, 8)],
+        "catalog.row_compat": [(32,)],
+        "packer.solve_block": [(8,)],
+    }
+)
+
+
+@pytest.fixture
+def clean_aot():
+    """Isolate AOT process-global state per spec."""
+    reg = kobs.registry()
+    reg.reset()
+    aotrt.clear_executables()
+    aotrt.reset_off_ladder()
+    yield
+    aotrt.configure(None, None)
+    aotrt.clear_executables()
+    aotrt.reset_off_ladder()
+    reg.reset()
+
+
+def small_engine() -> CatalogEngine:
+    return CatalogEngine(construct_instance_types())
+
+
+def probe_feasibility(engine):
+    reqs = Requirements(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+    rows = engine.rows_for(reqs)
+    return engine.feasibility(
+        [rows], np.zeros((1, len(engine.resource_dims)))
+    )
+
+
+class TestLadder:
+    def test_bucket_for_picks_smallest_fit(self):
+        assert lmod.DEFAULT.bucket_for("feasibility.cube", (3, 5)) == (8, 16)
+        assert lmod.DEFAULT.bucket_for("feasibility.cube", (1, 1)) == (1, 4)
+        assert lmod.DEFAULT.bucket_for("catalog.row_compat", (40,)) == (64,)
+
+    def test_off_ladder_is_none(self):
+        assert lmod.DEFAULT.bucket_for("feasibility.cube", (4096, 4)) is None
+        assert lmod.DEFAULT.bucket_for("unknown.kernel", (1,)) is None
+        # arity mismatch can't select a bucket
+        assert lmod.DEFAULT.bucket_for("feasibility.cube", (1,)) is None
+
+    def test_serialization_round_trip(self, tmp_path):
+        path = tmp_path / "ladder.json"
+        path.write_text(TINY_LADDER.dumps())
+        loaded = lmod.load(str(path))
+        assert loaded == TINY_LADDER
+        assert lmod.resolve(str(path)) == TINY_LADDER
+
+    def test_resolve_specs(self):
+        assert lmod.resolve("") is None
+        assert lmod.resolve("off") is None
+        assert lmod.resolve("default") is lmod.DEFAULT
+
+    def test_from_observatory_rounds_up_device_buckets(self):
+        counts = {
+            "feasibility.cube": {
+                "shapes": {
+                    "3x5,5x144,...": {"warmup": 1, "steady": 4},
+                    # host-twin buckets never shape the ladder
+                    "9x9,...": {"host": 2},
+                },
+                "recompiles": 0,
+            },
+            "catalog.row_compat": {
+                "shapes": {"40,40,40": {"steady": 1}},
+                "recompiles": 0,
+            },
+        }
+        ladder = lmod.from_observatory(counts, headroom=1)
+        assert (4, 8) in ladder.buckets("feasibility.cube")
+        assert (8, 16) in ladder.buckets("feasibility.cube")  # headroom
+        assert (64,) in ladder.buckets("catalog.row_compat")
+        assert not any(b[0] >= 16 and b != (8, 16)
+                       for b in ladder.buckets("feasibility.cube"))
+
+    def test_from_observatory_headroom_covers_every_axis(self):
+        """Headroom doubles the per-axis maxima: growth along the R axis
+        must stay on-ladder even when the lexicographically-largest bucket
+        is wide-and-shallow."""
+        counts = {
+            "feasibility.cube": {
+                "shapes": {
+                    "512x4,4x144": {"steady": 1},
+                    "64x64,64x144": {"steady": 1},
+                },
+                "recompiles": 0,
+            },
+        }
+        ladder = lmod.from_observatory(counts, headroom=1)
+        assert (1024, 128) in ladder.buckets("feasibility.cube")
+        assert ladder.bucket_for("feasibility.cube", (128, 128)) == (1024, 128)
+
+
+class TestExecutableCache:
+    def test_round_trip(self, tmp_path):
+        c = ExecutableCache(str(tmp_path))
+        assert c.get("k" * 64) is None  # miss
+        assert c.put("k" * 64, b"payload")
+        assert c.get("k" * 64) == b"payload"
+        # a hit is only counted once the caller confirms the payload loaded
+        assert c.stats()["hits"] == 0
+        c.count_hit()
+        assert c.stats()["hits"] == 1
+        assert c.stats()["misses"] == 1
+
+    def test_valid_envelope_bad_payload_evicts_without_hit(self, tmp_path):
+        """An entry whose checksum is clean but whose payload fails to load
+        (toolchain drift inside a valid envelope): the caller evicts it —
+        one eviction, zero hits, so cache metrics never claim a warm start
+        that didn't happen."""
+        c = ExecutableCache(str(tmp_path))
+        c.put("p" * 64, b"not a pickled executable")
+        body = c.get("p" * 64)
+        assert body == b"not a pickled executable"  # envelope verifies
+        c.evict("p" * 64, "deserialize: boom")  # what the compiler does
+        assert c.stats()["hits"] == 0
+        assert c.stats()["evictions"] == 1
+        assert c.get("p" * 64) is None  # gone
+
+    def test_corrupted_entry_evicts_and_degrades(self, tmp_path):
+        c = ExecutableCache(str(tmp_path))
+        c.put("a" * 64, b"good bytes")
+        path = c._path("a" * 64)
+        with open(path, "r+b") as f:
+            f.seek(len(cachemod.MAGIC) + 70)
+            f.write(b"XXXX")  # flip body bytes: checksum now fails
+        assert c.get("a" * 64) is None
+        assert not os.path.exists(path), "corrupt entry not evicted"
+        assert c.stats()["evictions"] == 1
+
+    def test_truncated_entry_evicts(self, tmp_path):
+        c = ExecutableCache(str(tmp_path))
+        c.put("b" * 64, b"a longer body that will be cut")
+        path = c._path("b" * 64)
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[: len(raw) // 2])
+        assert c.get("b" * 64) is None
+        assert not os.path.exists(path)
+
+    def test_bad_magic_evicts(self, tmp_path):
+        c = ExecutableCache(str(tmp_path))
+        path = c._path("c" * 64)
+        open(path, "wb").write(b"not an aot entry at all")
+        assert c.get("c" * 64) is None
+        assert not os.path.exists(path)
+
+    def test_version_mismatched_key_is_a_miss(self, monkeypatch, tmp_path):
+        """The jax/XLA version lives in the cache KEY: a version bump makes
+        yesterday's entries unreachable misses, never wrong loads."""
+        k_now = aotc.cache_key("cat", "feasibility.cube", "1x4", 1)
+        monkeypatch.setattr(
+            aotc, "_toolchain_fingerprint", lambda: "jax=9.9.9;backend=tpu"
+        )
+        k_other = aotc.cache_key("cat", "feasibility.cube", "1x4", 1)
+        assert k_now != k_other
+        c = ExecutableCache(str(tmp_path))
+        c.put(k_other, b"old-version executable")
+        assert c.get(k_now) is None  # miss, not corruption
+        assert c.stats()["evictions"] == 0
+        # ladder version + catalog content rotate the key the same way
+        assert aotc.cache_key("cat", "feasibility.cube", "1x4", 2) != k_other
+        assert aotc.cache_key("dog", "feasibility.cube", "1x4", 1) != k_other
+
+    def test_concurrent_writers_share_a_dir(self, tmp_path):
+        """Two daemons sharing one cache dir: interleaved writes to the
+        same keys never produce a torn read or an exception."""
+        c1 = ExecutableCache(str(tmp_path))
+        c2 = ExecutableCache(str(tmp_path))
+        body = b"x" * 4096
+        errors = []
+
+        def writer(c):
+            try:
+                for i in range(50):
+                    c.put("e" * 64, body)
+                    got = c.get("e" * 64)
+                    assert got is None or got == body
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(c,)) for c in (c1, c2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert c1.get("e" * 64) == body
+        assert c1.stats()["evictions"] == 0
+
+    def test_read_only_dir_degrades_to_jit(self, monkeypatch, tmp_path):
+        """An unwritable cache dir (read-only volume) must not crash the
+        boot: writes warn + count, reads keep working."""
+        c = ExecutableCache(str(tmp_path))
+        c.put("f" * 64, b"pre-existing")
+
+        def deny(*args, **kwargs):
+            raise PermissionError("read-only file system")
+
+        monkeypatch.setattr(cachemod.os, "replace", deny)
+        assert c.put("g" * 64, b"new entry") is False
+        assert c.stats()["write_errors"] == 1
+        assert c.get("f" * 64) == b"pre-existing"  # reads unaffected
+        # no temp-file litter
+        assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+    def test_uncreatable_root_is_an_empty_cache(self, tmp_path):
+        target = tmp_path / "file"
+        target.write_text("occupied")
+        c = ExecutableCache(str(target / "sub"))  # parent is a file
+        assert c.get("h" * 64) is None
+        assert c.put("h" * 64, b"x") is False
+
+
+class TestWarmStart:
+    def test_cold_then_warm_boot_zero_fresh_compiles(self, clean_aot, tmp_path):
+        """The acceptance contract: boot #1 compiles the ladder and fills
+        the cache; boot #2 (fresh process stand-in: executables + jit
+        caches dropped) loads every bucket from disk and compiles NOTHING,
+        asserted via the observatory's aot-warm compile counters."""
+        cache = ExecutableCache(str(tmp_path))
+        aotrt.configure(TINY_LADDER, cache)
+        reg = kobs.registry()
+
+        s1 = aot.warm_start(small_engine())
+        assert s1["buckets"] > 0
+        assert s1["fresh_compiles"] == s1["buckets"]
+        assert s1["cache_hits"] == 0 and s1["errors"] == 0
+
+        # restart: drop every executable this process holds
+        aotrt.clear_executables()
+        jax.clear_caches()
+        reg.reset()
+        e2 = small_engine()
+        s2 = aot.warm_start(e2)
+        assert s2["fresh_compiles"] == 0, s2
+        assert s2["cache_hits"] == s2["buckets"] == s1["buckets"]
+        # observatory agrees: every aot-warm record was a warm load
+        for row in reg.debug_snapshot()["kernels"]:
+            assert row["compiles"] == 0, row
+            assert row["phases"]["aot-warm"] > 0
+        assert e2.aot_ladder is TINY_LADDER
+        assert getattr(e2, "_aot_warmed") is True
+
+    def test_warm_start_idempotent_per_engine(self, clean_aot, tmp_path):
+        aotrt.configure(TINY_LADDER, ExecutableCache(str(tmp_path)))
+        base = aotrt.stats()["warm_starts"]
+        engine = small_engine()
+        s1 = aot.warm_start(engine)
+        s2 = aot.warm_start(engine)  # no second walk
+        assert s2 is s1 or s2 == s1
+        assert aotrt.stats()["warm_starts"] == base + 1
+
+    def test_corrupt_cache_entry_falls_back_to_compile(self, clean_aot, tmp_path):
+        cache = ExecutableCache(str(tmp_path))
+        aotrt.configure(TINY_LADDER, cache)
+        aot.warm_start(small_engine())
+        # corrupt every entry, restart, warm again: evict + recompile, no crash
+        for name in os.listdir(tmp_path):
+            path = os.path.join(tmp_path, name)
+            raw = open(path, "rb").read()
+            open(path, "wb").write(raw[:-8] + b"CORRUPTX")
+        aotrt.clear_executables()
+        s2 = aot.warm_start(small_engine())
+        assert s2["fresh_compiles"] == s2["buckets"]
+        assert s2["cache_hits"] == 0
+        assert cache.stats()["evictions"] >= s2["buckets"]
+
+    def test_without_cache_dir_still_prepays_compiles(self, clean_aot):
+        aotrt.configure(TINY_LADDER, None)
+        s = aot.warm_start(small_engine())
+        assert s["fresh_compiles"] == s["buckets"] > 0
+
+    def test_disabled_runs_lazy_warmup(self, clean_aot):
+        engine = small_engine()
+        assert aot.warm_start(engine) is None
+        assert engine.aot_ladder is None
+        assert getattr(engine, "_warmed", False) is True
+
+    def test_key_capacity_stabilized(self, clean_aot):
+        """warm_start pre-interns the well-known label keys so the padded
+        key axis at boot matches steady state — pod selectors (arch, zone,
+        capacity-type...) must not grow K past the AOT'd shapes."""
+        aotrt.configure(TINY_LADDER, None)
+        engine = small_engine()
+        aot.warm_start(engine)
+        k_boot = engine._key_capacity
+        for key in (wk.LABEL_ARCH, wk.LABEL_TOPOLOGY_ZONE,
+                    wk.CAPACITY_TYPE_LABEL_KEY, wk.LABEL_HOSTNAME):
+            engine.vocab.key_id(key)
+        engine._maybe_reencode()
+        assert engine._key_capacity == k_boot
+
+
+class TestDispatchInterception:
+    def test_feasibility_served_by_aot_executable(self, clean_aot, tmp_path):
+        aotrt.configure(TINY_LADDER, ExecutableCache(str(tmp_path)))
+        prev = catmod.FORCE_BACKEND
+        catmod.FORCE_BACKEND = "device"
+        try:
+            engine = small_engine()
+            aot.warm_start(engine)
+            rows = engine.rows_for(
+                Requirements(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+            )
+            reg = kobs.registry()
+            reg.seal()
+            base = reg.steady_recompiles()
+            engine.feasibility(
+                [rows], np.zeros((1, len(engine.resource_dims)))
+            )
+            snap = reg.debug_snapshot("feasibility.cube")
+            assert snap["aot_served"] >= 1, snap
+            assert reg.steady_recompiles() == base
+        finally:
+            catmod.FORCE_BACKEND = prev
+
+    def test_decisions_identical_with_and_without_aot(self, clean_aot, tmp_path):
+        aotrt.configure(TINY_LADDER, ExecutableCache(str(tmp_path)))
+        e_aot = small_engine()
+        aot.warm_start(e_aot)
+        fz_aot = probe_feasibility(e_aot)
+        aotrt.configure(None, None)
+        e_ref = small_engine()
+        e_ref.warmup()
+        fz_ref = probe_feasibility(e_ref)
+        assert (fz_aot.feasible == fz_ref.feasible).all()
+
+    def test_broken_executable_falls_back_and_discards(self, clean_aot):
+        """An installed executable that raises at call time (backend drift)
+        must degrade to the jit path and drop out of the table."""
+        from karpenter_tpu.tracing import kernel as ktime
+
+        calls = []
+
+        def broken(*args):
+            calls.append(1)
+            raise TypeError("aval mismatch")
+
+        f = jax.jit(lambda x: x * 2.0)
+        import jax.numpy as jnp
+
+        sig = kobs.shape_signature((jnp.ones((6,)),))
+        aotrt.install("spec.broken", sig, broken)
+        ctr = global_registry.get("karpenter_aot_executable_fallbacks_total")
+        base = ctr.value({"kernel": "spec.broken"})
+        out = ktime.dispatch(f, jnp.ones((6,)), kernel="spec.broken")
+        assert float(np.asarray(out)[0]) == 2.0  # jit fallback answered
+        assert calls == [1]
+        assert aotrt.lookup("spec.broken", sig) is None  # discarded
+        assert ctr.value({"kernel": "spec.broken"}) == base + 1
+        # next dispatch goes straight to jit, no second failure
+        ktime.dispatch(f, jnp.ones((6,)), kernel="spec.broken")
+        assert calls == [1]
+
+    def test_packer_pads_group_axis_to_bucket(self, clean_aot):
+        from karpenter_tpu.ops.packer import (
+            GroupSolver,
+            encode_pods_for_packer,
+        )
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        aotrt.configure(TINY_LADDER, None)
+        engine = small_engine()
+        aot.warm_start(engine)
+        reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        dims = engine.resource_dims
+        requests = np.zeros((3, len(dims)))
+        cpu = parse_resource_list({"cpu": "1"})["cpu"]
+        requests[:, dims[wk.RESOURCE_CPU]] = cpu
+        grouped = encode_pods_for_packer(engine, [reqs] * 3, requests)
+        solver = GroupSolver(engine)
+        choice, feasible, nodes, unsched = solver.solve(grouped)
+        # G groups in, G results out (padding sliced off) and all feasible
+        G = grouped.membership.shape[0]
+        assert len(choice) == len(nodes) == G
+        assert feasible.all()
+        shapes = kobs.registry().debug_snapshot("packer.solve_block")["shapes"]
+        # the dispatched group axis is the ladder bucket (8), not G
+        assert any(s["shape"].startswith("8x") for s in shapes), shapes
+
+
+class TestOffLadder:
+    def test_note_counts_warns_once_and_fires_callbacks(self, clean_aot):
+        fired = []
+        aotrt.on_off_ladder(lambda k, s: fired.append((k, s)), key="spec")
+        ctr = global_registry.get("karpenter_aot_offladder_dispatches_total")
+        base = ctr.value({"kernel": "spec.k"})
+        aotrt.note_off_ladder("spec.k", "1024x8")
+        aotrt.note_off_ladder("spec.k", "1024x8")
+        assert ctr.value({"kernel": "spec.k"}) == base + 2
+        assert fired == [("spec.k", "1024x8")] * 2
+        assert aotrt.stats()["off_ladder_dispatches"] == 2
+
+    def test_oversized_cube_flags_off_ladder(self, clean_aot):
+        """A sweep past the largest bucket keeps the pow2 padding and is
+        counted — it will jit-compile a shape the warm start never saw."""
+        aotrt.configure(TINY_LADDER, None)
+        prev = catmod.FORCE_BACKEND
+        catmod.FORCE_BACKEND = "device"
+        try:
+            engine = small_engine()
+            aot.warm_start(engine)
+            # 5 rowsets > the tiny ladder's largest P bucket (4)
+            many = [
+                Requirements(
+                    Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN,
+                                [f"kwok-zone-{i % 4 + 1}"]),
+                    Requirement(wk.LABEL_ARCH, Operator.IN,
+                                ["amd64" if i % 2 else "arm64"]),
+                )
+                for i in range(5)
+            ]
+            row_sets = [engine.rows_for(r) for r in many]
+            base = aotrt.stats()["off_ladder_dispatches"]
+            engine.feasibility(
+                row_sets, np.zeros((len(row_sets), len(engine.resource_dims)))
+            )
+            assert aotrt.stats()["off_ladder_dispatches"] > base
+        finally:
+            catmod.FORCE_BACKEND = prev
+
+
+class TestLadderView:
+    def test_debug_kernels_view_ladder(self, clean_aot, tmp_path):
+        aotrt.configure(TINY_LADDER, ExecutableCache(str(tmp_path)))
+        aot.warm_start(small_engine())
+        aotrt.note_off_ladder("feasibility.cube", "2048x4")
+        view = kobs.registry().debug_snapshot(view="ladder")
+        assert view["enabled"] is True
+        assert view["ladder_version"] == lmod.LADDER_VERSION
+        assert [4, 8] in view["ladder"]["feasibility.cube"]
+        assert view["executables"]
+        assert view["off_ladder"]["count"] == 1
+        assert view["off_ladder"]["events"] == [
+            {"kernel": "feasibility.cube", "shape": "2048x4"}
+        ]
+        assert view["cache"]["misses"] > 0
+        # observed buckets flag ladder membership for device dispatches
+        cube_rows = view["observed"].get("feasibility.cube", [])
+        assert any(r.get("on_ladder") for r in cube_rows), cube_rows
+
+    def test_view_when_disabled(self, clean_aot):
+        view = kobs.registry().debug_snapshot(view="ladder")
+        assert view["enabled"] is False
+        assert view["ladder"] == {}
+        assert view["cache"] is None
+
+
+class TestOptionsWiring:
+    def test_cache_dir_implies_default_ladder(self, clean_aot, tmp_path):
+        from karpenter_tpu.operator.options import Options
+
+        aotrt.configure_from_options(
+            Options(compile_cache_dir=str(tmp_path))
+        )
+        assert aotrt.enabled()
+        assert aotrt.active_ladder() is lmod.DEFAULT
+        assert aotrt.active_cache().root == str(tmp_path)
+
+    def test_off_and_default_specs(self, clean_aot, tmp_path):
+        from karpenter_tpu.operator.options import Options
+
+        aotrt.configure_from_options(Options(aot_ladder="off"))
+        assert not aotrt.enabled()
+        aotrt.configure_from_options(Options(aot_ladder="default"))
+        assert aotrt.enabled()
+        assert aotrt.active_cache() is None  # ladder without persistence
+
+    def test_options_parse_flags(self):
+        from karpenter_tpu.operator.options import Options
+
+        opts = Options.parse(
+            ["--compile-cache-dir", "/var/cache/karpenter-aot",
+             "--aot-ladder", "default"],
+            env={},
+        )
+        assert opts.compile_cache_dir == "/var/cache/karpenter-aot"
+        assert opts.aot_ladder == "default"
+
+
+class TestProvisionerWiring:
+    def test_prewarm_walks_ladder_and_registers_offladder_events(
+        self, clean_aot, tmp_path
+    ):
+        """Operator boot with --compile-cache-dir: the first provisioning
+        pass AOT-warm-starts the engine, and off-ladder dispatches publish
+        AOTOffLadderDispatch warning events through the recorder."""
+        from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+        from helpers import nodepool
+
+        ladder_path = tmp_path / "ladder.json"
+        ladder_path.write_text(TINY_LADDER.dumps())
+        base = aotrt.stats()
+        clock = FakeClock()
+        store = Store(clock=clock)
+        operator = Operator(
+            store,
+            KwokCloudProvider(store, clock),
+            clock=clock,
+            options=Options(
+                compile_cache_dir=str(tmp_path / "cache"),
+                aot_ladder=str(ladder_path),
+            ),
+        )
+        assert aotrt.enabled()
+        store.create(nodepool("workers"))
+        operator.run_once()
+        stats = aotrt.stats_delta(base)
+        assert stats["warm_starts"] == 1
+        assert stats["fresh_compiles"] > 0
+        # the off-ladder warning event path is wired through the recorder
+        aotrt.note_off_ladder("feasibility.cube", "4096x8")
+        events = [
+            e for e in operator.recorder.events
+            if e.reason == "AOTOffLadderDispatch"
+        ]
+        assert events and "4096x8" in events[0].message
+        # ladder view serves through the operator's debug surface
+        view = operator.kernel_snapshot(view="ladder")
+        assert view["enabled"] is True
+
+
+class TestSolverdRestartScenario:
+    """The restart-midstream acceptance: the scenario completes
+    deterministically (digest equality across same-seed runs) with no SLO
+    breach, the restart is in the record, and with a cache dir the second
+    process's boots warm-start."""
+
+    TRACE = {
+        "version": 1,
+        "name": "restart-mini",
+        "duration": 120.0,
+        "tick": 1.0,
+        "nodepools": [{"name": "workers"}],
+        "events": [
+            {"at": 2.0, "kind": "submit", "group": "svc", "count": 4,
+             "pod": {"cpu": "1", "memory": "1Gi"}, "replace": True},
+            {"at": 60.0, "kind": "solverd-restart"},
+            {"at": 70.0, "kind": "submit", "group": "post", "count": 3,
+             "pod": {"cpu": "2"}, "replace": True},
+        ],
+    }
+
+    def test_deterministic_and_no_slo_breach(self, clean_aot):
+        from karpenter_tpu.sim.harness import run_scenario
+
+        a = run_scenario(dict(self.TRACE), seed=11)
+        b = run_scenario(dict(self.TRACE), seed=11)
+        assert a.digest == b.digest
+        assert a.report["kernels"]["digest"] == b.report["kernels"]["digest"]
+        assert a.report["faults"]["solverd_restarts"] == 1
+        assert a.report["slo"]["pods_never_bound"] == 0
+        assert a.report["kernels"]["steady_recompiles"] == 0
+        # post-restart demand was actually solved (the restart didn't
+        # strand the operator on a dead solver client)
+        assert a.report["slo"]["pods_bound"] == 7
+
+    def test_fault_profile_survives_the_restart(self, clean_aot):
+        """A trace combining a solver rejection storm with a mid-trace
+        restart: the rebuilt client re-wraps with the SAME flaky profile
+        (continuing the rng stream), so rejections keep landing after the
+        restart and same-seed runs stay byte-identical."""
+        from karpenter_tpu.sim.harness import run_scenario
+
+        trace = dict(self.TRACE)
+        trace["faults"] = {"solver_rejection_rate": 0.5}
+        a = run_scenario(dict(trace), seed=11)
+        b = run_scenario(dict(trace), seed=11)
+        assert a.digest == b.digest
+        assert a.report["faults"]["solver_rejections"] > 0
+        # rejections recorded AFTER the restart prove the wrapper survived
+        restart_t = next(
+            e["t"] for e in a.log if e["ev"] == "solverd-restart"
+        )
+        post = [
+            e for e in a.log
+            if e["ev"] == "fault-solver-reject" and e["t"] > restart_t
+        ]
+        assert post, "no solver rejections after the restart — wrapper lost"
+        assert a.report["slo"]["pods_never_bound"] == 0
+
+    def test_registered_scenario_resolves(self):
+        from karpenter_tpu.sim import scenarios
+
+        trace = scenarios.resolve("solverd-restart", 7)
+        kinds = [e["kind"] for e in trace["events"]]
+        assert "solverd-restart" in kinds
+
+    def test_restart_with_aot_cache_warm_starts(self, clean_aot, tmp_path):
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.sim.harness import run_scenario
+
+        opts = Options(
+            compile_cache_dir=str(tmp_path), aot_ladder="default"
+        )
+        a = run_scenario(dict(self.TRACE), seed=11, options=opts)
+        aot_a = a.report["kernels"]["aot"]
+        # boot + post-restart re-warm both walked the ladder
+        assert aot_a["warm_starts"] == 2, aot_a
+        assert aot_a["fresh_compiles"] > 0
+        assert a.report["slo"]["pods_never_bound"] == 0
+        # a second process (fresh executables + jit caches) boots warm
+        aotrt.clear_executables()
+        jax.clear_caches()
+        b = run_scenario(dict(self.TRACE), seed=11, options=opts)
+        aot_b = b.report["kernels"]["aot"]
+        assert aot_b["fresh_compiles"] == 0, aot_b
+        assert aot_b["cache_hits"] > 0
+        assert a.digest == b.digest
+        assert a.report["kernels"]["digest"] == b.report["kernels"]["digest"]

@@ -39,7 +39,7 @@ class TestRegistryAccounting:
         assert snap["compiles"] == 1
         assert snap["cache_hits"] == 1
         assert snap["recompiles"] == 0
-        assert snap["phases"] == {"warmup": 2, "steady": 0}
+        assert snap["phases"] == {"warmup": 2, "steady": 0, "aot-warm": 0}
         (shape,) = snap["shapes"]
         assert shape["shape"] == "4"
         assert shape["dispatches"] == 2
@@ -87,7 +87,7 @@ class TestSealContract:
             ktime.dispatch(f, jnp.ones((16,)), kernel="spec.seal")
         assert registry.steady_recompiles() == 0
         snap = registry.debug_snapshot("spec.seal")
-        assert snap["phases"] == {"warmup": 1, "steady": 3}
+        assert snap["phases"] == {"warmup": 1, "steady": 3, "aot-warm": 0}
 
     def test_forced_recompile_trips_guard(self, registry):
         @jax.jit

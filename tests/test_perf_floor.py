@@ -137,6 +137,69 @@ class TestPerfFloor:
             f"host={measured['host_samples']}"
         )
 
+    def test_warm_boot_zero_fresh_ladder_compiles(self, tmp_path):
+        """The AOT warm-start floor (ROADMAP item 2 / acceptance): a second
+        boot against a warm persistent cache performs ZERO fresh ladder
+        compiles, asserted via the observatory's aot-warm compile counters
+        — and the steady state it boots into never recompiles. A broken
+        cache (every boot re-compiling) fails this spec the way a silent
+        host fallback fails the throughput floor."""
+        import jax
+        import numpy as np
+
+        from karpenter_tpu import aot
+        from karpenter_tpu.aot import ladder as lmod
+        from karpenter_tpu.aot import runtime as aotrt
+        from karpenter_tpu.aot.cache import ExecutableCache
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.scheduling.requirements import (
+            Operator,
+            Requirement,
+            Requirements,
+        )
+
+        ladder = lmod.make(
+            {"feasibility.cube": [(1, 4), (4, 8)],
+             "catalog.row_compat": [(32,)]}
+        )
+        reg = kobs.registry()
+        reg.reset()
+        aotrt.configure(ladder, ExecutableCache(str(tmp_path)))
+        try:
+            cold = aot.warm_start(CatalogEngine(CATALOG))
+            assert cold["fresh_compiles"] == cold["buckets"] > 0
+            # "second boot": every in-process executable dropped, engine
+            # rebuilt from identical catalog content
+            aotrt.clear_executables()
+            jax.clear_caches()
+            reg.reset()
+            engine = CatalogEngine(construct_instance_types())
+            warm = aot.warm_start(engine)
+            assert warm["fresh_compiles"] == 0, (
+                f"warm boot re-compiled {warm['fresh_compiles']} ladder "
+                f"bucket(s): {warm}"
+            )
+            assert warm["cache_hits"] == warm["buckets"] == cold["buckets"]
+            snap = reg.debug_snapshot()
+            assert all(row["compiles"] == 0 for row in snap["kernels"]), snap
+            # and the warm-booted engine's steady state holds the PR 6
+            # zero-recompile contract
+            rows = engine.rows_for(
+                Requirements(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+            )
+            req = np.zeros((1, len(engine.resource_dims)))
+            engine.feasibility([rows], req)
+            reg.seal()
+            base = reg.steady_recompiles()
+            for _ in range(3):
+                engine.feasibility([rows], req)
+            assert reg.steady_recompiles() == base
+        finally:
+            aotrt.configure(None, None)
+            aotrt.clear_executables()
+            reg.reset()
+
     def test_deliberate_regression_fails_the_floor(self, monkeypatch):
         """Force the regression the floor exists to catch — topo solves
         pushed back onto the host per-pod loop (ffd_topo.supported False) —
