@@ -109,13 +109,21 @@ def make(kernels: dict, version: int = LADDER_VERSION) -> Ladder:
 # sweeps (solverd priming, bench scale) reach hundreds of row-sets over a
 # few dozen distinct rows. Row-batch device dispatches only occur for bulk
 # encodes (catalog.DEVICE_MIN_ROW_BATCH = 32 and up).
+#
+# The 128/256/1024 P rungs are the FRONTIER buckets: a consolidation
+# frontier round primes the whole round's joint row-sets from its largest
+# prefix in ONE sweep, so the union lands between the single-solve bucket
+# (64) and the old top rung — without the intermediate rungs every frontier
+# compute either 8x-overpadded to 512 or, past 512, jit-compiled a shape
+# the AOT walk never prepaid (a steady-state recompile, which the
+# observatory seal treats as a bug).
 DEFAULT = make(
     {
         "feasibility.cube": [
-            (p, r) for p in (1, 8, 64, 512) for r in (4, 16, 64)
+            (p, r) for p in (1, 8, 64, 128, 256, 512, 1024) for r in (4, 16, 64)
         ],
         "feasibility.membership": [
-            (p, r) for p in (1, 8, 64, 512) for r in (4, 16, 64)
+            (p, r) for p in (1, 8, 64, 128, 256, 512, 1024) for r in (4, 16, 64)
         ],
         "catalog.row_compat": [(32,), (64,), (128,)],
         "packer.solve_block": [(8,), (64,), (512,)],

@@ -28,6 +28,10 @@ from karpenter_tpu.scheduling.requirements import Operator, Requirement, Require
 CONSOLIDATION_TTL = 15.0  # seconds (consolidation.go:46)
 MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT = 15  # consolidation.go:49
 
+# sentinel: get_candidate_prices legitimately returns None, so "not
+# provided" needs its own marker for consolidation_decision
+_UNSET = object()
+
 
 class Consolidation:
     """Shared state/machinery for the consolidation-family methods."""
@@ -89,12 +93,38 @@ class Consolidation:
             )
         except CandidateDeletingError:
             return Command()
+        return self.consolidation_decision(list(candidates), results)
+
+    def consolidation_decision(
+        self,
+        candidates: list[Candidate],
+        results,
+        candidate_price=_UNSET,
+        events: Optional[list] = None,
+    ) -> Command:
+        """Everything after the simulation: the simulate-then-price-gate
+        verdict for `candidates` given its scheduling `results`. Split from
+        compute_consolidation so the frontier search can feed many probes'
+        results from one coalesced batch, with `candidate_price` precomputed
+        by the prefix reduction (ops/frontier.PrefixPrices) instead of
+        re-walking the prefix per probe.
+
+        `events`: the frontier evaluates probes the sequential search may
+        never visit; passing a list DEFERS the single-candidate
+        Unconsolidatable events into it as (candidate, message) so the
+        caller publishes exactly the ones the sequential walk would —
+        event-stream parity is part of the decisions-byte-identical
+        contract."""
+
+        def note(candidate: Candidate, message: str) -> None:
+            if events is None:
+                self._unconsolidatable(candidate, message)
+            else:
+                events.append((candidate, message))
 
         if not results.all_non_pending_pods_scheduled():
             if len(candidates) == 1:
-                self._unconsolidatable(
-                    candidates[0], results.non_pending_pod_scheduling_errors()
-                )
+                note(candidates[0], results.non_pending_pod_scheduling_errors())
             return Command()
 
         if len(results.new_node_claims) == 0:
@@ -102,13 +132,14 @@ class Consolidation:
 
         if len(results.new_node_claims) != 1:
             if len(candidates) == 1:
-                self._unconsolidatable(
+                note(
                     candidates[0],
                     f"Can't remove without creating {len(results.new_node_claims)} candidates",
                 )
             return Command()
 
-        candidate_price = get_candidate_prices(candidates)
+        if candidate_price is _UNSET:
+            candidate_price = get_candidate_prices(candidates)
         if candidate_price is None:
             return Command()
 
@@ -123,7 +154,9 @@ class Consolidation:
         if all_spot and replacement.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY).has(
             wk.CAPACITY_TYPE_SPOT
         ):
-            return self._compute_spot_to_spot(candidates, results, candidate_price)
+            return self._compute_spot_to_spot(
+                candidates, results, candidate_price, note
+            )
 
         try:
             replacement.remove_instance_type_options_by_price_and_min_values(
@@ -131,11 +164,11 @@ class Consolidation:
             )
         except ValueError as e:
             if len(candidates) == 1:
-                self._unconsolidatable(candidates[0], f"Filtering by price: {e}")
+                note(candidates[0], f"Filtering by price: {e}")
             return Command()
         if not replacement.instance_type_options:
             if len(candidates) == 1:
-                self._unconsolidatable(candidates[0], "Can't replace with a cheaper node")
+                note(candidates[0], "Can't replace with a cheaper node")
             return Command()
 
         # Prefer spot when both capacity types remain (consolidation.go:216-219)
@@ -152,12 +185,15 @@ class Consolidation:
             results=results,
         )
 
-    def _compute_spot_to_spot(self, candidates, results, candidate_price) -> Command:
+    def _compute_spot_to_spot(self, candidates, results, candidate_price, note=None) -> Command:
         """consolidation.go:229-301: spot→spot needs the feature gate and ≥15
         cheaper types (single-candidate case) to avoid flapping."""
+        if note is None:
+            def note(candidate, message):
+                self._unconsolidatable(candidate, message)
         if not self.spot_to_spot_enabled:
             if len(candidates) == 1:
-                self._unconsolidatable(
+                note(
                     candidates[0],
                     "SpotToSpotConsolidation is disabled, can't replace a spot node with a spot node",
                 )
@@ -179,11 +215,11 @@ class Consolidation:
             )
         except ValueError as e:
             if len(candidates) == 1:
-                self._unconsolidatable(candidates[0], f"Filtering by price: {e}")
+                note(candidates[0], f"Filtering by price: {e}")
             return Command()
         if not replacement.instance_type_options:
             if len(candidates) == 1:
-                self._unconsolidatable(candidates[0], "Can't replace with a cheaper node")
+                note(candidates[0], "Can't replace with a cheaper node")
             return Command()
         if len(candidates) > 1:
             return Command(
@@ -192,7 +228,7 @@ class Consolidation:
                 results=results,
             )
         if len(replacement.instance_type_options) < MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT:
-            self._unconsolidatable(
+            note(
                 candidates[0],
                 f"SpotToSpotConsolidation requires {MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT} "
                 f"cheaper instance type options than the current candidate to consolidate, "

@@ -123,9 +123,11 @@ class Controller:
             return self._revalidate_pending()
         # Clean leftover disruption taints/conditions from restarts or
         # abandoned commands (controller.go:131-152).
+        # view, not copies: both cleanup helpers act through the store by
+        # name and only read the StateNodes
         outdated = [
             n
-            for n in self.cluster.state_nodes()
+            for n in self.cluster.state_nodes_view()
             if not self.queue.has_any(n.provider_id()) and not n.is_marked_for_deletion()
         ]
         require_no_schedule_taint(self.store, False, *outdated)
@@ -133,9 +135,11 @@ class Controller:
 
         from karpenter_tpu.solverd import SolverRejection, TransportError
 
+        # candidate bases shared by this pass's methods (helpers.get_candidates)
+        pass_cache: dict = {}
         for method in self.methods:
             try:
-                if self._disrupt(method):
+                if self._disrupt(method, pass_cache):
                     return True
             except (SolverRejection, TransportError) as e:
                 # The solver shed our simulations (or the sidecar is down):
@@ -166,7 +170,7 @@ class Controller:
         self.queue.start_command(cmd)
         return True
 
-    def _disrupt(self, method) -> bool:
+    def _disrupt(self, method, pass_cache: Optional[dict] = None) -> bool:
         """controller.go:169-206."""
         labels = {
             "reason": method.reason().lower(),
@@ -182,6 +186,8 @@ class Controller:
                 method.should_disrupt,
                 method.disruption_class(),
                 self.queue,
+                pass_cache=pass_cache,
+                node_prefilter=getattr(method, "node_prefilter", None),
             )
             _ELIGIBLE_NODES.set(
                 float(len(candidates)), {"reason": method.reason().lower()}

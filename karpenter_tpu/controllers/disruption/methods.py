@@ -11,6 +11,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+from karpenter_tpu import tracing
 from karpenter_tpu.apis.nodepool import (
     DISRUPTION_REASON_DRIFTED,
     DISRUPTION_REASON_EMPTY,
@@ -19,6 +20,7 @@ from karpenter_tpu.apis.nodepool import (
 from karpenter_tpu.controllers.disruption.consolidation import Consolidation
 from karpenter_tpu.controllers.disruption.helpers import (
     CandidateDeletingError,
+    FrontierSimulator,
     simulate_scheduling,
 )
 from karpenter_tpu.controllers.disruption.types import (
@@ -50,6 +52,46 @@ _CONSOLIDATION_TIMEOUTS = global_registry.counter(
 MAX_PARALLEL_CONSOLIDATION = 100  # multinodeconsolidation.go:85-87
 
 
+def _frontier_depth(c: Consolidation) -> int:
+    """The configured speculation depth (--consolidation-frontier-depth),
+    floored at 1 — depth 1 IS the sequential probe order, still riding the
+    shared frontier context."""
+    from karpenter_tpu.ops import frontier as ftr
+
+    return max(
+        1,
+        int(
+            getattr(
+                c.provisioner.options,
+                "consolidation_frontier_depth",
+                ftr.DEFAULT_DEPTH,
+            )
+        ),
+    )
+
+
+# frontier-search telemetry: a "round" is one coalesced simulate batch, a
+# "probe" one prefix simulation inside it. rounds x batch-size vs the
+# sequential log2(N) is the whole point — these are the series that prove it
+_FRONTIER_ROUNDS = global_registry.histogram(
+    "karpenter_consolidation_frontier_rounds",
+    "coalesced simulate-batch rounds per consolidation compute",
+    labels=["consolidation_type"],
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16),
+)
+_FRONTIER_PROBES = global_registry.counter(
+    "karpenter_consolidation_frontier_probes_total",
+    "prefix/candidate probes simulated by the consolidation frontier search",
+    labels=["consolidation_type"],
+)
+_FRONTIER_BATCH_SIZE = global_registry.histogram(
+    "karpenter_consolidation_frontier_batch_size",
+    "probes per coalesced frontier round",
+    labels=["consolidation_type"],
+    buckets=(1, 2, 3, 7, 15, 31, 63),
+)
+
+
 class Emptiness:
     """Delete nodes with no reschedulable pods (emptiness.go)."""
 
@@ -77,6 +119,9 @@ class Emptiness:
         )
 
     def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        # defensive copy: budgets decrement as empties are admitted; the
+        # caller's mapping must survive a retry of the same pass untouched
+        budgets = dict(budgets)
         if self.c.is_consolidated():
             return Command()
         candidates = self.c.sort_candidates(list(candidates))
@@ -120,6 +165,14 @@ class Drift:
 
     def should_disrupt(self, candidate: Candidate) -> bool:
         return candidate.node_claim.condition_is_true(self.reason())
+
+    def node_prefilter(self, node) -> bool:
+        """Drift is decidable from the claim condition alone — skip the full
+        candidate build (PDB walks, cost model) for the typical cluster
+        where nothing has drifted. Strict superset of should_disrupt."""
+        return node.node_claim is not None and node.node_claim.condition_is_true(
+            self.reason()
+        )
 
     def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
         def drift_time(c: Candidate) -> float:
@@ -176,6 +229,11 @@ class MultiNodeConsolidation:
         return self.c.should_disrupt(candidate)
 
     def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        # defensive copy: the filter below decrements per-pool budgets as it
+        # admits candidates, and the caller's mapping must stay pristine —
+        # a shed/timeout retry of the same pass re-enters with the SAME dict
+        # and would otherwise see pre-decremented budgets
+        budgets = dict(budgets)
         if self.c.is_consolidated():
             return Command()
         candidates = self.c.sort_candidates(list(candidates))
@@ -201,13 +259,126 @@ class MultiNodeConsolidation:
     def _first_n_consolidation_option(
         self, candidates: list[Candidate], max_n: int
     ) -> Command:
-        """multinodeconsolidation.go:117-170.
+        """The device-resident frontier search. Each round evaluates every
+        probe the sequential binary search (_first_n_sequential, the
+        reference port and parity oracle) could visit in its next `depth`
+        verdicts — one speculative level-set of its decision tree — as ONE
+        frontier-tagged solverd batch: the coalescer fuses the k prefix
+        simulations' joint-mask sweeps into a single device pass primed from
+        the largest prefix, and every probe's scheduler stamps from the
+        round's shared cluster view (FrontierSimulator) instead of
+        rebuilding it. The host then walks `depth` verdicts of the tree,
+        updating (lo, hi, last_saved) exactly as the sequential loop would —
+        the probe set being the decision tree's own level-set is what makes
+        the walk reproduce the sequential search's probe sequence, and
+        therefore its decision, bit for bit with no monotonicity assumption.
+        Rounds: ceil(log2(N)/depth) batches instead of log2(N) sequential
+        simulations. Per-prefix candidate prices and the
+        replace-cheaper-than-cheapest gate come from the prefix reductions
+        (ops/frontier) computed once per compute instead of once per probe."""
+        if len(candidates) < 2:
+            return Command()
+        from karpenter_tpu.ops import frontier as ftr
 
-        Each probe is a full scheduling simulation; consecutive probes share
-        the engine's interned requirement rows and feasibility masks, so
-        after the first simulation the device work per probe is just the
-        joint sets the previous probes haven't seen — the binary search
-        itself stays sequential (each bound depends on the last verdict)."""
+        depth = _frontier_depth(self.c)
+        sim = FrontierSimulator(self.c.store, self.c.cluster, self.c.provisioner)
+        prices = ftr.PrefixPrices(candidates)
+        floors = ftr.PrefixTypeFloors(candidates)
+        lo_n, hi_n = 1, min(max_n, len(candidates) - 1)
+        last_saved = Command()
+        deadline = self.c.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT
+        tracer = tracing.tracer()
+        rounds = 0
+        while lo_n <= hi_n:
+            # the 60s cap holds between frontier rounds: a mid-search
+            # timeout returns the best command validated so far, exactly
+            # like the sequential loop's per-probe check
+            if self.c.clock.now() > deadline:
+                _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "multi"})
+                if rounds:
+                    _FRONTIER_ROUNDS.observe(
+                        float(rounds), {"consolidation_type": "multi"}
+                    )
+                return last_saved
+            rounds += 1
+            probes = ftr.speculative_probes(lo_n, hi_n, depth)
+            with tracer.span(
+                "consolidation.frontier",
+                consolidation_type="multi",
+                round=rounds,
+                lo=lo_n,
+                hi=hi_n,
+                probes=len(probes),
+            ):
+                plans = {mid: sim.plan(candidates[: mid + 1]) for mid in probes}
+                sim.solve_batch(list(plans.values()))
+            _FRONTIER_PROBES.inc(
+                {"consolidation_type": "multi"}, float(len(probes))
+            )
+            _FRONTIER_BATCH_SIZE.observe(
+                float(len(probes)), {"consolidation_type": "multi"}
+            )
+            for _ in range(depth):
+                if lo_n > hi_n:
+                    break
+                mid = (lo_n + hi_n) // 2
+                cmd = self._probe_verdict(plans[mid], candidates, mid, prices)
+                ok = cmd.decision() == DECISION_DELETE
+                if cmd.decision() == DECISION_REPLACE:
+                    ok = self._replace_gate(cmd, mid, floors)
+                if ok:
+                    last_saved = cmd
+                    lo_n = mid + 1
+                else:
+                    hi_n = mid - 1
+        _FRONTIER_ROUNDS.observe(float(rounds), {"consolidation_type": "multi"})
+        return last_saved
+
+    def _probe_verdict(self, plan, candidates, mid, prices) -> Command:
+        """One walked probe's Command. Errors surface with sequential
+        semantics: a deleting candidate is a no-op Command
+        (compute_consolidation's CandidateDeletingError catch); anything
+        else — solver rejection, transport failure — raises, but only for
+        probes the walk actually reaches, since the sequential search never
+        ran the speculative ones."""
+        if isinstance(plan.error, CandidateDeletingError):
+            return Command()
+        if plan.error is not None:
+            raise plan.error
+        return self.c.consolidation_decision(
+            candidates[: mid + 1],
+            plan.results,
+            candidate_price=prices.for_prefix(mid + 1),
+        )
+
+    @staticmethod
+    def _replace_gate(cmd: Command, mid: int, floors) -> bool:
+        """The replace-cheaper-than-cheapest price gate with the prefix
+        reduction's per-type floors standing in for _filter_out_same_type's
+        per-probe rescan — byte-identical verdicts (same price cap, same
+        remove call), O(1) per probe after the one-pass reduction."""
+        replacement = cmd.replacements[0]
+        max_price = floors.max_price(
+            mid + 1,
+            [it.name for it in replacement.node_claim.instance_type_options],
+        )
+        try:
+            replacement.node_claim.remove_instance_type_options_by_price_and_min_values(
+                replacement.node_claim.requirements, max_price
+            )
+        except ValueError:
+            return False
+        return bool(replacement.node_claim.instance_type_options)
+
+    def _first_n_sequential(
+        self, candidates: list[Candidate], max_n: int
+    ) -> Command:
+        """multinodeconsolidation.go:117-170 — the reference's sequential
+        binary search, verbatim: one full scheduling simulation per probe,
+        each bound waiting on the last verdict. Kept as the parity oracle
+        the frontier search is fuzzed against (tests/test_frontier.py): the
+        frontier must select the same command on every seeded candidate
+        set."""
         if len(candidates) < 2:
             return Command()
         lo_n, hi_n = 1, min(max_n, len(candidates) - 1)
@@ -283,32 +454,114 @@ class SingleNodeConsolidation:
         return self.c.should_disrupt(candidate)
 
     def compute_command(self, budgets: dict[str, int], *candidates: Candidate) -> Command:
+        """The cheapest-first walk, with the per-candidate simulations run
+        as speculative look-ahead chunks through the frontier batch path:
+        the next w sim-eligible candidates simulate as ONE coalesced solverd
+        group, then the walk consumes verdicts in candidate order and
+        returns at the first non-noop exactly like the sequential loop.
+        Verdict events (single-candidate Unconsolidatable messages) are
+        DEFERRED at simulation time and published only for candidates the
+        walk actually reaches — a speculative probe past the winner must
+        leave no trace in the event stream."""
+        # defensive copy (same contract as MultiNodeConsolidation): the
+        # caller's budget mapping survives this pass untouched
+        budgets = dict(budgets)
         if self.c.is_consolidated():
             return Command()
         candidates = self.sort_candidates(list(candidates))
         deadline = self.c.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
         constrained = False
         unseen = {c.node_pool.metadata.name for c in candidates}
-        for i, candidate in enumerate(candidates):
-            if self.c.clock.now() > deadline:
-                _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "single"})
-                self.previously_unseen_nodepools = unseen
-                return Command()
-            unseen.discard(candidate.node_pool.metadata.name)
-            if budgets.get(candidate.node_pool.metadata.name, 0) == 0:
-                constrained = True
-                continue
-            if not candidate.reschedulable_pods:
-                continue
-            cmd = self.c.compute_consolidation(candidate)
-            if cmd.decision() == DECISION_NOOP:
-                continue
-            # Unvalidated: two-phase validation happens in the controller.
-            return cmd
-        if not constrained:
-            self.c.mark_consolidated()
-        self.previously_unseen_nodepools = unseen
-        return Command()
+        sim: Optional[FrontierSimulator] = None
+        tracer = tracing.tracer()
+        width = (1 << _frontier_depth(self.c)) - 1
+        # candidate index -> (command, deferred events, error)
+        verdicts: dict[int, tuple] = {}
+        rounds = 0
+
+        def eligible(c: Candidate) -> bool:
+            return (
+                budgets.get(c.node_pool.metadata.name, 0) != 0
+                and bool(c.reschedulable_pods)
+            )
+
+        def ensure_verdict(start: int) -> None:
+            nonlocal sim, rounds
+            batch = []
+            for j in range(start, len(candidates)):
+                if len(batch) >= width:
+                    break
+                if j not in verdicts and eligible(candidates[j]):
+                    batch.append(j)
+            if not batch:
+                return
+            if sim is None:
+                sim = FrontierSimulator(
+                    self.c.store, self.c.cluster, self.c.provisioner
+                )
+            rounds += 1
+            with tracer.span(
+                "consolidation.frontier",
+                consolidation_type="single",
+                round=rounds,
+                probes=len(batch),
+            ):
+                plans = {j: sim.plan([candidates[j]]) for j in batch}
+                # disjoint candidates, not nested prefixes: every member's
+                # row-sets must be collected for the shared prime
+                sim.solve_batch(list(plans.values()), nested=False)
+            _FRONTIER_PROBES.inc(
+                {"consolidation_type": "single"}, float(len(batch))
+            )
+            _FRONTIER_BATCH_SIZE.observe(
+                float(len(batch)), {"consolidation_type": "single"}
+            )
+            for j, plan in plans.items():
+                if isinstance(plan.error, CandidateDeletingError):
+                    verdicts[j] = (Command(), [], None)
+                elif plan.error is not None:
+                    verdicts[j] = (None, [], plan.error)
+                else:
+                    events: list = []
+                    cmd = self.c.consolidation_decision(
+                        [candidates[j]], plan.results, events=events
+                    )
+                    verdicts[j] = (cmd, events, None)
+
+        try:
+            for i, candidate in enumerate(candidates):
+                if self.c.clock.now() > deadline:
+                    _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "single"})
+                    self.previously_unseen_nodepools = unseen
+                    return Command()
+                unseen.discard(candidate.node_pool.metadata.name)
+                if budgets.get(candidate.node_pool.metadata.name, 0) == 0:
+                    constrained = True
+                    continue
+                if not candidate.reschedulable_pods:
+                    continue
+                if i not in verdicts:
+                    ensure_verdict(i)
+                cmd, events, error = verdicts.pop(i)
+                for target, message in events:
+                    self.c._unconsolidatable(target, message)
+                if error is not None:
+                    # surfaced only when the walk reaches it — sequential
+                    # semantics (the speculative siblings never ran there)
+                    raise error
+                if cmd.decision() == DECISION_NOOP:
+                    continue
+                # Unvalidated: two-phase validation happens in the controller.
+                return cmd
+            if not constrained:
+                self.c.mark_consolidated()
+            self.previously_unseen_nodepools = unseen
+            return Command()
+        finally:
+            if rounds:
+                _FRONTIER_ROUNDS.observe(
+                    float(rounds), {"consolidation_type": "single"}
+                )
 
     def sort_candidates(self, candidates: list[Candidate]) -> list[Candidate]:
         """Cost-sorted, round-robin interleaved across nodepools with unseen

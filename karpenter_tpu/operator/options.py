@@ -71,6 +71,12 @@ class Options:
     solver_daemon_address: str = ""  # "host:port" or unix socket path
     solverd_queue_depth: int = 256  # admission queue depth (shed past it)
     solverd_coalesce_window: float = 0.0  # seconds the batch leader waits
+    # consolidation frontier search (controllers/disruption + ops/frontier):
+    # how many levels of the binary-search decision tree one coalesced
+    # simulate batch evaluates speculatively. 1 = the sequential probe
+    # order (still batched per round of one); higher trades speculative
+    # simulations for fewer rounds — decisions are identical at any depth.
+    consolidation_frontier_depth: int = 2
 
     # AOT compile service (karpenter_tpu/aot): compile_cache_dir points at
     # the persistent on-disk executable cache (restarts warm-start from it);
@@ -134,6 +140,7 @@ class Options:
         parser.add_argument("--solver-daemon-address")
         parser.add_argument("--solverd-queue-depth", type=int)
         parser.add_argument("--solverd-coalesce-window", type=float)
+        parser.add_argument("--consolidation-frontier-depth", type=int)
         parser.add_argument("--compile-cache-dir")
         parser.add_argument("--aot-ladder")
         parser.add_argument("--tracing-sample-rate", type=float)

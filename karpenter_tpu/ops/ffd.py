@@ -521,6 +521,43 @@ class _Node:
         self.gcap: dict[int, tuple[int, int]] = {}  # gi -> (usage_ver, k_left)
 
 
+class _LazyNodes:
+    """Sequence facade over the scheduler's ExistingNodes that materializes
+    _Node wrappers on first touch. The monotone FFD scan (_try_nodes) only
+    ever reads a prefix of the node order — consolidation simulations pack
+    a few hundred pods into the first handful of nodes — so building all
+    ~1k wrappers up front was the single largest steady-state solve cost at
+    frontier scale. Full iteration (the topo driver's volatile scans, abort
+    snapshots) materializes everything, preserving exact semantics;
+    `materialized()` exposes only touched wrappers for emit, where an
+    untouched node is by construction join-free."""
+
+    __slots__ = ("_ens", "_built")
+
+    def __init__(self, existing_nodes):
+        self._ens = existing_nodes
+        self._built: list = [None] * len(existing_nodes)
+
+    def __len__(self) -> int:
+        return len(self._built)
+
+    def __bool__(self) -> bool:
+        return bool(self._built)
+
+    def __getitem__(self, i: int) -> "_Node":
+        nd = self._built[i]
+        if nd is None:
+            nd = self._built[i] = _Node(self._ens[i])
+        return nd
+
+    def __iter__(self):
+        for i in range(len(self._built)):
+            yield self[i]
+
+    def materialized(self):
+        return (nd for nd in self._built if nd is not None)
+
+
 class _Fallback(Exception):
     """Internal: abort the device solve and use the host loop."""
 
@@ -823,7 +860,7 @@ class _DeviceSolve:
         self.U = self.uniq_alloc.shape[0]
         self.groups: list[_Group] = []
         self.claims: list[_Claim] = []
-        self.nodes = [_Node(en) for en in scheduler.existing_nodes]
+        self.nodes = _LazyNodes(scheduler.existing_nodes)
         self.seq = 0  # bucket-entry counter for the stable-sort order model
         # joint requirement-set masks: frozenset(row ids) -> (compat, offer).
         # Shared on the ENGINE across solves: steady-state provisioner
@@ -2016,7 +2053,9 @@ class _DeviceSolve:
         from karpenter_tpu.scheduler.nodeclaim import NodeClaim as SchedNodeClaim
 
         s = self.s
-        for nd in self.nodes:
+        # only touched wrappers can have joins; untouched nodes need no
+        # materialization just to skip them
+        for nd in self.nodes.materialized():
             if not nd.joined:
                 continue
             en = nd.en
@@ -2214,6 +2253,24 @@ def collect_joint_rowsets(scheduler, pods: Sequence[Pod]) -> list[tuple]:
         ]
     except Exception:  # noqa: BLE001 — priming is best-effort, never fatal
         return []
+
+
+def collect_prefix_rowsets(schedulers_pods: Sequence[tuple]) -> list[tuple]:
+    """Prefix-mask variant of collect_joint_rowsets for frontier groups:
+    the k solves of a consolidation frontier round simulate nested prefixes
+    of one candidate order, so their pod sets nest — every shape group (and
+    therefore every joint (template x group) row-set) of a smaller prefix
+    appears in the largest one. Collecting from the largest member alone
+    yields the union the per-member loop would, for one prefix's worth of
+    grouping work, and the single prime_joint_masks sweep that follows is
+    the one feasibility pass all k prefixes share. Under-collection is
+    impossible for nested inputs and harmless otherwise: priming only warms
+    the joint cache — a solve whose pair wasn't primed computes it exactly,
+    host-side, on demand."""
+    if not schedulers_pods:
+        return []
+    scheduler, pods = max(schedulers_pods, key=lambda sp: len(sp[1]))
+    return collect_joint_rowsets(scheduler, pods)
 
 
 def prime_joint_masks(engine: "CatalogEngine", pairs: Sequence[tuple]) -> int:

@@ -289,8 +289,7 @@ class _TopoSolve(_DeviceSolve):
         ]
         self._hostname_tgs = bool(self._hn_tgs)
         self._saved_topology: Optional[tuple] = None
-        self._saved_node_hp: list[tuple] = []
-        self._saved_node_vols: list[tuple] = []
+        self._saved_node_usage: list[tuple] = []
         self._relax_restore: dict[str, Pod] = {}
         self._aborted = False
         self._scan = _ScanOrder()
@@ -592,17 +591,12 @@ class _TopoSolve(_DeviceSolve):
             for tg in self._hn_tgs
             for d in tg.domains
         )
-        # port/volume joins on existing nodes mutate the SHARED state_node
-        # usage; a fallback must not leave phantom entries behind
-        if self._any_ports:
-            self._saved_node_hp = [
-                (nd.en.state_node, nd.en.state_node.hostport_usage.copy())
-                for nd in self.nodes
-            ]
-        if self._any_volumes:
-            self._saved_node_vols = [
-                (nd.en.state_node, nd.en.state_node.volume_usage.copy())
-                for nd in self.nodes
+        # port/volume joins fork usage onto the ExistingNode (copy-on-write
+        # — the StateNode itself is never written); a fallback must still
+        # not leave phantom fork entries behind for the host loop to read
+        if self._any_ports or self._any_volumes:
+            self._saved_node_usage = [
+                (nd.en, nd.en.usage_snapshot()) for nd in self.nodes
             ]
 
     def abort(self) -> None:
@@ -615,10 +609,8 @@ class _TopoSolve(_DeviceSolve):
         topo = self.topology
         if self._saved_topology is not None:
             topo.restore_counts(self._saved_topology)
-        for sn, usage in self._saved_node_hp:
-            sn.hostport_usage = usage
-        for sn, usage in self._saved_node_vols:
-            sn.volume_usage = usage
+        for en, usage in self._saved_node_usage:
+            en.restore_usage(usage)
         for orig in self._relax_restore.values():
             topo.update(orig)
             self.s.update_cached_pod_data(orig)
@@ -729,10 +721,10 @@ class _TopoSolve(_DeviceSolve):
                 continue
             if (
                 vols is not None
-                and nd.en.state_node.volume_usage.exceeds_limits(vols) is not None
+                and nd.en.volume_usage.exceeds_limits(vols) is not None
             ):
                 continue
-            if gp and nd.en.state_node.hostport_usage.conflicts(pod, gp) is not None:
+            if gp and nd.en.hostport_usage.conflicts(pod, gp) is not None:
                 continue
             kc = nd.gcap.get(gi)
             if kc is None or kc[0] != nd.usage_ver:
@@ -768,9 +760,11 @@ class _TopoSolve(_DeviceSolve):
             nd.usage_ver += 1
             topo.record(pod, nd.en.cached_taints, joint)
             if gp:
-                nd.en.state_node.hostport_usage.add(pod, gp)
+                nd.en.fork_usage()
+                nd.en.hostport_usage.add(pod, gp)
             if vols is not None:
-                nd.en.state_node.volume_usage.add(pod, vols)
+                nd.en.fork_usage()
+                nd.en.volume_usage.add(pod, vols)
             return True
         return False
 

@@ -196,12 +196,17 @@ class Scheduler:
         reserved_offering_mode: str = RESERVED_OFFERING_MODE_FALLBACK,
         reserved_capacity_enabled: bool = True,
         engine=None,
+        node_prototypes=None,
     ):
         self.store = store
         self.cluster = cluster
         self.topology = topology
         self.recorder = recorder
         self.clock = clock
+        # shared per-node statics for repeated scheduler builds over one
+        # cluster view (consolidation frontier probes); see
+        # existingnode.build_node_prototypes
+        self.node_prototypes = node_prototypes
         self.preference_policy = preference_policy
         self.min_values_policy = min_values_policy
         self.reserved_offering_mode = reserved_offering_mode
@@ -275,33 +280,54 @@ class Scheduler:
         self, state_nodes: Sequence[StateNode], daemonset_pods: Sequence[Pod]
     ) -> None:
         """Existing nodes participate with their unaccounted daemon overhead;
-        their capacity counts against nodepool limits (scheduler.go:559-587)."""
+        their capacity counts against nodepool limits (scheduler.go:559-587).
+
+        With `node_prototypes` (the consolidation frontier's shared statics,
+        existingnode.build_node_prototypes), nodes stamp from their
+        prototype instead of re-deriving taints/requirements/daemon headroom
+        — identity-checked against the StateNode so a stale prototype map
+        can only ever fall back to the full path, never serve wrong data."""
         for node in state_nodes:
-            taints = node.taints()
-            daemons = []
-            if daemonset_pods:
-                node_taints = Taints(taints)
-                node_reqs = Requirements.from_labels(node.labels())
-                for p in daemonset_pods:
-                    if node_taints.tolerates_pod(p) is not None:
-                        continue
-                    if not node_reqs.is_compatible(strict_pod_requirements(p)):
-                        continue
-                    daemons.append(p)
-            self.existing_nodes.append(
-                ExistingNode(
-                    node,
-                    self.topology,
-                    taints,
-                    res.merge(*(pod_resource_requests(p) for p in daemons)),
-                )
+            proto = (
+                self.node_prototypes.get(node.name())
+                if self.node_prototypes
+                else None
             )
-            pool_name = node.labels().get(wk.NODEPOOL_LABEL_KEY, "")
-            if pool_name in self.remaining_resources:
-                self.remaining_resources[pool_name] = res.subtract(
-                    self.remaining_resources[pool_name], node.capacity()
+            if proto is not None and proto.state_node is node:
+                self.existing_nodes.append(
+                    ExistingNode.from_prototype(proto, self.topology)
                 )
-        self.existing_nodes.sort(key=lambda n: (not n.initialized(), n.name()))
+                pool_name = proto.pool_name
+                capacity = proto.capacity
+            else:
+                taints = node.taints()
+                daemons = []
+                if daemonset_pods:
+                    node_taints = Taints(taints)
+                    node_reqs = Requirements.from_labels(node.labels())
+                    for p in daemonset_pods:
+                        if node_taints.tolerates_pod(p) is not None:
+                            continue
+                        if not node_reqs.is_compatible(strict_pod_requirements(p)):
+                            continue
+                        daemons.append(p)
+                self.existing_nodes.append(
+                    ExistingNode(
+                        node,
+                        self.topology,
+                        taints,
+                        res.merge(*(pod_resource_requests(p) for p in daemons)),
+                    )
+                )
+                pool_name = node.labels().get(wk.NODEPOOL_LABEL_KEY, "")
+                capacity = node.capacity()
+            # subtract() keeps LHS keys only, so a pool with no limits ({})
+            # is a fixed point — skip the per-node call for it
+            if self.remaining_resources.get(pool_name):
+                self.remaining_resources[pool_name] = res.subtract(
+                    self.remaining_resources[pool_name], capacity
+                )
+        self.existing_nodes.sort(key=ExistingNode.sort_key)
 
     def update_cached_pod_data(self, p: Pod) -> None:
         if self.preference_policy == PREFERENCE_POLICY_IGNORE:

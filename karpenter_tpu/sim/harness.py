@@ -199,6 +199,10 @@ class Simulation:
         from karpenter_tpu.observability import kernels as kobs
 
         self._kernels_base = kobs.registry().counts_snapshot()
+        # consolidation frontier counters (methods.py): snapshot for
+        # per-run deltas — rounds/probes/coalesced groups are scenario
+        # facts and belong in the deterministic report surface
+        self._frontier_base = self._frontier_snapshot()
         # AOT compile-service traffic (cache hits/misses, fresh compiles,
         # off-ladder dispatches): snapshotted so the report carries this
         # run's deltas; the section rides OUTSIDE the kernels digest — a
@@ -308,12 +312,35 @@ class Simulation:
             from karpenter_tpu.aot import runtime as aotrt
 
             report["kernels"]["aot"] = aotrt.stats_delta(self._aot_base)
+            # consolidation frontier search: this run's rounds/probes per
+            # consolidation type plus the solverd frontier groups that
+            # coalesced — deterministic (decision-path) facts
+            snap = self._frontier_snapshot()
+            report["frontier"] = {
+                key: round(snap[key] - self._frontier_base[key], 6)
+                for key in snap
+            }
             self.tracer.close()  # flush the JSONL export, if any
             return SimResult(report=report, digest=self.log.digest(), log=self.log)
         finally:
             catmod.PINNED_RTT = pinned_prev
             apicore.set_uid_source(None)
             self.clock.disable_blocking_sleep()
+
+    @staticmethod
+    def _frontier_snapshot() -> dict:
+        from karpenter_tpu.controllers.disruption import methods as dmethods
+        from karpenter_tpu.solverd import coalescer as dcoal
+
+        out = {}
+        for ctype in ("multi", "single"):
+            labels = {"consolidation_type": ctype}
+            out[f"{ctype}_rounds"] = float(
+                dmethods._FRONTIER_ROUNDS.count(labels)
+            )
+            out[f"{ctype}_probes"] = dmethods._FRONTIER_PROBES.value(labels)
+        out["coalesced_groups"] = dcoal._FRONTIER_GROUPS.value()
+        return out
 
     def _solver_stats(self) -> dict:
         stats = dict(self.operator.solver_stats())
@@ -328,9 +355,16 @@ class Simulation:
     # -- trace events --------------------------------------------------------
 
     def _nodepool(self, spec: dict) -> NodePool:
+        from karpenter_tpu.apis.nodepool import Budget
+
         np_ = NodePool(metadata=ObjectMeta(name=spec["name"]))
         np_.spec.template.spec.requirements = list(spec.get("requirements", []))
         np_.spec.disruption.consolidate_after = spec.get("consolidate_after", 15.0)
+        if spec.get("budgets"):
+            # e.g. [{"nodes": "100%"}] — the default 10% budget caps
+            # disruption at ONE node on small simulated fleets, which
+            # forces every consolidation through the single-node path
+            np_.spec.disruption.budgets = [Budget(**b) for b in spec["budgets"]]
         if spec.get("limits"):
             np_.spec.limits = parse_resource_list(spec["limits"])
         np_.set_condition("Ready", "True")

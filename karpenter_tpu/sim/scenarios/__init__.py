@@ -76,3 +76,8 @@ register(
     tracemod.solverd_restart,
     "solver daemon restarts mid-trace; warm-starts from the AOT cache when configured",
 )
+register(
+    "consolidation-churn",
+    tracemod.consolidation_churn,
+    "fan-out waves drain into underutilized fleets; multi-node frontier consolidation folds them",
+)

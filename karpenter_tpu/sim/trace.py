@@ -243,6 +243,64 @@ def tpu_training(rng: Random) -> dict:
     return trace
 
 
+def consolidation_churn(rng: Random) -> dict:
+    """The consolidation-heavy shape the frontier search exists for: waves
+    of large short-lived pods fan the cluster out to many nodes, each
+    wave leaving behind a residue of small long-running pods — so after a
+    wave drains, the fleet is many barely-utilized (non-empty) nodes that
+    only MULTI-node consolidation can fold together. Two full
+    fan-out/drain/consolidate cycles, no faults: the event digest is a pure
+    function of the frontier search's decisions."""
+    duration = 540.0
+    trace = _base("consolidation-churn", duration=duration, tick=2.0)
+    # pin the pool to 4-cpu boxes: a 3-cpu fanout pod then owns a node, so
+    # a drained wave strands its residue across MANY small nodes — the
+    # multi-node shape. (On the default catalog the packer would fold the
+    # whole wave onto a couple of 16x machines and consolidation would
+    # never see a multi-node fleet.)
+    trace["nodepools"][0]["requirements"] = [
+        {
+            "key": "karpenter.kwok.sh/instance-size",
+            "operator": "In",
+            "values": ["4x"],
+        }
+    ]
+    # let consolidation act on the whole drained fleet at once — the
+    # default 10% budget admits one node on a fleet this size, which would
+    # push everything through the single-node path
+    trace["nodepools"][0]["budgets"] = [{"nodes": "100%"}]
+    events = []
+    for cycle in range(2):
+        start = 6.0 + cycle * 240.0
+        spreaders = 8 + rng.randrange(4)
+        # the fan-out: one fat pod per node, gone after ~100s
+        events.append(
+            {
+                "at": round(start, 3),
+                "kind": "submit",
+                "group": f"fanout-{cycle}",
+                "count": spreaders,
+                "pod": {"cpu": "3", "memory": "4Gi"},
+                "until": round(start + 90.0 + rng.randrange(20), 3),
+                "replace": False,
+            }
+        )
+        # the residue: small long-running pods left stranded one-per-node,
+        # keeping the drained nodes non-empty (underutilized, not empty)
+        events.append(
+            {
+                "at": round(start + 2.0, 3),
+                "kind": "submit",
+                "group": f"residue-{cycle}",
+                "count": spreaders,
+                "pod": {"cpu": "200m", "memory": "256Mi"},
+                "replace": True,
+            }
+        )
+    trace["events"] = sorted(events, key=lambda e: e["at"])
+    return trace
+
+
 def solverd_restart(rng: Random) -> dict:
     """Service load with the solver daemon restarting mid-trace — the
     rolling-upgrade path: steady demand establishes a warm solver, the

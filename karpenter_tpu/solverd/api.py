@@ -62,7 +62,16 @@ class SolveRequest:
     transports — the in-process path passes it through, the socket path
     puts the same fields in the JSON frame. Context must live on the
     request, not ambient state: a coalesced batch executes many callers'
-    requests on one leader thread."""
+    requests on one leader thread.
+
+    `group` tags requests submitted together as one structured batch — the
+    consolidation frontier search tags each round's probes with one group
+    id. `group_nested` declares the group's pod sets are nested prefixes
+    (multi-node frontier rounds): the coalescer then primes the group's
+    joint masks from its LARGEST member only, whose row-sets cover the
+    whole group. Disjoint groups (single-node rounds) leave it False and
+    collect per member — largest-member priming would skip the siblings'
+    row-sets entirely."""
 
     kind: str
     scheduler: object
@@ -71,3 +80,5 @@ class SolveRequest:
     deadline: Optional[float] = None
     client: str = ""
     trace_context: Optional[dict] = None
+    group: Optional[str] = None
+    group_nested: bool = False

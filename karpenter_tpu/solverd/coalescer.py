@@ -45,6 +45,11 @@ _PRIMED = global_registry.counter(
     "karpenter_solverd_primed_rowsets_total",
     "joint requirement row-sets primed by coalesced sweeps",
 )
+_FRONTIER_GROUPS = global_registry.counter(
+    "karpenter_solverd_frontier_groups_total",
+    "frontier-tagged request groups whose joint masks were primed from "
+    "their largest member",
+)
 
 
 class Coalescer:
@@ -133,8 +138,44 @@ class Coalescer:
                 "solverd.coalesce", parent=ctx, requests=len(bucket)
             ) as span:
                 try:
-                    pairs = []
+                    # frontier-tagged groups whose pod sets NEST (multi-node
+                    # prefix probes, request.group_nested) collect from
+                    # their largest member only — its row-sets cover the
+                    # whole group, so the per-member grouping work
+                    # telescopes away. Disjoint groups (single-node probe
+                    # batches) still collect per member: their siblings'
+                    # row-sets are NOT subsets of anyone's.
+                    groups: dict[str, list] = {}
+                    singles: list = []
                     for entry in bucket:
+                        tag = getattr(entry.request, "group", None)
+                        if tag is not None:
+                            groups.setdefault(tag, []).append(entry)
+                        else:
+                            singles.append(entry)
+                    pairs = []
+                    for members in groups.values():
+                        if all(
+                            getattr(e.request, "group_nested", False)
+                            for e in members
+                        ):
+                            pairs.extend(
+                                ffd.collect_prefix_rowsets(
+                                    [
+                                        (e.request.scheduler, e.request.pods)
+                                        for e in members
+                                    ]
+                                )
+                            )
+                        else:
+                            for e in members:
+                                pairs.extend(
+                                    ffd.collect_joint_rowsets(
+                                        e.request.scheduler, e.request.pods
+                                    )
+                                )
+                        _FRONTIER_GROUPS.inc()
+                    for entry in singles:
                         pairs.extend(
                             ffd.collect_joint_rowsets(
                                 entry.request.scheduler, entry.request.pods
@@ -146,7 +187,11 @@ class Coalescer:
                         if primed:
                             _PRIMED.inc(value=float(primed))
                     _COALESCED.inc(value=float(len(bucket)))
-                    span.set_volatile(primed=primed, rowsets=len(pairs))
+                    span.set_volatile(
+                        primed=primed,
+                        rowsets=len(pairs),
+                        frontier_groups=len(groups),
+                    )
                 except Exception as e:  # noqa: BLE001 — priming is an
                     # optimization; the solves below are exact without it
                     span.fail(e)

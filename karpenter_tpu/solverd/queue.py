@@ -74,6 +74,20 @@ class AdmissionQueue:
                 ready.append(entry)
         return ready, expired
 
+    def remove(self, entries) -> int:
+        """Un-admit still-queued entries (identity match); returns how many
+        were actually removed. A batched submitter that sheds mid-group uses
+        this so the next drain doesn't execute probes the caller has already
+        abandoned — entries a concurrent leader drained first are simply not
+        found and run to completion."""
+        targets = {id(e) for e in entries}
+        with self._lock:
+            kept = deque(e for e in self._items if id(e) not in targets)
+            removed = len(self._items) - len(kept)
+            self._items = kept
+            _DEPTH.set(float(len(self._items)))
+        return removed
+
     def depth(self) -> int:
         with self._lock:
             return len(self._items)

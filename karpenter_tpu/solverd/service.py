@@ -25,7 +25,11 @@ from typing import Optional
 
 from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry
-from karpenter_tpu.solverd.api import SolveRequest, SolverClosedError
+from karpenter_tpu.solverd.api import (
+    SolveRequest,
+    SolverClosedError,
+    SolverRejection,
+)
 from karpenter_tpu.solverd.coalescer import Coalescer
 from karpenter_tpu.solverd.queue import AdmissionQueue
 from karpenter_tpu.utils.clock import Clock
@@ -87,6 +91,7 @@ class SolverService:
         self.requests = 0
         self.executed = 0
         self.rejected = 0
+        self.cancelled = 0
         self.max_batch_size = 0
         self.last_batch_seconds = 0.0
 
@@ -140,6 +145,49 @@ class SolverService:
         if entry.error is not None:
             raise entry.error
         return entry.result
+
+    def solve_many(self, requests: list) -> list:
+        """Admit + execute a structured batch (e.g. one consolidation
+        frontier round), returning the completed entries in request order —
+        callers read per-entry `result`/`error` so one failed probe doesn't
+        void its siblings' verdicts. All entries land in the admission
+        queue before any drain runs, so a single leader executes the whole
+        group as ONE coalesced batch. Admission is all-or-nothing: a typed
+        rejection mid-group un-admits the already-queued siblings (a
+        frontier round is useless in fragments) and re-raises."""
+        entries = []
+        for request in requests:
+            try:
+                entries.append(self.submit(request))
+            except SolverRejection:
+                cancelled = self.queue.remove(entries)
+                with self._stats_lock:
+                    self.cancelled += cancelled
+                raise
+        while True:
+            leader = False
+            with self._lock:
+                if all(e.done for e in entries):
+                    break
+                if not self._executing:
+                    self._executing = True
+                    leader = True
+            if leader:
+                try:
+                    if self.coalesce_window > 0:
+                        self.clock.sleep(self.coalesce_window)
+                    self.run_pending()
+                finally:
+                    with self._lock:
+                        self._executing = False
+            else:
+                # re-scan outside the lock: a concurrent leader may have
+                # finished every entry since the locked check — then just
+                # loop back to the all-done exit instead of blocking
+                pending = next((e for e in entries if not e.done), None)
+                if pending is not None:
+                    pending.event.wait(timeout=0.05)
+        return entries
 
     # -- execution -----------------------------------------------------------
 
@@ -226,6 +274,7 @@ class SolverService:
                 "batches": self.batches,
                 "executed": self.executed,
                 "rejected": self.rejected,
+                "cancelled": self.cancelled,
                 "max_batch_size": self.max_batch_size,
                 "last_batch_seconds": self.last_batch_seconds,
             }

@@ -73,6 +73,31 @@ class SolverClient:
     ):
         raise NotImplementedError
 
+    def solve_many(
+        self,
+        kind: str,
+        batch,
+        timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
+        group: Optional[str] = None,
+        nested: bool = False,
+    ) -> list:
+        """Run a structured batch of solves — `batch` is [(scheduler, pods),
+        ...] — returning per-item (result, error) tuples in order. The
+        consolidation frontier submits each round's k prefix probes through
+        this so they coalesce into ONE device batch; errors stay per-item
+        because the caller walks its decision tree and must only surface
+        failures the sequential search would actually have hit. The base
+        implementation degrades to sequential solves for transports without
+        a batched path — decisions are identical, only coalescing is lost."""
+        out = []
+        for scheduler, pods in batch:
+            try:
+                out.append((self.solve(kind, scheduler, pods, timeout, deadline), None))
+            except Exception as err:  # noqa: BLE001 — per-item error slots
+                out.append((None, err))
+        return out
+
     def stats(self) -> dict:
         return {"transport": self.transport}
 
@@ -102,6 +127,28 @@ class InProcessClient(SolverClient):
                 trace_context=tracing.tracer().carrier(),
             )
         )
+
+    def solve_many(self, kind, batch, timeout=None, deadline=None, group=None,
+                   nested=False):
+        from karpenter_tpu import tracing
+
+        carrier = tracing.tracer().carrier()
+        entries = self.service.solve_many(
+            [
+                SolveRequest(
+                    kind=kind,
+                    scheduler=scheduler,
+                    pods=list(pods),
+                    timeout=timeout,
+                    deadline=deadline,
+                    trace_context=carrier,
+                    group=group,
+                    group_nested=nested,
+                )
+                for scheduler, pods in batch
+            ]
+        )
+        return [(e.result, e.error) for e in entries]
 
     def stats(self) -> dict:
         return self.service.stats()
@@ -291,6 +338,83 @@ class SocketClient(SolverClient):
             )
         return _unpack(reply["payload"])
 
+    def solve_many(self, kind, batch, timeout=None, deadline=None, group=None,
+                   nested=False):
+        """Batched solves in ONE frame: the daemon admits the whole group
+        before draining, so a frontier round coalesces into a single device
+        batch on the far side of the socket exactly as it does in-process.
+        Per-item verdicts (result or typed error) ride back in one reply."""
+        from karpenter_tpu import tracing
+
+        if not batch:
+            return []
+        payloads = []
+        clock = batch[0][0].clock
+        for scheduler, pods in batch:
+            with _engine_stripped(scheduler) as engine:
+                payloads.append(
+                    _pack(
+                        {
+                            "scheduler": scheduler,
+                            "pods": list(pods),
+                            "catalog": list(engine.instance_types)
+                            if engine
+                            else None,
+                        }
+                    )
+                )
+        tracer = tracing.tracer()
+        msg = {
+            "v": WIRE_VERSION,
+            "op": "solve_many",
+            "kind": kind,
+            "timeout": timeout,
+            "deadline_rel": None
+            if deadline is None
+            else max(0.0, deadline - clock.now()),
+            "group": group,
+            "nested": bool(nested),
+            "trace": tracer.carrier(),
+            "payloads": payloads,
+        }
+        with self._lock:
+            reply = self._rpc(msg)
+        if reply.get("spans"):
+            tracer.import_spans(reply["spans"])
+        if not reply.get("ok"):
+            err = reply.get("error", {})
+            cls = _ERROR_TYPES.get(err.get("type"))
+            if cls is not None:
+                raise cls(err.get("message", ""))
+            raise TransportError(
+                f"daemon error {err.get('type')}: {err.get('message')}"
+            )
+        out = []
+        for item in reply.get("results", []):
+            if item.get("ok"):
+                out.append((_unpack(item["payload"]), None))
+            else:
+                err = item.get("error", {})
+                cls = _ERROR_TYPES.get(err.get("type"))
+                if cls is not None:
+                    out.append((None, cls(err.get("message", ""))))
+                else:
+                    out.append(
+                        (
+                            None,
+                            TransportError(
+                                f"daemon error {err.get('type')}: "
+                                f"{err.get('message')}"
+                            ),
+                        )
+                    )
+        if len(out) != len(batch):
+            raise TransportError(
+                f"solve_many reply carried {len(out)} results for "
+                f"{len(batch)} requests"
+            )
+        return out
+
     def _drop(self) -> None:
         if self._sock is not None:
             try:
@@ -439,9 +563,19 @@ class SolverDaemon:
     def _process(self, msg: dict) -> dict:
         if msg.get("op") == "stats":
             return {"ok": True, "stats": self.service.stats()}
+        if msg.get("op") == "solve_many":
+            return self._process_many(msg)
         if msg.get("op") != "solve":
             return _error_reply(TransportError(f"unknown op {msg.get('op')}"))
-        body = _unpack(msg["payload"])
+        trace = msg.get("trace")
+        request = self._decode_request(msg, msg["payload"])
+        results = self.service.solve(request)
+        reply = {"ok": True, "payload": _pack(_detached(results))}
+        self._attach_spans(reply, trace)
+        return reply
+
+    def _decode_request(self, msg: dict, payload: str) -> SolveRequest:
+        body = _unpack(payload)
         scheduler = body["scheduler"]
         catalog = body.get("catalog")
         if catalog:
@@ -450,8 +584,7 @@ class SolverDaemon:
             except Exception:  # noqa: BLE001 — host path is decision-identical
                 scheduler.engine = None
         deadline_rel = msg.get("deadline_rel")
-        trace = msg.get("trace")
-        request = SolveRequest(
+        return SolveRequest(
             kind=msg.get("kind", api.KIND_SOLVE),
             scheduler=scheduler,
             pods=body["pods"],
@@ -460,14 +593,33 @@ class SolverDaemon:
             if deadline_rel is None
             else self.service.clock.now() + deadline_rel,
             client="socket",
-            trace_context=trace,
+            trace_context=msg.get("trace"),
+            group=msg.get("group"),
+            group_nested=bool(msg.get("nested", False)),
         )
-        results = self.service.solve(request)
-        # the result graph references the daemon's engine through the claim
-        # objects — detach before pickling (device arrays don't travel)
-        for nc in results.new_node_claims:
-            nc.engine = None
-        reply = {"ok": True, "payload": _pack(results)}
+
+    def _process_many(self, msg: dict) -> dict:
+        """One frame, one admission group, one coalesced batch: the frontier
+        client's k probes decode into k SolveRequests sharing the frame's
+        control plane (kind/timeout/deadline/group/trace) and execute via
+        service.solve_many, so a socket-side frontier round batches exactly
+        like an in-process one. Verdicts travel back per item — a failed
+        probe reports its typed error without voiding its siblings."""
+        trace = msg.get("trace")
+        requests = [
+            self._decode_request(msg, payload)
+            for payload in msg.get("payloads", [])
+        ]
+        entries = self.service.solve_many(requests)
+        results = []
+        for entry in entries:
+            if entry.error is not None:
+                results.append(_error_reply(entry.error))
+            else:
+                results.append(
+                    {"ok": True, "payload": _pack(_detached(entry.result))}
+                )
+        reply = {"ok": True, "results": results}
         self._attach_spans(reply, trace)
         return reply
 
@@ -505,6 +657,14 @@ def _error_reply(e: Exception) -> dict:
         "ok": False,
         "error": {"type": type(e).__name__, "message": str(e)},
     }
+
+
+def _detached(results):
+    """Detach the daemon's engine from a result graph before pickling — the
+    claim objects reference it and device arrays don't travel."""
+    for nc in results.new_node_claims:
+        nc.engine = None
+    return results
 
 
 def _default_engine_factory():

@@ -116,6 +116,16 @@ class Cluster:
         (cluster.go:203-209)."""
         return [n.deep_copy() for n in self.nodes.values()]
 
+    def state_nodes_view(self) -> list[StateNode]:
+        """The live StateNode objects, uncopied — for read-only consumers.
+        Scheduling solves qualify since ExistingNode went copy-on-write (it
+        forks usage onto itself instead of writing through the StateNode),
+        which is what lets the consolidation frontier share ONE cluster view
+        across k probe simulations instead of deep-copying per probe. The
+        caller must not outlive the operator pass it snapshotted in: the
+        list is stable only while no informer updates run."""
+        return list(self.nodes.values())
+
     def node_for_pod(self, pod: Pod) -> Optional[StateNode]:
         name = self.bindings.get((pod.metadata.namespace, pod.metadata.name))
         if name is None:

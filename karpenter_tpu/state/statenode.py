@@ -40,6 +40,11 @@ class StateNode:
         self.volume_usage = VolumeUsage()
         self.marked_for_deletion = False
         self.nominated_until = 0.0
+        # bumped on every in-place usage mutation (update_for_pod /
+        # cleanup_for_pod): consumers caching node-derived statics — the
+        # consolidation frontier's ExistingNode prototypes — key on it to
+        # invalidate exactly when this node's usage actually moved
+        self.usage_seq = 0
 
     # -- identity -----------------------------------------------------------
 
@@ -225,12 +230,14 @@ class StateNode:
             self.daemonset_requests[key] = pod_resource_requests(pod)
         self.hostport_usage.add(pod, get_host_ports(pod))
         self.volume_usage.add(pod, get_volumes(store, pod))
+        self.usage_seq += 1
 
     def cleanup_for_pod(self, namespace: str, name: str) -> None:
         self.hostport_usage.delete_pod(namespace, name)
         self.volume_usage.delete_pod(namespace, name)
         self.pod_requests.pop((namespace, name), None)
         self.daemonset_requests.pop((namespace, name), None)
+        self.usage_seq += 1
 
     def deep_copy(self) -> "StateNode":
         """Copy with independent usage tracking, for scheduling simulations
@@ -247,6 +254,7 @@ class StateNode:
         out.volume_usage = self.volume_usage.copy()
         out.marked_for_deletion = self.marked_for_deletion
         out.nominated_until = self.nominated_until
+        out.usage_seq = self.usage_seq
         return out
 
     def shallow_copy(self) -> "StateNode":
@@ -259,6 +267,7 @@ class StateNode:
         out.volume_usage = self.volume_usage
         out.marked_for_deletion = self.marked_for_deletion
         out.nominated_until = self.nominated_until
+        out.usage_seq = self.usage_seq
         return out
 
     def __repr__(self) -> str:

@@ -291,18 +291,16 @@ class TestMultiNodeConsolidation:
         before = dmethods._CONSOLIDATION_TIMEOUTS.value(
             {"consolidation_type": "multi"}
         )
-        # every simulation probe burns past the deadline
-        multi = next(
-            m for m in env.controller.methods
-            if isinstance(m, dmethods.MultiNodeConsolidation)
-        )
-        orig = multi.c.compute_consolidation
+        # every frontier round burns past the deadline; depth 1 keeps the
+        # search multi-round so the between-rounds check actually runs
+        env.provisioner.options.consolidation_frontier_depth = 1
+        orig = dmethods.FrontierSimulator.solve_batch
 
-        def slow_probe(*candidates):
+        def slow_batch(sim, plans):
             env.clock.step(dmethods.MULTI_NODE_CONSOLIDATION_TIMEOUT + 1.0)
-            return orig(*candidates)
+            return orig(sim, plans)
 
-        monkeypatch.setattr(multi.c, "compute_consolidation", slow_probe)
+        monkeypatch.setattr(dmethods.FrontierSimulator, "solve_batch", slow_batch)
         env.reconcile()
         assert (
             dmethods._CONSOLIDATION_TIMEOUTS.value({"consolidation_type": "multi"})

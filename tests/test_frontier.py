@@ -1,0 +1,555 @@
+"""Device-resident consolidation frontier search: parity fuzz against the
+sequential binary-search oracle, the prefix reductions, solverd's batched
+solve_many, and the frontier's telemetry/timeout/budget contracts.
+
+The load-bearing invariant: the frontier search must select the SAME
+command as the reference's sequential binary search on every input — it
+evaluates the sequential search's own decision tree speculatively, so any
+divergence is a bug, not a tolerance.
+"""
+
+from __future__ import annotations
+
+import math
+from random import Random
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import Budget
+from karpenter_tpu.controllers.disruption import methods as dmethods
+from karpenter_tpu.controllers.disruption.consolidation import (
+    Consolidation,
+    get_candidate_prices,
+)
+from karpenter_tpu.controllers.disruption.helpers import (
+    FrontierSimulator,
+    build_disruption_budget_mapping,
+    get_candidates,
+)
+from karpenter_tpu.controllers.disruption.types import (
+    Command,
+    DECISION_NOOP,
+)
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops import frontier as ftr
+
+from helpers import nodepool, unschedulable_pod
+from test_disruption import Env
+
+SIZES = ["s-4x-amd64-linux", "c-4x-amd64-linux", "m-4x-amd64-linux"]
+
+
+@pytest.fixture(autouse=True)
+def _device_path(monkeypatch):
+    """Fuzz fixtures are far below DEVICE_MIN_PODS (64); pin it to 1 so
+    every probe simulation actually exercises the device path (the
+    device_path.py discipline), and STRICT so a silent host fallback
+    raises instead of hiding — the '0 fallbacks' half of the acceptance
+    criterion."""
+    monkeypatch.setattr(ffd, "DEVICE_MIN_PODS", 1)
+    monkeypatch.setattr(ffd, "STRICT", True)
+
+
+def build_env(
+    seed: int,
+    n_nodes: int = 8,
+    pools: tuple = ("default",),
+    spot_frac: float = 0.0,
+    spot_gate: bool = False,
+    budgets: dict | None = None,
+) -> Env:
+    """A deterministic consolidation fixture: `seed` fully determines the
+    cluster, so two builds of the same seed are byte-identical — the parity
+    fuzz runs the sequential oracle and the frontier search on SEPARATE
+    twin environments and compares their outputs, events included."""
+    rng = Random(seed)
+    options = Options()
+    if spot_gate:
+        options.feature_gates.spot_to_spot_consolidation = True
+    env = Env(options=options)
+    for pool in pools:
+        np_ = nodepool(pool)
+        np_.spec.disruption.budgets = [
+            Budget(nodes=(budgets or {}).get(pool, "100%"))
+        ]
+        env.store.create(np_)
+    for i in range(n_nodes):
+        cpu = rng.choice([4, 4, 8])
+        itype = rng.choice(SIZES).replace("4x", f"{cpu}x")
+        pods = []
+        for j in range(rng.randrange(0, 3)):
+            pods.append(
+                unschedulable_pod(
+                    name=f"n{i}-p{j}",
+                    requests={"cpu": f"{rng.choice([100, 200, 500])}m"},
+                )
+            )
+        env.add_pair(
+            f"node-{i:03d}",
+            pods=pods,
+            pool=rng.choice(pools),
+            instance_type=itype,
+            capacity={"cpu": str(cpu), "memory": f"{cpu * 4}Gi", "pods": "110"},
+            capacity_type=(
+                wk.CAPACITY_TYPE_SPOT
+                if rng.random() < spot_frac
+                else wk.CAPACITY_TYPE_ON_DEMAND
+            ),
+        )
+    env.informer.flush()
+    env.clock.step(120)
+    return env
+
+
+def multi_method(env) -> dmethods.MultiNodeConsolidation:
+    c = Consolidation(
+        env.clock, env.cluster, env.store, env.provisioner, env.provider,
+        env.recorder, env.queue,
+    )
+    return dmethods.MultiNodeConsolidation(c)
+
+
+def single_method(env) -> dmethods.SingleNodeConsolidation:
+    c = Consolidation(
+        env.clock, env.cluster, env.store, env.provisioner, env.provider,
+        env.recorder, env.queue,
+    )
+    return dmethods.SingleNodeConsolidation(c)
+
+
+def candidates_for(env, method):
+    return get_candidates(
+        env.store, env.cluster, env.recorder, env.clock, env.provider,
+        method.should_disrupt, method.disruption_class(), env.queue,
+    )
+
+
+def budgets_for(env, method):
+    return build_disruption_budget_mapping(
+        env.store, env.cluster, env.clock, env.recorder, method.reason()
+    )
+
+
+def command_signature(cmd: Command) -> tuple:
+    """Everything decision-relevant about a Command, comparably."""
+    replacements = []
+    for rep in cmd.replacements:
+        nc = rep.node_claim
+        ct = nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        replacements.append(
+            (
+                tuple(it.name for it in nc.instance_type_options),
+                tuple(sorted(ct.values)) if ct.values is not None else None,
+            )
+        )
+    return (
+        cmd.decision(),
+        tuple(sorted(c.name() for c in cmd.candidates)),
+        tuple(replacements),
+    )
+
+
+def event_stream(env) -> list[tuple]:
+    return [
+        (
+            e.type,
+            e.reason,
+            e.message,
+            getattr(getattr(e.involved_object, "metadata", None), "name", ""),
+        )
+        for e in env.recorder.events
+    ]
+
+
+def sequential_single_oracle(method, budgets, candidates) -> Command:
+    """The reference's singlenodeconsolidation.go walk, verbatim (pre-
+    frontier): cheapest first, one simulation per candidate, first
+    non-noop wins."""
+    c = method.c
+    budgets = dict(budgets)
+    if c.is_consolidated():
+        return Command()
+    cands = method.sort_candidates(list(candidates))
+    constrained = False
+    unseen = {x.node_pool.metadata.name for x in cands}
+    for cand in cands:
+        unseen.discard(cand.node_pool.metadata.name)
+        if budgets.get(cand.node_pool.metadata.name, 0) == 0:
+            constrained = True
+            continue
+        if not cand.reschedulable_pods:
+            continue
+        cmd = c.compute_consolidation(cand)
+        if cmd.decision() == DECISION_NOOP:
+            continue
+        return cmd
+    if not constrained:
+        c.mark_consolidated()
+    method.previously_unseen_nodepools = unseen
+    return Command()
+
+
+def run_multi_pair(seed: int, depth: int = 2, **env_kw) -> None:
+    """Twin environments, same seed: sequential oracle on one, frontier on
+    the other. Commands AND event streams must match byte for byte."""
+    env_a, env_b = build_env(seed, **env_kw), build_env(seed, **env_kw)
+    fallbacks0 = ffd.DEVICE_FALLBACKS
+
+    m_seq = multi_method(env_a)
+    cands_a = candidates_for(env_a, m_seq)
+    m_seq._first_n_consolidation_option = m_seq._first_n_sequential
+    cmd_a = m_seq.compute_command(budgets_for(env_a, m_seq), *cands_a)
+
+    m_frontier = multi_method(env_b)
+    env_b.provisioner.options.consolidation_frontier_depth = depth
+    cands_b = candidates_for(env_b, m_frontier)
+    cmd_b = m_frontier.compute_command(budgets_for(env_b, m_frontier), *cands_b)
+
+    assert command_signature(cmd_a) == command_signature(cmd_b), (
+        f"seed {seed}: frontier diverged from the sequential oracle"
+    )
+    assert event_stream(env_a) == event_stream(env_b), (
+        f"seed {seed}: event streams diverged"
+    )
+    assert ffd.DEVICE_FALLBACKS == fallbacks0, "a probe fell back to the host loop"
+
+
+class TestSpeculativeProbes:
+    def test_level_set_is_distinct_and_covers_binary_path(self):
+        rng = Random(17)
+        for _ in range(200):
+            lo = rng.randrange(1, 60)
+            hi = lo + rng.randrange(0, 60)
+            depth = rng.randrange(1, 5)
+            probes = ftr.speculative_probes(lo, hi, depth)
+            assert len(probes) == len(set(probes))
+            assert all(lo <= m <= hi for m in probes)
+            # every (lo, hi) walk of `depth` verdicts only visits probed mids
+            for verdicts in range(2 ** depth):
+                l, h = lo, hi
+                for bit in range(depth):
+                    if l > h:
+                        break
+                    mid = (l + h) // 2
+                    assert mid in probes, (lo, hi, depth, mid)
+                    if (verdicts >> bit) & 1:
+                        l = mid + 1
+                    else:
+                        h = mid - 1
+
+    def test_depth_one_is_single_probe(self):
+        assert ftr.speculative_probes(1, 99, 1) == [(1 + 99) // 2]
+
+    def test_empty_interval(self):
+        assert ftr.speculative_probes(5, 4, 3) == []
+
+
+class TestPrefixReductions:
+    def test_prefix_prices_match_oracle(self):
+        env = build_env(21, n_nodes=10, spot_frac=0.4)
+        method = multi_method(env)
+        cands = method.c.sort_candidates(candidates_for(env, method))
+        prices = ftr.PrefixPrices(cands)
+        for m in range(1, len(cands) + 1):
+            assert prices.for_prefix(m) == get_candidate_prices(cands[:m]), m
+
+    def test_prefix_type_floors_match_filter_oracle(self):
+        env = build_env(22, n_nodes=12)
+        method = multi_method(env)
+        cands = method.c.sort_candidates(candidates_for(env, method))
+        floors = ftr.PrefixTypeFloors(cands)
+        for m in range(1, len(cands) + 1):
+            # oracle: _filter_out_same_type's own existing_types/price scan
+            existing, by_type = set(), {}
+            for c in cands[:m]:
+                existing.add(c.instance_type.name)
+                from karpenter_tpu.cloudprovider.types import Offerings
+                from karpenter_tpu.scheduling.requirements import Requirements
+
+                compatible = Offerings(c.instance_type.offerings).compatible(
+                    Requirements.from_labels(c.state_node.labels())
+                )
+                if compatible:
+                    p = compatible.cheapest().price
+                    by_type[c.instance_type.name] = min(
+                        p, by_type.get(c.instance_type.name, math.inf)
+                    )
+            names = sorted({c.instance_type.name for c in cands}) + ["absent"]
+            expect = math.inf
+            for name in names:
+                if name in existing:
+                    expect = min(expect, by_type.get(name, math.inf))
+            assert floors.max_price(m, names) == expect, m
+
+
+class TestFrontierParity:
+    """The acceptance invariant: identical Commands, zero divergences."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_fuzz_basic(self, seed):
+        run_multi_pair(seed, n_nodes=6 + seed % 5)
+
+    @pytest.mark.parametrize("seed", range(12, 18))
+    def test_fuzz_spot_mix(self, seed):
+        run_multi_pair(seed, n_nodes=7, spot_frac=0.5, spot_gate=True)
+
+    @pytest.mark.parametrize("seed", range(18, 24))
+    def test_fuzz_multi_pool_constrained(self, seed):
+        run_multi_pair(
+            seed,
+            n_nodes=9,
+            pools=("default", "burst"),
+            budgets={"burst": "0"},
+        )
+
+    @pytest.mark.parametrize("depth", [1, 2, 3, 4])
+    def test_depth_invariance(self, depth):
+        """Any speculation depth must pick the same command — the walk is
+        the same decision tree regardless of how many levels batch."""
+        run_multi_pair(31, n_nodes=9, depth=depth)
+
+    def test_replace_case(self):
+        """Many small half-full nodes fold into one bigger cheaper node —
+        the REPLACE path through the price gate, both searches agreeing."""
+        def build():
+            env = Env()
+            np_ = nodepool("default")
+            np_.spec.disruption.budgets = [Budget(nodes="100%")]
+            env.store.create(np_)
+            for i in range(3):
+                pods = [
+                    unschedulable_pod(name=f"r{i}-p{j}", requests={"cpu": "1"})
+                    for j in range(2)
+                ]
+                env.add_pair(
+                    f"rep-{i}",
+                    pods=pods,
+                    instance_type="c-4x-amd64-linux",
+                    capacity={"cpu": "4", "memory": "8Gi", "pods": "110"},
+                )
+            env.informer.flush()
+            env.clock.step(120)
+            return env
+
+        env_a, env_b = build(), build()
+        m_seq = multi_method(env_a)
+        m_seq._first_n_consolidation_option = m_seq._first_n_sequential
+        cmd_a = m_seq.compute_command(
+            budgets_for(env_a, m_seq), *candidates_for(env_a, m_seq)
+        )
+        m_f = multi_method(env_b)
+        cmd_b = m_f.compute_command(
+            budgets_for(env_b, m_f), *candidates_for(env_b, m_f)
+        )
+        assert command_signature(cmd_a) == command_signature(cmd_b)
+        assert cmd_a.decision() != DECISION_NOOP
+
+    def test_single_candidate_edge(self):
+        env = build_env(40, n_nodes=1)
+        method = multi_method(env)
+        cmd = method.compute_command(
+            budgets_for(env, method), *candidates_for(env, method)
+        )
+        assert cmd.decision() == DECISION_NOOP  # needs >= 2 candidates
+
+    def test_no_candidates_edge(self):
+        env = build_env(41, n_nodes=0)
+        method = multi_method(env)
+        cmd = method.compute_command(budgets_for(env, method))
+        assert cmd.decision() == DECISION_NOOP
+
+    @pytest.mark.parametrize("seed", range(50, 58))
+    def test_single_node_parity(self, seed):
+        """The batched single-node walk vs the sequential reference loop:
+        same command, same deferred-then-published event stream."""
+        env_a, env_b = build_env(seed, n_nodes=7), build_env(seed, n_nodes=7)
+        s_a = single_method(env_a)
+        cmd_a = sequential_single_oracle(
+            s_a, budgets_for(env_a, s_a), candidates_for(env_a, s_a)
+        )
+        s_b = single_method(env_b)
+        cmd_b = s_b.compute_command(
+            budgets_for(env_b, s_b), *candidates_for(env_b, s_b)
+        )
+        assert command_signature(cmd_a) == command_signature(cmd_b), seed
+        assert event_stream(env_a) == event_stream(env_b), seed
+
+
+class TestBudgetsDefensiveCopy:
+    """A shed/timeout retry re-enters compute_command with the SAME budget
+    mapping; the pass must not see pre-decremented budgets."""
+
+    def test_multi_node_leaves_caller_budgets_untouched(self):
+        env = build_env(60, n_nodes=5)
+        method = multi_method(env)
+        budgets = budgets_for(env, method)
+        snapshot = dict(budgets)
+        method.compute_command(budgets, *candidates_for(env, method))
+        assert budgets == snapshot
+
+    def test_emptiness_leaves_caller_budgets_untouched(self):
+        env = Env()
+        env.store.create(nodepool("default"))
+        for i in range(3):
+            env.add_pair(f"empty-{i}")
+        env.informer.flush()
+        env.clock.step(120)
+        c = Consolidation(
+            env.clock, env.cluster, env.store, env.provisioner, env.provider,
+            env.recorder, env.queue,
+        )
+        method = dmethods.Emptiness(c)
+        budgets = budgets_for(env, method)
+        snapshot = dict(budgets)
+        cmd = method.compute_command(budgets, *candidates_for(env, method))
+        assert cmd.candidates, "expected empties to consolidate"
+        assert budgets == snapshot
+
+    def test_single_node_leaves_caller_budgets_untouched(self):
+        env = build_env(61, n_nodes=4)
+        method = single_method(env)
+        budgets = budgets_for(env, method)
+        snapshot = dict(budgets)
+        method.compute_command(budgets, *candidates_for(env, method))
+        assert budgets == snapshot
+
+
+class TestFrontierTimeout:
+    def test_mid_search_timeout_returns_last_saved(self, monkeypatch):
+        """Satellite contract: the 60s deadline checked BETWEEN rounds — a
+        mid-search timeout returns the best command validated so far and
+        increments the timeout counter."""
+        env = build_env(70, n_nodes=6)
+        env.provisioner.options.consolidation_frontier_depth = 1
+        method = multi_method(env)
+        before = dmethods._CONSOLIDATION_TIMEOUTS.value(
+            {"consolidation_type": "multi"}
+        )
+        orig = FrontierSimulator.solve_batch
+
+        def slow_batch(sim, plans):
+            env.clock.step(dmethods.MULTI_NODE_CONSOLIDATION_TIMEOUT + 1.0)
+            return orig(sim, plans)
+
+        monkeypatch.setattr(FrontierSimulator, "solve_batch", slow_batch)
+        cands = candidates_for(env, method)
+        cmd = method.compute_command(budgets_for(env, method), *cands)
+        assert (
+            dmethods._CONSOLIDATION_TIMEOUTS.value({"consolidation_type": "multi"})
+            == before + 1
+        )
+        # depth 1, round 1 probed the sequential search's first mid and its
+        # verdict was applied before the round-2 deadline check fired: the
+        # returned command is that probe's (last validated), not a fresh
+        # recompute — compare against the oracle's first probe
+        twin = build_env(70, n_nodes=6)
+        m2 = multi_method(twin)
+        cands2 = m2.c.sort_candidates(candidates_for(twin, m2))
+        disruptable = [c for c in cands2 if c.reschedulable_pods]
+        lo, hi = 1, min(len(disruptable), dmethods.MAX_PARALLEL_CONSOLIDATION) - 1
+        mid = (lo + hi) // 2
+        first_probe = m2.c.compute_consolidation(*disruptable[: mid + 1])
+        if first_probe.decision() != DECISION_NOOP:
+            assert command_signature(cmd) == command_signature(first_probe)
+        else:
+            assert cmd.decision() == DECISION_NOOP
+
+
+class TestFrontierTelemetry:
+    def test_probe_and_round_metrics_and_span(self):
+        from karpenter_tpu import tracing
+
+        env = build_env(80, n_nodes=8)
+        method = multi_method(env)
+        labels = {"consolidation_type": "multi"}
+        probes0 = dmethods._FRONTIER_PROBES.value(labels)
+        rounds0 = dmethods._FRONTIER_ROUNDS.count(labels)
+        cands = candidates_for(env, method)
+        method.compute_command(budgets_for(env, method), *cands)
+        assert dmethods._FRONTIER_PROBES.value(labels) > probes0
+        assert dmethods._FRONTIER_ROUNDS.count(labels) == rounds0 + 1
+        names = [s["name"] for s in tracing.tracer().ring.spans()]
+        assert "consolidation.frontier" in names
+
+    def test_coalescer_counts_frontier_groups(self):
+        from karpenter_tpu.solverd import coalescer as dcoal
+
+        env = build_env(81, n_nodes=8)
+        method = multi_method(env)
+        groups0 = dcoal._FRONTIER_GROUPS.value()
+        method.compute_command(
+            budgets_for(env, method), *candidates_for(env, method)
+        )
+        assert dcoal._FRONTIER_GROUPS.value() > groups0
+
+
+class TestCollectPrefixRowsets:
+    def test_collects_from_largest_member(self, monkeypatch):
+        seen = []
+
+        def fake_collect(scheduler, pods):
+            seen.append((scheduler, len(pods)))
+            return [("rows", "reqs")]
+
+        monkeypatch.setattr(ffd, "collect_joint_rowsets", fake_collect)
+        group = [("sched-a", [1]), ("sched-b", [1, 2, 3]), ("sched-c", [1, 2])]
+        pairs = ffd.collect_prefix_rowsets(group)
+        assert pairs == [("rows", "reqs")]
+        assert seen == [("sched-b", 3)]
+
+    def test_empty_group(self):
+        assert ffd.collect_prefix_rowsets([]) == []
+
+
+class TestCoalescerGroupPriming:
+    """Nested groups prime from their largest member; disjoint groups
+    (single-node rounds) must still collect EVERY member — the siblings'
+    row-sets are not subsets of anyone's."""
+
+    class _Entry:
+        def __init__(self, request):
+            self.request = request
+            self.result = None
+            self.error = None
+
+    def _entries(self, engine, nested):
+        from karpenter_tpu.solverd.api import SolveRequest
+
+        out = []
+        for i, pods in enumerate(([1], [1, 2], [1, 2, 3])):
+            sched = type("S", (), {"engine": engine})()
+            out.append(
+                self._Entry(
+                    SolveRequest(
+                        kind="simulate", scheduler=sched, pods=pods,
+                        group="g", group_nested=nested,
+                    )
+                )
+            )
+        return out
+
+    def _prime_with(self, monkeypatch, nested):
+        from karpenter_tpu.solverd.coalescer import Coalescer
+
+        collected = []
+        monkeypatch.setattr(
+            ffd, "collect_joint_rowsets",
+            lambda s, p: collected.append(("member", len(p))) or [],
+        )
+        monkeypatch.setattr(
+            ffd, "collect_prefix_rowsets",
+            lambda sp: collected.append(("largest", max(len(p) for _, p in sp))) or [],
+        )
+        engine = object()
+        Coalescer()._prime(self._entries(engine, nested))
+        return collected
+
+    def test_nested_group_collects_largest_only(self, monkeypatch):
+        assert self._prime_with(monkeypatch, nested=True) == [("largest", 3)]
+
+    def test_disjoint_group_collects_every_member(self, monkeypatch):
+        assert self._prime_with(monkeypatch, nested=False) == [
+            ("member", 1), ("member", 2), ("member", 3),
+        ]

@@ -227,3 +227,61 @@ class TestPerfFloor:
             f"forced host loop only {regressed_s / device_s:.2f}x slower — "
             f"the ratio floor would not catch this regression"
         )
+
+
+class TestConsolidationFrontierFloor:
+    """ISSUE 9 acceptance: the device-resident frontier search holds
+    multi-node consolidation at O(100ms)/compute @1000 candidates (the
+    sequential host search ran ~550ms+). The bound is best-of-N with gc
+    fenced (container CPU varies ~30% run-to-run) and sits ~3x above the
+    steady number, so it trips on structural regressions — a probe falling
+    back to per-probe world rebuilds, the prototype cache dying, the lazy
+    node materialization reverting — not on CI jitter."""
+
+    # steady best-of-5 runs ~85-120ms on the bench container
+    MAX_COMPUTE_MS = 300.0
+
+    def test_thousand_candidate_compute_floor(self):
+        import bench
+
+        leg = bench.consolidation_bench(1000, reps=3)
+        assert leg["best_ms"] <= self.MAX_COMPUTE_MS, (
+            f"multi-node consolidation @1000 candidates took "
+            f"{leg['best_ms']:.0f}ms best-of-3 (floor "
+            f"{self.MAX_COMPUTE_MS:.0f}ms); samples={leg['samples_ms']}"
+        )
+        # the batched shape itself: the search must run as coalesced
+        # frontier rounds, not one simulation per sequential probe
+        assert leg["rounds_per_compute"] <= 5, leg
+        assert leg["probes_per_compute"] >= 7, leg
+
+    def test_frontier_probes_ride_one_solverd_batch(self):
+        """Each frontier round's probes must coalesce into ONE solverd
+        batch — k batches per round means the frontier degraded to
+        sequential submission."""
+        import bench
+        from karpenter_tpu.solverd import coalescer as dcoal
+
+        controller, cluster, clock = bench._consolidation_env(200)
+        controller.reconcile()  # warm
+        controller._pending = None
+        clock.step(60)
+        cluster.mark_unconsolidated()
+        solver = controller.provisioner.solver
+        batches0 = solver.stats()["batches"]
+        groups0 = dcoal._FRONTIER_GROUPS.value()
+        from karpenter_tpu.controllers.disruption import methods as dmethods
+
+        labels = {"consolidation_type": "multi"}
+        rounds0 = dmethods._FRONTIER_ROUNDS.sum(labels)
+        probes0 = dmethods._FRONTIER_PROBES.value(labels)
+        controller.reconcile()
+        rounds = dmethods._FRONTIER_ROUNDS.sum(labels) - rounds0
+        probes = dmethods._FRONTIER_PROBES.value(labels) - probes0
+        batches = solver.stats()["batches"] - batches0
+        assert probes > rounds, "expected >1 probe per round (depth >= 2)"
+        assert batches == rounds, (
+            f"{probes:.0f} probes over {rounds:.0f} rounds ran as "
+            f"{batches} solverd batches — frontier rounds must coalesce"
+        )
+        assert dcoal._FRONTIER_GROUPS.value() > groups0

@@ -909,9 +909,14 @@ class TestHostPortsBothPaths:
         assert not sn.hostport_usage
         solve = ffd_topo._TopoSolve(scheduler, pods)
         solve.run(60.0)
-        assert sn.hostport_usage, "expected a port join on the existing node"
+        # copy-on-write: the join forks usage onto the ExistingNode; the
+        # shared StateNode must stay pristine throughout
+        en = scheduler.existing_nodes[0]
+        assert en.hostport_usage, "expected a port join on the existing node"
+        assert not sn.hostport_usage, "solve wrote through the StateNode"
         solve.abort()
-        assert not sn.hostport_usage, "abort left phantom port entries"
+        assert not en.hostport_usage, "abort left phantom port entries"
+        assert not sn.hostport_usage
 
     def test_abort_restores_existing_node_volume_usage(self):
         # volume twin of the port rollback spec: a mid-solve fallback must
@@ -968,9 +973,13 @@ class TestHostPortsBothPaths:
         sn = state_nodes[0]
         solve = ffd_topo._TopoSolve(scheduler, pods)
         solve.run(60.0)
-        assert sn.volume_usage._volumes, "expected a volume join on the node"
+        # copy-on-write: the fork on the ExistingNode carries the joins,
+        # the shared StateNode stays pristine
+        en = scheduler.existing_nodes[0]
+        assert en.volume_usage._volumes, "expected a volume join on the node"
+        assert not sn.volume_usage._volumes, "solve wrote through the StateNode"
         solve.abort()
-        assert not sn.volume_usage._volumes, "abort left phantom volume entries"
+        assert not en.volume_usage._volumes, "abort left phantom volume entries"
 
 
 class TestNodePoolSelection:

@@ -483,3 +483,148 @@ class TestStatsConsistency:
         assert stats["executed"] == 1
         assert stats["rejected"] == 1
         assert stats["executed"] <= stats["requests"]
+
+
+class _ExplodingScheduler:
+    """Module-level (picklable) scheduler stub whose solve always raises —
+    the per-item error-isolation case for solve_many on both transports."""
+
+    engine = None
+
+    def __init__(self):
+        self.clock = FakeClock()
+
+    def solve(self, pods, timeout=None):
+        raise RuntimeError("boom")
+
+
+class TestSolveMany:
+    """Batched submission (the consolidation frontier's transport): one
+    admission group, one coalesced batch, per-item verdicts."""
+
+    def test_one_batch_and_ordered_results(self):
+        direct = []
+        batch = []
+        for n in (2, 4, 3):
+            s, p = build_scheduler(n_pods=n)
+            direct.append(decisions(s.solve(p, timeout=60.0)))
+            s2, p2 = build_scheduler(n_pods=n)
+            batch.append((s2, p2))
+        svc = SolverService(clock=FakeClock())
+        client = InProcessClient(svc)
+        try:
+            out = client.solve_many(
+                KIND_SIMULATE, batch, timeout=60.0, group="frontier-test"
+            )
+        finally:
+            svc.close()
+        assert [err for _, err in out] == [None, None, None]
+        assert [decisions(res) for res, _ in out] == direct
+        stats = svc.stats()
+        assert stats["batches"] == 1, "a frontier group must run as ONE batch"
+        assert stats["executed"] == 3
+
+    def test_per_item_error_isolation(self):
+        s1, p1 = build_scheduler(n_pods=2)
+        svc = SolverService(clock=FakeClock())
+        client = InProcessClient(svc)
+        try:
+            out = client.solve_many(
+                KIND_SIMULATE,
+                [(s1, p1), (_ExplodingScheduler(), [])],
+                timeout=60.0,
+            )
+        finally:
+            svc.close()
+        (res, err), (res2, err2) = out
+        assert err is None and res is not None
+        assert res2 is None and isinstance(err2, RuntimeError)
+
+    def test_rejection_cancels_the_whole_group(self):
+        svc = SolverService(clock=FakeClock(), max_queue_depth=2)
+        batch = []
+        for _ in range(3):
+            s, p = build_scheduler(n_pods=1)
+            batch.append(
+                SolveRequest(KIND_SIMULATE, s, list(p), timeout=60.0)
+            )
+        with pytest.raises(QueueFullError):
+            svc.solve_many(batch)
+        # the two admitted siblings were un-admitted: nothing left to run
+        assert svc.queue.depth() == 0
+        assert svc.run_pending() == 0
+        assert svc.stats()["cancelled"] == 2
+        svc.close()
+
+    def test_socket_solve_many_matches_inprocess(self):
+        batch_sizes = (2, 3)
+        inproc_svc = SolverService(clock=FakeClock())
+        inproc = InProcessClient(inproc_svc)
+        try:
+            want = [
+                decisions(res)
+                for res, err in inproc.solve_many(
+                    KIND_SIMULATE,
+                    [build_scheduler(n_pods=n) for n in batch_sizes],
+                    timeout=60.0,
+                    group="g1",
+                )
+            ]
+        finally:
+            inproc_svc.close()
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            out = client.solve_many(
+                KIND_SIMULATE,
+                [build_scheduler(n_pods=n) for n in batch_sizes],
+                timeout=60.0,
+                group="g1",
+            )
+            assert [err for _, err in out] == [None, None]
+            assert [decisions(res) for res, _ in out] == want
+            # the whole group rode ONE frame into ONE coalesced batch
+            assert svc.stats()["batches"] == 1
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+
+    def test_socket_solve_many_per_item_error(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            s1, p1 = build_scheduler(n_pods=2)
+            out = client.solve_many(
+                KIND_SIMULATE,
+                [(s1, p1), (_ExplodingScheduler(), [])],
+                timeout=60.0,
+            )
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+        (res, err), (res2, err2) = out
+        assert err is None and res is not None
+        assert res2 is None and isinstance(err2, TransportError)
+        assert "boom" in str(err2)
+
+    def test_base_class_fallback_is_sequential_solves(self):
+        from karpenter_tpu.solverd.transport import SolverClient
+
+        calls = []
+
+        class Seq(SolverClient):
+            def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+                calls.append(scheduler)
+                if scheduler == "bad":
+                    raise RuntimeError("nope")
+                return f"ok-{scheduler}"
+
+        out = Seq().solve_many("simulate", [("a", []), ("bad", []), ("c", [])])
+        assert calls == ["a", "bad", "c"]
+        assert out[0] == ("ok-a", None)
+        assert out[1][0] is None and isinstance(out[1][1], RuntimeError)
+        assert out[2] == ("ok-c", None)
