@@ -548,6 +548,200 @@ def restart_bench(one_pass, build_engine, cache_dir=None) -> dict:
     return out
 
 
+def _fleet_solve_env():
+    """A deterministic solve-batch factory for the fleet/pipeline leg:
+    every call builds a fresh (scheduler, pods) pair over the kwok catalog
+    — fresh because a solve mutates its scheduler — with the pod mix varied
+    by (salt, index) so successive batches look like a real admission
+    stream, not one memoized solve."""
+    from karpenter_tpu.apis.core import (
+        Condition,
+        Container,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.cloudprovider.kwok.instance_types import (
+        construct_instance_types,
+    )
+    from karpenter_tpu.events.recorder import Recorder
+    from karpenter_tpu.ops.catalog import CatalogEngine
+    from karpenter_tpu.runtime.store import Store
+    from karpenter_tpu.scheduler.scheduler import Scheduler
+    from karpenter_tpu.scheduler.topology import Topology
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informer import StateInformer
+    from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    catalog = construct_instance_types()
+    engine = CatalogEngine(catalog)  # client-side: stripped before pickling
+    cpus = ["250m", "500m", "1", "2"]
+
+    def build(n_pods: int, salt: int):
+        clock = FakeClock()
+        store = Store(clock=clock)
+        cluster = Cluster(clock, store, cloud_provider=None)
+        informer = StateInformer(store, cluster)
+        recorder = Recorder(clock=clock)
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.set_condition("Ready", "True")
+        store.create(pool)
+        informer.flush()
+        pods = []
+        for i in range(n_pods):
+            pod = Pod(
+                metadata=ObjectMeta(
+                    name=f"pod-{salt}-{i:05d}", uid=f"uid-{salt}-{i:05d}"
+                ),
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            requests=parse_resource_list(
+                                {"cpu": cpus[(i + salt) % len(cpus)],
+                                 "memory": "1Gi"}
+                            )
+                        )
+                    ]
+                ),
+            )
+            pod.metadata.creation_timestamp = 1000.0 + i
+            pod.status.conditions.append(
+                Condition(
+                    type="PodScheduled", status="False", reason="Unschedulable"
+                )
+            )
+            store.create(pod)
+            pods.append(pod)
+        instance_types = {"default": list(catalog)}
+        topology = Topology(store, cluster, [], [pool], instance_types, pods)
+        scheduler = Scheduler(
+            store, [pool], cluster, [], topology, instance_types, [],
+            recorder, clock, engine=engine,
+        )
+        return scheduler, pods
+
+    return build
+
+
+def spawn_solverd(listen: str, extra_args=()):
+    """Launch `python -m karpenter_tpu.solverd` as a REAL sidecar process
+    (the production deployment shape) and wait for it to answer a stats
+    RPC. A subprocess — not an in-process daemon thread — is the honest
+    substrate for the pipeline measurement: host-side encode and
+    daemon-side device execution genuinely run in parallel instead of
+    time-slicing one GIL. Returns (proc, client)."""
+    import os
+    import subprocess
+    import sys
+
+    from karpenter_tpu.solverd import SocketClient
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "karpenter_tpu.solverd",
+            "--listen", listen, "--coalesce-window", "0",
+            "--log-level", "error", *extra_args,
+        ],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=dict(os.environ),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    client = SocketClient(listen)
+    deadline = time.time() + 180.0  # first jax import can be slow
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"solverd daemon exited rc={proc.returncode} before ready"
+            )
+        if "error" not in client.stats():
+            return proc, client
+        if time.time() > deadline:
+            proc.kill()
+            raise RuntimeError(f"solverd daemon at {listen} never became ready")
+        time.sleep(0.2)
+
+
+def fleet_bench(n_batches: int = 8, n_pods: int = 1200, reps: int = 3) -> dict:
+    """The fleet admission-pipeline leg: a fixed stream of solve batches
+    driven through a REAL sidecar daemon process, pipelined (host-side
+    encode of batch N+1 — the wire pickle — overlapping the daemon's
+    execution of batch N) vs unpipelined (encode and execute strictly
+    serialized).
+
+    Reported best-of-N with gc fenced out of the timed region (container
+    CPU varies ~30% run-to-run; the minimum measures the code, not the
+    neighbors). `encode_overlap_fraction` is the share of total encode wall
+    that ran inside the previous batch's execute window — the quantity the
+    perf floor asserts stays >= 0.5."""
+    import gc
+    import tempfile
+
+    from karpenter_tpu.solverd import KIND_SOLVE, AdmissionPipeline
+
+    build = _fleet_solve_env()
+    tmp = tempfile.mkdtemp(prefix="karpenter-fleet-bench-")
+    proc, client = spawn_solverd(f"{tmp}/solverd.sock")
+    pipeline = AdmissionPipeline(client)
+
+    def stream(salt_base: int):
+        return [build(n_pods, salt_base + i) for i in range(n_batches)]
+
+    try:
+        # warm: daemon-side engine rebuild + every compile this leg needs
+        out = pipeline.run(KIND_SOLVE, stream(0))
+        assert all(err is None for _res, err in out), [e for _r, e in out if e]
+        results: dict[str, dict] = {}
+        for mode, pipelined in (("pipelined", True), ("unpipelined", False)):
+            walls, fractions, stats_best = [], [], None
+            for rep in range(reps):
+                batches = stream((1 + rep) * 100)  # built OUTSIDE the fence
+                gc.collect()
+                gc.disable()
+                try:
+                    start = time.perf_counter()
+                    out = pipeline.run(KIND_SOLVE, batches, pipelined=pipelined)
+                    wall = (time.perf_counter() - start) * 1000.0
+                finally:
+                    gc.enable()
+                assert all(err is None for _res, err in out)
+                if not walls or wall < min(walls):
+                    stats_best = pipeline.stats()
+                walls.append(wall)
+                fractions.append(pipeline.stats()["encode_overlap_fraction"])
+            results[mode] = {
+                "best_ms": round(min(walls), 2),
+                "samples_ms": [round(w, 2) for w in walls],
+                "encode_overlap_fraction": max(fractions),
+                **{
+                    k: stats_best[k]
+                    for k in ("encode_wall_s", "execute_wall_s", "hidden_encode_s")
+                },
+            }
+    finally:
+        import shutil
+
+        client.close()
+        proc.terminate()  # SIGTERM: the daemon's graceful-drain exit path
+        try:
+            proc.wait(timeout=15)
+        except Exception:  # noqa: BLE001 — drain grace blown: hard kill
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "batches": n_batches,
+        "pods_per_batch": n_pods,
+        "pipelined": results["pipelined"],
+        "unpipelined": results["unpipelined"],
+        "speedup": round(
+            results["unpipelined"]["best_ms"] / results["pipelined"]["best_ms"], 3
+        ),
+        "encode_overlap_fraction": results["pipelined"]["encode_overlap_fraction"],
+    }
+
+
 def topology_bench(engine, n: int = 20000, runs: int = 7) -> tuple[float, float]:
     """Topology-engaged solves: n pods across 4 deployments, each zone-
     spread with maxSkew 1 (the topo driver, ops/ffd_topo.py + the count
@@ -738,6 +932,14 @@ def main() -> None:
     consolidation = consolidation_bench(1000)
     consolidation_10k = consolidation_bench(10_000, reps=2)
     topo_ms, topo_cold_ms = topology_bench(engine)
+    fleet = fleet_bench()
+    # self-enforcing pipeline budget (mirrored at reduced scale by
+    # tests/test_perf_floor.py): the double-buffered admission pipeline
+    # must hide at least half of the host-side encode wall
+    assert fleet["encode_overlap_fraction"] >= 0.5, (
+        f"admission pipeline hid only "
+        f"{fleet['encode_overlap_fraction']:.0%} of host encode time"
+    )
 
     # Cold-vs-warm restart leg (LAST: it drops every jit executable). Three
     # restarts of the same daemon: the pre-AOT lazy cold path, the AOT cold
@@ -817,7 +1019,13 @@ def main() -> None:
                     f"{cold_restart['prewarm_ms'] + cold_restart['first_solve_ms']:.0f}ms "
                     f"(prewarm+first solve) vs warm AOT-cache restart "
                     f"{warm_restart['prewarm_ms'] + warm_restart['first_solve_ms']:.0f}ms, "
-                    f"0 fresh ladder compiles asserted"
+                    f"0 fresh ladder compiles asserted; fleet admission "
+                    f"pipeline @{fleet['batches']}x{fleet['pods_per_batch']} "
+                    f"pods over the socket daemon: hides "
+                    f"{fleet['encode_overlap_fraction']:.0%} of host encode "
+                    f"(asserted >=50%), pipelined "
+                    f"{fleet['pipelined']['best_ms']:.0f}ms vs unpipelined "
+                    f"{fleet['unpipelined']['best_ms']:.0f}ms best-of-3"
                 ),
                 "value": round(p50, 2),
                 "unit": "ms",
@@ -832,6 +1040,11 @@ def main() -> None:
                     "@1000": consolidation,
                     "@10000": consolidation_10k,
                 },
+                # fleet admission pipeline (ROADMAP item 4): pipelined vs
+                # unpipelined admission over a real socket daemon at a
+                # fixed batch stream, with the encode-overlap fraction the
+                # perf floor enforces
+                "fleet": fleet,
                 "cold_start": {
                     "prewarm_ms": round(warmup_ms, 2),
                     "first_batch_ms": round(cold_ms, 2),

@@ -508,6 +508,13 @@ class Operator:
             out["error"] = stats["error"]
         if "reconnects" in stats:
             out["reconnects"] = stats["reconnects"]
+        # fleet transport (solverd/fleet.py): the pool view — per-replica
+        # breaker states, failover counters — rides into /healthz and
+        # /debug/health; "reachable" already degrades when every replica's
+        # breaker is open (the fleet stats carry an error then)
+        for key in ("healthy_replicas", "replicas", "failovers", "replays"):
+            if key in stats:
+                out[key] = stats[key]
         self._solver_health_cache = out
 
     def _degraded_reasons(self, solver_health: dict) -> list[str]:

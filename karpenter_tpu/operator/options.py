@@ -68,9 +68,23 @@ class Options:
     # operator; "socket" forwards solves to a sidecar daemon
     # (python -m karpenter_tpu.solverd) at solver_daemon_address.
     solver_transport: str = "inprocess"  # "inprocess" | "socket"
-    solver_daemon_address: str = ""  # "host:port" or unix socket path
+    # one address ("host:port" or unix socket path) talks to a single
+    # daemon; a comma-separated list is a REPLICA POOL — the client routes
+    # by catalog content-hash affinity and fails over on replica loss
+    # (solverd/fleet.py)
+    solver_daemon_address: str = ""
     solverd_queue_depth: int = 256  # admission queue depth (shed past it)
     solverd_coalesce_window: float = 0.0  # seconds the batch leader waits
+    # multi-tenant admission (solverd/queue.py): tenant_quota caps any one
+    # tenant's share of the queue (0 = off); tenant_weights ("gold=4,free=1")
+    # orders mixed drained batches by weighted fair queuing
+    solverd_tenant_quota: int = 0
+    solverd_tenant_weights: str = ""
+    # per-replica circuit breakers in the fleet client: consecutive
+    # transport failures before a replica drops out of rotation, and
+    # seconds before a half-open probe re-admits it
+    solverd_replica_breaker_threshold: int = 3
+    solverd_replica_breaker_cooldown: float = 5.0
     # consolidation frontier search (controllers/disruption + ops/frontier):
     # how many levels of the binary-search decision tree one coalesced
     # simulate batch evaluates speculatively. 1 = the sequential probe
@@ -140,6 +154,10 @@ class Options:
         parser.add_argument("--solver-daemon-address")
         parser.add_argument("--solverd-queue-depth", type=int)
         parser.add_argument("--solverd-coalesce-window", type=float)
+        parser.add_argument("--solverd-tenant-quota", type=int)
+        parser.add_argument("--solverd-tenant-weights")
+        parser.add_argument("--solverd-replica-breaker-threshold", type=int)
+        parser.add_argument("--solverd-replica-breaker-cooldown", type=float)
         parser.add_argument("--consolidation-frontier-depth", type=int)
         parser.add_argument("--compile-cache-dir")
         parser.add_argument("--aot-ladder")
@@ -165,6 +183,8 @@ class Options:
             "solver_backend": "SOLVER_BACKEND",
             "solver_transport": "SOLVER_TRANSPORT",
             "solver_daemon_address": "SOLVER_DAEMON_ADDRESS",
+            "solverd_tenant_quota": "SOLVERD_TENANT_QUOTA",
+            "solverd_tenant_weights": "SOLVERD_TENANT_WEIGHTS",
             "compile_cache_dir": "COMPILE_CACHE_DIR",
             "aot_ladder": "AOT_LADDER",
         }

@@ -93,9 +93,18 @@ def main(argv=None) -> int:
             aot_ladder=args.aot_ladder,
         )
 
-    result = run_scenario(
-        trace, args.seed, options=options, trace_export=args.trace_export
-    )
+    if trace.get("fleet"):
+        # multi-tenant fleet trace: N operator cells over a shared solverd
+        # replica pool (sim/fleet.py) — same CLI surface, combined report
+        from karpenter_tpu.sim.fleet import run_fleet_scenario
+
+        result = run_fleet_scenario(
+            trace, args.seed, options=options, trace_export=args.trace_export
+        )
+    else:
+        result = run_scenario(
+            trace, args.seed, options=options, trace_export=args.trace_export
+        )
 
     if args.events:
         with open(args.events, "w", encoding="utf-8") as f:

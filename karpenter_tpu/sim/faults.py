@@ -152,12 +152,16 @@ class FlakySolverClient(SolverClient):
         self.on_fault = on_fault or _noop_on_fault
         self.rejections = 0
 
-    def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+    def solve(self, kind, scheduler, pods, timeout=None, deadline=None,
+              request_id=None, tenant=None):
         if self.rng.random() < self.rejection_rate:
             self.rejections += 1
             self.on_fault("fault-solver-reject", kind=kind, pods=len(list(pods)))
             raise QueueFullError("sim: injected rejection storm")
-        return self.inner.solve(kind, scheduler, pods, timeout=timeout, deadline=deadline)
+        return self.inner.solve(
+            kind, scheduler, pods, timeout=timeout, deadline=deadline,
+            request_id=request_id, tenant=tenant,
+        )
 
     def stats(self) -> dict:
         stats = dict(self.inner.stats())

@@ -23,6 +23,7 @@ group).
 from __future__ import annotations
 
 import math
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from random import Random
 from typing import Optional
@@ -109,6 +110,43 @@ def build_pod(name: str, group: str, spec: dict) -> Pod:
     return pod
 
 
+# Pinned device RTT for _use_device routing (ops/catalog.device_rtt_s):
+# the measured RTT is wall-clock and machine-dependent, so borderline
+# cubes could route host on one run and device on the next — and
+# report["kernels"] dispatch counts would not be a pure function of
+# (scenario, seed). 100µs sits at the co-located-chip scale: small
+# cubes keep the exact host twins, large cubes keep the device.
+PINNED_RTT_S = 100e-6
+
+
+@contextmanager
+def sim_globals(seed: int, clock: FakeClock):
+    """The process-global discipline every deterministic run needs, held
+    for exactly the run's duration: seeded uid source, blocking FakeClock
+    sleeps, a fresh kernel-observatory warmup window, hermetic engines
+    (a content-cached engine from an earlier sim would already be warm and
+    its warmup dispatches would not repeat), and the pinned device RTT.
+    One `with` block serves a single-tenant Simulation.run() or a whole
+    multi-tenant FleetSimulation — the globals are process-wide either
+    way, so they must be entered once per run, never per cell."""
+    from karpenter_tpu.controllers.provisioning import provisioner as provmod
+    from karpenter_tpu.observability import kernels as kobs
+    from karpenter_tpu.ops import catalog as catmod
+
+    apicore.set_uid_source(Random(f"{seed}:uids"))
+    clock.enable_blocking_sleep()
+    kobs.registry().unseal()
+    provmod._ENGINE_CONTENT_CACHE.clear()
+    pinned_prev = catmod.PINNED_RTT
+    catmod.PINNED_RTT = PINNED_RTT_S
+    try:
+        yield
+    finally:
+        catmod.PINNED_RTT = pinned_prev
+        apicore.set_uid_source(None)
+        clock.disable_blocking_sleep()
+
+
 class Simulation:
     def __init__(
         self,
@@ -117,11 +155,14 @@ class Simulation:
         options: Optional[Options] = None,
         registration_delay: float = 2.0,
         trace_export: Optional[str] = None,
+        clock: Optional[FakeClock] = None,
+        solver_factory=None,
+        configure_tracer: bool = True,
     ):
         tracemod.validate(trace)
         self.trace = trace
         self.seed = seed
-        self.clock = FakeClock()
+        self.clock = clock if clock is not None else FakeClock()
         self.t0 = self.clock.now()
         self.log = EventLog()
         self.store = Store(clock=self.clock)
@@ -146,20 +187,30 @@ class Simulation:
         self.operator = Operator(
             self.store, self.provider, clock=self.clock, options=options or Options()
         )
+        # a multi-tenant coordinator (sim/fleet.py) swaps the freshly built
+        # in-process client for its shared replica pool BEFORE any fault
+        # wrapping, so the flaky layer and the scenario see the pool
+        if solver_factory is not None:
+            self.operator.provisioner.solver = solver_factory(self)
         # re-install the tracer the Operator just configured, in DETERMINISTIC
         # mode: full sampling (journeys and the span digest must be complete),
         # volatile wall-clock attrs dropped at export — so two same-seed runs
         # emit byte-identical span logs, and the digest below is a regression
-        # fingerprint exactly like the event-log digest
+        # fingerprint exactly like the event-log digest. (The tracer is
+        # process-global: a multi-tenant coordinator configures it ONCE
+        # after building every cell, so it passes configure_tracer=False.)
         from karpenter_tpu import tracing
 
-        self.tracer = tracing.configure(
-            clock=self.clock,
-            sample_rate=1.0,
-            deterministic=True,
-            buffer_size=(options or Options()).trace_buffer_size,
-            jsonl_path=trace_export,
-        )
+        if configure_tracer:
+            self.tracer = tracing.configure(
+                clock=self.clock,
+                sample_rate=1.0,
+                deterministic=True,
+                buffer_size=(options or Options()).trace_buffer_size,
+                jsonl_path=trace_export,
+            )
+        else:
+            self.tracer = tracing.tracer()
         self.operator.tracer = self.tracer
         # the operator's cloud-provider circuit breaker is part of the
         # scenario's observable record: every transition lands in the event
@@ -227,51 +278,46 @@ class Simulation:
 
     # -- the loop ------------------------------------------------------------
 
-    # Pinned device RTT for _use_device routing (ops/catalog.device_rtt_s):
-    # the measured RTT is wall-clock and machine-dependent, so borderline
-    # cubes could route host on one run and device on the next — and
-    # report["kernels"] dispatch counts would not be a pure function of
-    # (scenario, seed). 100µs sits at the co-located-chip scale: small
-    # cubes keep the exact host twins, large cubes keep the device.
-    PINNED_RTT_S = 100e-6
+    # kept as a class attr for callers that referenced it here
+    PINNED_RTT_S = PINNED_RTT_S
+
+    def prepare(self) -> None:
+        """Stage the run: create nodepools, arm the trace-event queue and
+        the first controller tick. Split out of run() so a multi-tenant
+        coordinator can prepare every cell before driving one shared
+        clock."""
+        for np_spec in self.trace.get("nodepools", [{"name": "workers"}]):
+            self.store.create(self._nodepool(np_spec))
+        self._events = list(self.trace["events"])
+        self._next_pass = self.t0
+        self._tick = float(self.trace.get("tick", 1.0))
+
+    def next_due(self) -> float:
+        """The next virtual time this cell needs the clock to reach: its
+        next trace event or its next controller tick."""
+        t_event = self.t0 + self._events[0]["at"] if self._events else math.inf
+        return min(self._next_pass, t_event)
+
+    def step(self) -> None:
+        """Apply every due trace event, then run one operator pass if the
+        tick is due — at the clock's CURRENT time (the caller owns time)."""
+        while self._events and self.t0 + self._events[0]["at"] <= self.clock.now():
+            self._apply(self._events.pop(0))
+        if self.clock.now() >= self._next_pass:
+            summary = self.operator.run_once()
+            self._workloads()
+            self._observe(summary)
+            self._next_pass = self.clock.now() + self._tick
 
     def run(self) -> SimResult:
         end = self.t0 + float(self.trace["duration"])
-        tick = float(self.trace.get("tick", 1.0))
-        events = list(self.trace["events"])
-        apicore.set_uid_source(Random(f"{self.seed}:uids"))
-        self.clock.enable_blocking_sleep()
-        from karpenter_tpu.observability import kernels as kobs
-        from karpenter_tpu.ops import catalog as catmod
-
-        # fresh-run kernel phases: the run's prewarm + first batch land in
-        # "warmup" (the provisioner re-seals after its first solve), so two
-        # same-seed runs — in CI, two cold processes — report identical
-        # phase splits
-        kobs.registry().unseal()
-        # hermetic engines: a content-cached engine from an earlier sim in
-        # this process would already be warm and already hold interned rows
-        # and joint masks, so its warmup/row-kernel dispatches would not
-        # repeat and report["kernels"] would depend on process history. A
-        # run always builds (and re-warms) its engines from scratch; the
-        # jit executable cache stays warm, which only affects walls — never
-        # deterministic counts.
-        from karpenter_tpu.controllers.provisioning import provisioner as provmod
-
-        provmod._ENGINE_CONTENT_CACHE.clear()
-        pinned_prev = catmod.PINNED_RTT
-        catmod.PINNED_RTT = self.PINNED_RTT_S
-        try:
-            for np_spec in self.trace.get("nodepools", [{"name": "workers"}]):
-                self.store.create(self._nodepool(np_spec))
-            next_pass = self.t0
+        with sim_globals(self.seed, self.clock):
+            self.prepare()
             while True:
-                t_event = (
-                    self.t0 + events[0]["at"] if events else math.inf
-                )
                 t_worker = self.clock.next_wakeup()
                 t_next = min(
-                    next_pass, t_event, math.inf if t_worker is None else t_worker
+                    self.next_due(),
+                    math.inf if t_worker is None else t_worker,
                 )
                 if t_next > end:
                     break
@@ -279,53 +325,56 @@ class Simulation:
                     # virtual time jumps straight to the next due event —
                     # this is the "no sleeping" core of the simulator
                     self.clock.set_time(t_next)
-                while events and self.t0 + events[0]["at"] <= self.clock.now():
-                    self._apply(events.pop(0))
-                if self.clock.now() >= next_pass:
-                    summary = self.operator.run_once()
-                    self._workloads()
-                    self._observe(summary)
-                    next_pass = self.clock.now() + tick
-            report = Accountant(self.kwok.instance_types, self.t0).report(
-                self.log,
-                end,
-                scenario=self.trace.get("name", ""),
-                seed=self.seed,
-                solver_stats=self._solver_stats(),
-            )
-            self.operator.shutdown()
-            # fold the scheduling traces into the report: the span-log
-            # digest (determinism fingerprint) and per-stage journey
-            # p50/p99 over every pod that completed its journey
-            report["tracing"] = {
-                "span_digest": self.tracer.digest.digest(),
-                "spans": self.tracer.digest.count,
-                "journeys": self.tracer.journeys.stats(),
-            }
-            # the kernel observatory section: per-(kernel, shape bucket,
-            # phase) dispatch count deltas + steady recompiles, digested —
-            # byte-deterministic across same-seed runs under the pinned RTT;
-            # walls and compile counts ride in its volatile appendix
-            report["kernels"] = kobs.registry().report(self._kernels_base)
-            # AOT compile-service deltas, deliberately OUTSIDE the digest
-            # (cache hits are process/disk history, not scenario facts)
-            from karpenter_tpu.aot import runtime as aotrt
-
-            report["kernels"]["aot"] = aotrt.stats_delta(self._aot_base)
-            # consolidation frontier search: this run's rounds/probes per
-            # consolidation type plus the solverd frontier groups that
-            # coalesced — deterministic (decision-path) facts
-            snap = self._frontier_snapshot()
-            report["frontier"] = {
-                key: round(snap[key] - self._frontier_base[key], 6)
-                for key in snap
-            }
+                self.step()
+            report = self.finalize(end)
             self.tracer.close()  # flush the JSONL export, if any
             return SimResult(report=report, digest=self.log.digest(), log=self.log)
-        finally:
-            catmod.PINNED_RTT = pinned_prev
-            apicore.set_uid_source(None)
-            self.clock.disable_blocking_sleep()
+
+    def finalize(self, end: float, process_sections: bool = True) -> dict:
+        """Fold the run into its report and shut the operator down. The
+        process-global sections (tracing digest, kernel observatory, AOT,
+        frontier counters) are singletons — a multi-tenant coordinator
+        passes process_sections=False per cell and folds them ONCE at pool
+        level instead."""
+        from karpenter_tpu.observability import kernels as kobs
+
+        report = Accountant(self.kwok.instance_types, self.t0).report(
+            self.log,
+            end,
+            scenario=self.trace.get("name", ""),
+            seed=self.seed,
+            solver_stats=self._solver_stats(),
+        )
+        self.operator.shutdown()
+        if not process_sections:
+            return report
+        # fold the scheduling traces into the report: the span-log
+        # digest (determinism fingerprint) and per-stage journey
+        # p50/p99 over every pod that completed its journey
+        report["tracing"] = {
+            "span_digest": self.tracer.digest.digest(),
+            "spans": self.tracer.digest.count,
+            "journeys": self.tracer.journeys.stats(),
+        }
+        # the kernel observatory section: per-(kernel, shape bucket,
+        # phase) dispatch count deltas + steady recompiles, digested —
+        # byte-deterministic across same-seed runs under the pinned RTT;
+        # walls and compile counts ride in its volatile appendix
+        report["kernels"] = kobs.registry().report(self._kernels_base)
+        # AOT compile-service deltas, deliberately OUTSIDE the digest
+        # (cache hits are process/disk history, not scenario facts)
+        from karpenter_tpu.aot import runtime as aotrt
+
+        report["kernels"]["aot"] = aotrt.stats_delta(self._aot_base)
+        # consolidation frontier search: this run's rounds/probes per
+        # consolidation type plus the solverd frontier groups that
+        # coalesced — deterministic (decision-path) facts
+        snap = self._frontier_snapshot()
+        report["frontier"] = {
+            key: round(snap[key] - self._frontier_base[key], 6)
+            for key in snap
+        }
+        return report
 
     @staticmethod
     def _frontier_snapshot() -> dict:

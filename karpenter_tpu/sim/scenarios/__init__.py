@@ -77,6 +77,11 @@ register(
     "solver daemon restarts mid-trace; warm-starts from the AOT cache when configured",
 )
 register(
+    "fleet-replica-kill",
+    tracemod.fleet_replica_kill,
+    "3 tenant clusters on a 2-replica solverd pool; one replica SIGKILLed mid-run",
+)
+register(
     "consolidation-churn",
     tracemod.consolidation_churn,
     "fan-out waves drain into underutilized fleets; multi-node frontier consolidation folds them",

@@ -71,6 +71,30 @@ def validate(trace: dict) -> dict:
         if ev["at"] < last:
             raise ValueError("trace events must be sorted by 'at'")
         last = ev["at"]
+    if "fleet" in trace:
+        fleet = trace["fleet"]
+        if int(fleet.get("replicas", 0)) < 1:
+            raise ValueError("fleet trace needs fleet.replicas >= 1")
+        tenants = trace.get("tenants")
+        if not tenants:
+            raise ValueError("fleet trace needs a non-empty 'tenants' list")
+        names = set()
+        for tenant in tenants:
+            if "name" not in tenant or "trace" not in tenant:
+                raise ValueError(f"fleet tenant missing name/trace: {tenant!r}")
+            if tenant["name"] in names:
+                raise ValueError(f"duplicate fleet tenant {tenant['name']!r}")
+            names.add(tenant["name"])
+            validate(tenant["trace"])
+        last = -math.inf
+        for kill in fleet.get("kills", []):
+            if "at" not in kill or "replica" not in kill:
+                raise ValueError(f"fleet kill missing at/replica: {kill!r}")
+            if not 0 <= int(kill["replica"]) < int(fleet["replicas"]):
+                raise ValueError(f"fleet kill names unknown replica: {kill!r}")
+            if kill["at"] < last:
+                raise ValueError("fleet kills must be sorted by 'at'")
+            last = kill["at"]
     return trace
 
 
@@ -341,6 +365,135 @@ def solverd_restart(rng: Random) -> dict:
             "replace": True,
         },
     ]
+    return trace
+
+
+def fleet_replica_kill(rng: Random) -> dict:
+    """The solverd-fleet availability gauntlet: three tenant clusters with
+    distinct workload shapes share a 2-replica solver pool, and one replica
+    is killed (SIGKILL — no drain, no goodbye) mid-trace. The survivors'
+    client-side breakers must open, affinity routing must converge on the
+    surviving replica, and every tenant's demand — including a post-kill
+    scale-up landing right on the failover path — must still bind with no
+    pod left unschedulable and zero double-executed solves."""
+    duration = 240.0
+    trace = {
+        "version": TRACE_VERSION,
+        "name": "fleet-replica-kill",
+        "duration": duration,
+        "tick": 2.0,
+        "fleet": {
+            "replicas": 2,
+            # a modest per-tenant quota: big enough that well-behaved
+            # tenants never trip it, live so the quota metrics are
+            # exercised end to end
+            "tenant_quota": 32,
+            "kills": [{"at": 120.0, "replica": 0}],
+        },
+        "tenants": [],
+        "events": [],
+    }
+
+    def tenant(name: str, weight: float, events: list) -> dict:
+        return {
+            "name": name,
+            "weight": weight,
+            "trace": {
+                "version": TRACE_VERSION,
+                "name": f"{name}-stream",
+                "duration": duration,
+                "tick": 2.0,
+                "nodepools": [{"name": "workers", "consolidate_after": 15.0}],
+                "faults": {},
+                "events": sorted(events, key=lambda e: e["at"]),
+            },
+        }
+
+    # tenant-web: steady service footprint, weighted heaviest
+    trace["tenants"].append(
+        tenant(
+            "tenant-web",
+            2.0,
+            [
+                {
+                    "at": 4.0,
+                    "kind": "submit",
+                    "group": "web",
+                    "count": 4 + rng.randrange(3),
+                    "pod": {"cpu": "2", "memory": "2Gi"},
+                    "replace": True,
+                },
+                # a scale-up right after the kill, sized so it cannot bind
+                # onto existing headroom: the very next solves MUST ride the
+                # failover path onto the surviving replica
+                {
+                    "at": 130.0,
+                    "kind": "submit",
+                    "group": "web-scaleup",
+                    "count": 2 + rng.randrange(2),
+                    "pod": {"cpu": "16", "memory": "32Gi"},
+                    "replace": True,
+                },
+                {
+                    "at": 170.0,
+                    "kind": "submit",
+                    "group": "web-burst",
+                    "count": 2,
+                    "pod": {"cpu": "16", "memory": "32Gi"},
+                    "until": 220.0,
+                    "replace": True,
+                },
+            ],
+        )
+    )
+    # tenant-batch: short-lived job waves, churning before and after the kill
+    batch_events = []
+    at = 6.0
+    i = 0
+    while at < duration - 60.0:
+        batch_events.append(
+            {
+                "at": round(at, 3),
+                "kind": "submit",
+                "group": f"job-{i}",
+                "count": 2 + rng.randrange(3),
+                "pod": {"cpu": "2", "memory": "4Gi"},
+                "until": round(at + 50.0 + rng.randrange(20), 3),
+                "replace": False,
+            }
+        )
+        at += 55.0 + rng.randrange(15)
+        i += 1
+    trace["tenants"].append(tenant("tenant-batch", 1.0, batch_events))
+    # tenant-ml: a small long-running training footprint
+    trace["tenants"].append(
+        tenant(
+            "tenant-ml",
+            1.0,
+            [
+                {
+                    "at": 8.0,
+                    "kind": "submit",
+                    "group": "trainer",
+                    "count": 2,
+                    "pod": {"cpu": "8", "memory": "16Gi"},
+                    "replace": True,
+                },
+                # post-kill evaluation burst: this tenant's affinity also
+                # pointed at the doomed replica, so its first post-kill
+                # provisioning solve exercises failover from a second tenant
+                {
+                    "at": 140.0,
+                    "kind": "submit",
+                    "group": "eval",
+                    "count": 2,
+                    "pod": {"cpu": "8", "memory": "32Gi"},
+                    "until": 210.0,
+                    "replace": True,
+                },
+            ],
+        )
+    )
     return trace
 
 

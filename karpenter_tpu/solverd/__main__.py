@@ -4,16 +4,25 @@ Runs a SolverDaemon on --listen (host:port or a unix socket path), owning
 the accelerator for every operator replica pointed at it via
 `--solver-transport socket --solver-daemon-address <addr>`. The daemon is
 stateless between requests — each request carries its full solve state —
-so it can restart freely; clients reconnect on the next call.
+so it can restart freely; clients reconnect on the next call. Run several
+(one --replica-id each) and list every address in the operators'
+--solver-daemon-address to form a fleet with client-side failover.
+
+Shutdown is graceful on SIGTERM/SIGINT: in-flight batches finish, new
+requests are answered with a typed `Draining` rejection (shed, never
+block — a pool client fails over on it), and the process exits once the
+queue quiesces or --drain-grace expires.
 """
 
 from __future__ import annotations
 
 import argparse
+import signal
 import sys
-import time
+import threading
 
 from karpenter_tpu.operator import logging as klog
+from karpenter_tpu.solverd.queue import parse_tenant_weights
 from karpenter_tpu.solverd.service import SolverService
 from karpenter_tpu.solverd.transport import SolverDaemon
 from karpenter_tpu.utils.clock import Clock
@@ -27,12 +36,30 @@ def main(argv=None) -> int:
         help="host:port or unix socket path to serve on",
     )
     parser.add_argument(
+        "--replica-id", default="",
+        help="identity this replica answers as in replies/metrics/spans "
+        "(default: the bound listen address)",
+    )
+    parser.add_argument(
         "--queue-depth", type=int, default=256,
         help="admission queue depth; excess requests are rejected",
     )
     parser.add_argument(
         "--coalesce-window", type=float, default=0.005,
         help="seconds the batch leader waits for concurrent requests",
+    )
+    parser.add_argument(
+        "--tenant-quota", type=int, default=0,
+        help="per-tenant cap on queued solves (0 = off): a noisy tenant is "
+        "shed with a typed TenantQuotaExceeded, quiet tenants keep headroom",
+    )
+    parser.add_argument(
+        "--tenant-weights", default="",
+        help="weighted fair drain order for mixed batches, e.g. 'gold=4,free=1'",
+    )
+    parser.add_argument(
+        "--drain-grace", type=float, default=10.0,
+        help="seconds SIGTERM waits for in-flight batches before exiting",
     )
     parser.add_argument(
         "--compile-cache-dir", default="",
@@ -66,21 +93,44 @@ def main(argv=None) -> int:
         clock=Clock(),
         max_queue_depth=ns.queue_depth,
         coalesce_window=ns.coalesce_window,
+        tenant_quota=ns.tenant_quota,
+        tenant_weights=parse_tenant_weights(ns.tenant_weights),
     )
-    daemon = SolverDaemon(service, address=ns.listen).start()
+    daemon = SolverDaemon(
+        service, address=ns.listen, replica_id=ns.replica_id
+    ).start()
     log.info(
         "solver daemon listening",
         address=daemon.address,
+        replica=daemon.replica_id,
         queue_depth=ns.queue_depth,
         coalesce_window=ns.coalesce_window,
+        tenant_quota=ns.tenant_quota,
         aot=aotrt.enabled(),
         compile_cache_dir=ns.compile_cache_dir or None,
     )
+
+    # Graceful drain on SIGTERM (and ctrl-C): the handler only sets an
+    # event — all teardown runs on the main thread, outside signal context.
+    stop = threading.Event()
+
+    def _request_shutdown(signum, frame) -> None:  # noqa: ARG001
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _request_shutdown)
+        except (ValueError, OSError):
+            pass  # non-main thread / unsupported platform: rely on finally
     try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        log.info("shutdown requested")
+        stop.wait()
+        log.info(
+            "shutdown requested: draining",
+            in_flight=service.queue.depth(),
+            grace=ns.drain_grace,
+        )
+        quiesced = daemon.drain_and_stop(grace=ns.drain_grace)
+        log.info("drained" if quiesced else "drain grace expired", clean=quiesced)
     finally:
         daemon.stop()
         service.close()

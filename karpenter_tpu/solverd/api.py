@@ -34,7 +34,29 @@ class DeadlineExceededError(SolverRejection):
 
 
 class SolverClosedError(SolverRejection):
-    """The service is shutting down and admits nothing."""
+    """The service is shutting down and admits nothing.
+
+    ``failover = True``: a closed replica is *gone*, not overloaded — a
+    pool client should re-route the request to a healthy sibling instead
+    of surfacing the rejection."""
+
+    failover = True
+
+
+class DrainingError(SolverRejection):
+    """The service is draining (SIGTERM): in-flight batches finish, new
+    requests are refused with this typed answer — shed, never block — so a
+    pool client fails over to a replica that is not about to exit."""
+
+    failover = True
+
+
+class TenantQuotaExceededError(SolverRejection):
+    """The tenant's share of the admission queue is exhausted; the request
+    was shed WITHOUT touching other tenants' headroom. Deliberately not a
+    failover trigger: the quota is per-tenant policy, and hopping replicas
+    to escape it would let a noisy tenant multiply its share by the pool
+    size."""
 
 
 class TransportError(Exception):
@@ -64,6 +86,13 @@ class SolveRequest:
     request, not ambient state: a coalesced batch executes many callers'
     requests on one leader thread.
 
+    `request_id` identifies the solve across retries: a transport that
+    replays an in-flight frame (reconnect, pool failover) reuses the id, and
+    the service dedupes on it — a replayed solve attaches to the original
+    admission instead of admitting (and executing) twice. `tenant` names the
+    requesting cluster for per-tenant admission quotas and weighted
+    fairness; empty string is the single-tenant default.
+
     `group` tags requests submitted together as one structured batch — the
     consolidation frontier search tags each round's probes with one group
     id. `group_nested` declares the group's pod sets are nested prefixes
@@ -82,3 +111,23 @@ class SolveRequest:
     trace_context: Optional[dict] = None
     group: Optional[str] = None
     group_nested: bool = False
+    request_id: str = ""
+    tenant: str = ""
+
+
+def new_request_id() -> str:
+    """A fresh request id. Rides the seeded uid source when one is
+    installed (apis/core) so simulated runs stay byte-deterministic."""
+    from karpenter_tpu.apis.core import new_uid
+
+    return f"req-{new_uid()}"
+
+
+def should_failover(err: Exception) -> bool:
+    """Whether a pool client should replay this failure on another replica:
+    transport loss (the daemon may never have seen the frame) and
+    going-away rejections (draining / closed) — never backpressure answers
+    (queue full, deadline, tenant quota) and never solve outcomes."""
+    if isinstance(err, TransportError):
+        return True
+    return isinstance(err, SolverRejection) and getattr(err, "failover", False)

@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional
 
 from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry
 from karpenter_tpu.solverd.api import (
+    DrainingError,
     SolveRequest,
     SolverClosedError,
     SolverRejection,
@@ -48,6 +50,11 @@ _QUEUE_LATENCY = global_registry.histogram(
     "karpenter_solverd_queue_latency_seconds",
     "admission-to-execution wait per request",
 )
+_DEDUP_HITS = global_registry.counter(
+    "karpenter_solverd_dedup_hits_total",
+    "replayed solve requests answered from the request-id dedup record "
+    "instead of being admitted (and executed) a second time",
+)
 
 
 class _Entry:
@@ -66,6 +73,25 @@ class _Entry:
         self.event.set()
 
 
+class _Completed:
+    """A finished solve's lightweight dedup record: same result/error/done
+    surface as a finished _Entry, without keeping the request (and its
+    scheduler graph) alive. A replayed request id resolves to this and
+    returns immediately — never re-admitted, never re-executed."""
+
+    __slots__ = ("result", "error")
+    done = True
+
+    def __init__(self, result, error):
+        self.result = result
+        self.error = error
+
+
+# completed dedup records kept per service; the records are tiny (result +
+# error references) but the cap bounds result-graph retention too
+_DEDUP_CAP = 1024
+
+
 class SolverService:
     def __init__(
         self,
@@ -73,14 +99,33 @@ class SolverService:
         max_queue_depth: int = 256,
         coalesce_window: float = 0.0,
         coalescer: Optional[Coalescer] = None,
+        tenant_quota: int = 0,
+        tenant_weights: Optional[dict] = None,
     ):
         self.clock = clock or Clock()
-        self.queue = AdmissionQueue(self.clock, max_depth=max_queue_depth)
+        self.queue = AdmissionQueue(
+            self.clock,
+            max_depth=max_queue_depth,
+            tenant_quota=tenant_quota,
+            tenant_weights=tenant_weights,
+        )
         self.coalescer = coalescer or Coalescer()
         self.coalesce_window = coalesce_window
         self._lock = threading.Lock()
         self._executing = False
         self._closed = False
+        self._draining = False
+        # request-id dedup: in-flight entries so a replay attaches to the
+        # original admission, completed records so a replay of a finished
+        # solve answers from the record. Bounded FIFO eviction of completed
+        # records only — in-flight entries are pinned (and bounded by the
+        # admission queue anyway).
+        self._dedup: OrderedDict[str, object] = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        # executed request ids (bounded): the fleet sim's zero-double-execute
+        # audit reads this; {} once the cap trips (audit reports overflow)
+        self.executed_ids: dict[str, int] = {}
+        self.executed_ids_overflow = False
         # cumulative stats for /debug/solverd (metrics carry the
         # histograms). Mutated and snapshotted only under _stats_lock so a
         # concurrent /debug/solverd read sees a mutually consistent set —
@@ -92,16 +137,41 @@ class SolverService:
         self.executed = 0
         self.rejected = 0
         self.cancelled = 0
+        self.deduped = 0
         self.max_batch_size = 0
         self.last_batch_seconds = 0.0
 
     # -- client surface ------------------------------------------------------
 
-    def submit(self, request: SolveRequest) -> _Entry:
+    def submit(self, request: SolveRequest):
         """Admit a request; raises a typed SolverRejection when shed. The
-        returned entry completes on a later run_pending()/solve() drain."""
+        returned entry completes on a later run_pending()/solve() drain.
+
+        Replay dedup: a request id already known — in flight or completed —
+        returns the ORIGINAL entry (or its completed record) without
+        touching the admission queue, so a transport replay (reconnect
+        after a dropped connection, pool failover back to this replica) can
+        never admit or execute the same solve twice."""
         if self._closed:
             raise SolverClosedError("solver service is closed")
+        rid = request.request_id
+        if rid:
+            with self._dedup_lock:
+                known = self._dedup.get(rid)
+            if known is not None:
+                with self._stats_lock:
+                    self.deduped += 1
+                _DEDUP_HITS.inc()
+                return known
+        if self._draining:
+            with self._stats_lock:
+                self.rejected += 1
+            from karpenter_tpu.solverd.queue import _REJECTIONS
+
+            _REJECTIONS.inc({"reason": "draining"})
+            raise DrainingError(
+                "solver service is draining; replay on another replica"
+            )
         entry = _Entry(request)
         try:
             self.queue.offer(entry)
@@ -109,10 +179,32 @@ class SolverService:
             with self._stats_lock:
                 self.rejected += 1
             raise
+        if rid:
+            with self._dedup_lock:
+                self._dedup[rid] = entry
+                while len(self._dedup) > _DEDUP_CAP:
+                    # evict oldest COMPLETED record; in-flight entries stay
+                    for key in self._dedup:
+                        if isinstance(self._dedup[key], _Completed):
+                            del self._dedup[key]
+                            break
+                    else:
+                        break
         with self._stats_lock:
             self.requests += 1
         _REQUESTS.inc({"kind": request.kind})
         return entry
+
+    def _seal_dedup(self, entry: _Entry) -> None:
+        """Swap a finished entry's dedup slot for its lightweight completed
+        record — future replays answer from it, and the request's scheduler
+        graph is released."""
+        rid = entry.request.request_id
+        if not rid:
+            return
+        with self._dedup_lock:
+            if self._dedup.get(rid) is entry:
+                self._dedup[rid] = _Completed(entry.result, entry.error)
 
     def solve(self, request: SolveRequest):
         """Admit + execute, returning the solve's Results (or raising its
@@ -160,9 +252,29 @@ class SolverService:
             try:
                 entries.append(self.submit(request))
             except SolverRejection:
-                cancelled = self.queue.remove(entries)
+                # cancel only entries THIS call admitted (entry.request is
+                # our request object): a dedup hit returns someone else's
+                # in-flight entry, and un-admitting it would shed a solve
+                # its real owner is still waiting on
+                fresh = [
+                    e
+                    for req, e in zip(requests, entries)
+                    if getattr(e, "request", None) is req
+                ]
+                removed = self.queue.remove(fresh)
+                # release the un-admitted entries' dedup slots: they will
+                # never finish, so leaving them would wedge a replay of the
+                # same ids (attached to entries no drain completes) and pin
+                # the eviction queue. Entries a concurrent leader already
+                # drained stay — they WILL finish, and a replay must keep
+                # attaching to them, not re-admit.
+                with self._dedup_lock:
+                    for entry in removed:
+                        rid = entry.request.request_id
+                        if rid and self._dedup.get(rid) is entry:
+                            del self._dedup[rid]
                 with self._stats_lock:
-                    self.cancelled += cancelled
+                    self.cancelled += len(removed)
                 raise
         while True:
             leader = False
@@ -213,6 +325,7 @@ class SolverService:
                 )
             entry.error = err
             entry.finish()
+            self._seal_dedup(entry)
         if not ready:
             return 0
         for entry in ready:
@@ -235,7 +348,14 @@ class SolverService:
             for entry in ready:
                 if entry.result is None and entry.error is None:
                     entry.error = RuntimeError("solve batch aborted")
+                rid = entry.request.request_id
+                if rid:
+                    if len(self.executed_ids) < _DEDUP_CAP:
+                        self.executed_ids[rid] = self.executed_ids.get(rid, 0) + 1
+                    else:
+                        self.executed_ids_overflow = True
                 entry.finish()
+                self._seal_dedup(entry)
         with self._stats_lock:
             self.executed += len(ready)
             self.last_batch_seconds = time.perf_counter() - started
@@ -252,6 +372,24 @@ class SolverService:
             pass
         return len(ready)
 
+    def drain(self) -> None:
+        """Enter draining mode: in-flight and already-admitted work finishes,
+        every new submit is refused with a typed DrainingError (shed, never
+        block). The daemon's SIGTERM path calls this, waits for
+        quiesced(), then exits."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def quiesced(self) -> bool:
+        """Nothing queued and no batch executing — safe to exit."""
+        with self._lock:
+            executing = self._executing
+        return not executing and self.queue.depth() == 0
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -260,6 +398,7 @@ class SolverService:
         for entry in ready + expired:
             entry.error = SolverClosedError("solver service closed")
             entry.finish()
+            self._seal_dedup(entry)
 
     def stats(self) -> dict:
         from karpenter_tpu.ops import ffd
@@ -275,6 +414,7 @@ class SolverService:
                 "executed": self.executed,
                 "rejected": self.rejected,
                 "cancelled": self.cancelled,
+                "deduped": self.deduped,
                 "max_batch_size": self.max_batch_size,
                 "last_batch_seconds": self.last_batch_seconds,
             }
@@ -282,6 +422,9 @@ class SolverService:
             "transport": "inprocess",
             "queue_depth": self.queue.depth(),
             "queue_cap": self.queue.max_depth,
+            "tenant_quota": self.queue.tenant_quota,
+            "tenant_depths": self.queue.tenant_depths(),
+            "draining": self._draining,
             "coalesce_window": self.coalesce_window,
             **counters,
             "joint_sweeps": ffd.JOINT_SWEEPS,

@@ -54,14 +54,28 @@ _ERROR_TYPES = {
         api.QueueFullError,
         api.DeadlineExceededError,
         api.SolverClosedError,
+        api.DrainingError,
+        api.TenantQuotaExceededError,
     )
 }
 
 
 class SolverClient:
-    """The one interface both transports implement."""
+    """The one interface every transport implements.
+
+    `tenant` names the requesting cluster on every request (per-tenant
+    quotas and fairness are enforced service-side). `request_id` — minted
+    per solve unless the caller (a pool client replaying onto another
+    replica) supplies one — makes retries dedup-safe.
+
+    encode()/solve_prepared() split a solve into its host-side encode
+    (building the wire frame: the pickle on the socket transport) and the
+    round trip that executes it, so an admission pipeline can encode batch
+    N+1 while batch N executes on the device. `solve(args...)` is always
+    `solve_prepared(encode(args...))`."""
 
     transport = "none"
+    tenant = ""
 
     def solve(
         self,
@@ -70,8 +84,48 @@ class SolverClient:
         pods,
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
+        request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ):
+        return self.solve_prepared(
+            self.encode(
+                kind, scheduler, pods, timeout, deadline,
+                request_id=request_id, tenant=tenant,
+            )
+        )
+
+    def encode(
+        self,
+        kind: str,
+        scheduler,
+        pods,
+        timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
+        request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        trace_carrier: Optional[dict] = None,
+    ):
+        """Host-side encode: everything that can be prepared without the
+        device or the wire. Returns an opaque prepared request for
+        solve_prepared(). The base/in-process prepared form is just the
+        captured arguments — there is no serialization to front-run."""
         raise NotImplementedError
+
+    def solve_prepared(self, prepared):
+        raise NotImplementedError
+
+    def solve_begin(self, prepared):
+        """Start a prepared solve and return an in-flight handle: a
+        transport that can leave the request on the wire (the socket
+        client) sends the frame now, so the caller can encode the NEXT
+        batch while the daemon executes this one, then collect with
+        solve_finish(). The base implementation is synchronous — begin is
+        a no-op and finish executes — so pipelining degrades gracefully on
+        transports with no wire to overlap."""
+        return prepared
+
+    def solve_finish(self, handle):
+        return self.solve_prepared(handle)
 
     def solve_many(
         self,
@@ -81,6 +135,8 @@ class SolverClient:
         deadline: Optional[float] = None,
         group: Optional[str] = None,
         nested: bool = False,
+        request_ids: Optional[list] = None,
+        tenant: Optional[str] = None,
     ) -> list:
         """Run a structured batch of solves — `batch` is [(scheduler, pods),
         ...] — returning per-item (result, error) tuples in order. The
@@ -91,9 +147,19 @@ class SolverClient:
         implementation degrades to sequential solves for transports without
         a batched path — decisions are identical, only coalescing is lost."""
         out = []
-        for scheduler, pods in batch:
+        batch = list(batch)
+        ids = request_ids or [None] * len(batch)
+        for (scheduler, pods), rid in zip(batch, ids):
             try:
-                out.append((self.solve(kind, scheduler, pods, timeout, deadline), None))
+                out.append(
+                    (
+                        self.solve(
+                            kind, scheduler, pods, timeout, deadline,
+                            request_id=rid, tenant=tenant,
+                        ),
+                        None,
+                    )
+                )
             except Exception as err:  # noqa: BLE001 — per-item error slots
                 out.append((None, err))
         return out
@@ -108,31 +174,42 @@ class SolverClient:
 class InProcessClient(SolverClient):
     transport = "inprocess"
 
-    def __init__(self, service: SolverService):
+    def __init__(self, service: SolverService, tenant: str = ""):
         self.service = service
+        self.tenant = tenant
 
-    def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+    def encode(self, kind, scheduler, pods, timeout=None, deadline=None,
+               request_id=None, tenant=None, trace_carrier=None):
         from karpenter_tpu import tracing
 
-        return self.service.solve(
-            SolveRequest(
-                kind=kind,
-                scheduler=scheduler,
-                pods=list(pods),
-                timeout=timeout,
-                deadline=deadline,
-                # the caller's span context rides the request so the
-                # service-side queue/coalesce/solve spans join its trace
-                # even when another thread's batch leader executes them
-                trace_context=tracing.tracer().carrier(),
-            )
+        return SolveRequest(
+            kind=kind,
+            scheduler=scheduler,
+            pods=list(pods),
+            timeout=timeout,
+            deadline=deadline,
+            # the caller's span context rides the request so the
+            # service-side queue/coalesce/solve spans join its trace
+            # even when another thread's batch leader executes them
+            trace_context=(
+                trace_carrier
+                if trace_carrier is not None
+                else tracing.tracer().carrier()
+            ),
+            request_id=request_id or api.new_request_id(),
+            tenant=self.tenant if tenant is None else tenant,
         )
 
+    def solve_prepared(self, prepared):
+        return self.service.solve(prepared)
+
     def solve_many(self, kind, batch, timeout=None, deadline=None, group=None,
-                   nested=False):
+                   nested=False, request_ids=None, tenant=None):
         from karpenter_tpu import tracing
 
         carrier = tracing.tracer().carrier()
+        batch = list(batch)
+        ids = request_ids or [api.new_request_id() for _ in batch]
         entries = self.service.solve_many(
             [
                 SolveRequest(
@@ -144,8 +221,10 @@ class InProcessClient(SolverClient):
                     trace_context=carrier,
                     group=group,
                     group_nested=nested,
+                    request_id=rid,
+                    tenant=self.tenant if tenant is None else tenant,
                 )
-                for scheduler, pods in batch
+                for (scheduler, pods), rid in zip(batch, ids)
             ]
         )
         return [(e.result, e.error) for e in entries]
@@ -235,6 +314,7 @@ class SocketClient(SolverClient):
         backoff_base: float = 0.05,
         backoff_max: float = 1.0,
         sleep=None,
+        tenant: str = "",
     ):
         self.address = address
         self.connect_timeout = connect_timeout
@@ -245,6 +325,8 @@ class SocketClient(SolverClient):
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self.reconnects = 0  # cumulative, for stats/tests
+        self.tenant = tenant
+        self.replica = None  # last replica id seen in a reply
 
     def _connect(self) -> socket.socket:
         if self._sock is not None:
@@ -293,7 +375,11 @@ class SocketClient(SolverClient):
             f"solve rpc failed after {attempts} attempts: {last_err}"
         ) from last_err
 
-    def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+    def encode(self, kind, scheduler, pods, timeout=None, deadline=None,
+               request_id=None, tenant=None, trace_carrier=None):
+        """The host-side half of a solve: pack the solve state into the
+        wire frame. This is the pickle — the expensive part an admission
+        pipeline overlaps with the previous batch's device execution."""
         from karpenter_tpu import tracing
 
         with _engine_stripped(scheduler) as engine:
@@ -304,8 +390,7 @@ class SocketClient(SolverClient):
                     "catalog": list(engine.instance_types) if engine else None,
                 }
             )
-        tracer = tracing.tracer()
-        msg = {
+        return {
             "v": WIRE_VERSION,
             "op": "solve",
             "kind": kind,
@@ -318,28 +403,92 @@ class SocketClient(SolverClient):
             # trace context as plain carrier fields in the JSON control
             # plane: daemon-side spans join the caller's trace without
             # unpickling anything
-            "trace": tracer.carrier(),
+            "trace": (
+                trace_carrier
+                if trace_carrier is not None
+                else tracing.tracer().carrier()
+            ),
+            # the id rides the frame itself, so the _rpc replay path (and a
+            # pool client re-sending the frame to a sibling replica) repeats
+            # it verbatim — the daemon dedups on it
+            "request_id": request_id or api.new_request_id(),
+            "tenant": self.tenant if tenant is None else tenant,
             "payload": payload,
         }
-        with self._lock:
-            reply = self._rpc(msg)
-        # daemon-side spans for this trace ride home in the reply frame and
-        # re-export into the caller's exporters — /debug/traces shows one
-        # joined trace whichever side of the socket a span was born on
+
+    @staticmethod
+    def _error_from(err: dict) -> Exception:
+        """One reply-envelope error dict -> the typed exception the
+        in-process transport would have raised."""
+        cls = _ERROR_TYPES.get(err.get("type"))
+        if cls is not None:
+            return cls(err.get("message", ""))
+        return TransportError(
+            f"daemon error {err.get('type')}: {err.get('message')}"
+        )
+
+    def _check_reply(self, reply: dict) -> dict:
+        """Shared reply-envelope handling (both solve shapes): import the
+        daemon-side spans riding home in the frame (so /debug/traces shows
+        one joined trace whichever side of the socket a span was born on),
+        record the answering replica, and raise the typed envelope error
+        when the frame is a rejection."""
+        from karpenter_tpu import tracing
+
         if reply.get("spans"):
-            tracer.import_spans(reply["spans"])
+            tracing.tracer().import_spans(reply["spans"])
+        if reply.get("replica"):
+            self.replica = reply["replica"]
         if not reply.get("ok"):
-            err = reply.get("error", {})
-            cls = _ERROR_TYPES.get(err.get("type"))
-            if cls is not None:
-                raise cls(err.get("message", ""))
-            raise TransportError(
-                f"daemon error {err.get('type')}: {err.get('message')}"
-            )
-        return _unpack(reply["payload"])
+            raise self._error_from(reply.get("error", {}))
+        return reply
+
+    def _decode_reply(self, reply: dict):
+        return _unpack(self._check_reply(reply)["payload"])
+
+    def solve_prepared(self, prepared):
+        with self._lock:
+            reply = self._rpc(prepared)
+        return self._decode_reply(reply)
+
+    def solve_begin(self, prepared):
+        """The in-flight half of the admission pipeline: send the frame NOW
+        and return without waiting — the daemon starts executing in its own
+        process while the caller encodes the next batch — then collect the
+        reply with solve_finish(). The connection lock is held from begin
+        to finish (the pipeline owns the client for that window). A failed
+        send is deferred: solve_finish replays through the normal
+        reconnect-with-backoff path, dedup-safe under the frame's pinned
+        request id."""
+        self._lock.acquire()
+        handle = {"msg": prepared, "sent": False}
+        try:
+            sock = self._connect()
+            send_frame(sock, prepared)
+            handle["sent"] = True
+        except (OSError, TransportError):
+            self._drop()
+        return handle
+
+    def solve_finish(self, handle):
+        try:
+            reply = None
+            if handle["sent"]:
+                try:
+                    reply = recv_frame(self._sock)
+                except (OSError, TransportError):
+                    self._drop()
+            if reply is None:
+                # send failed, daemon closed, or reply lost mid-solve:
+                # replay the frame — same request id, so a daemon that
+                # already executed it answers from its dedup record
+                reply = self._rpc(handle["msg"])
+        finally:
+            self._lock.release()
+        return self._decode_reply(reply)
 
     def solve_many(self, kind, batch, timeout=None, deadline=None, group=None,
-                   nested=False):
+                   nested=False, request_ids=None, tenant=None):
         """Batched solves in ONE frame: the daemon admits the whole group
         before draining, so a frontier round coalesces into a single device
         batch on the far side of the socket exactly as it does in-process.
@@ -348,6 +497,7 @@ class SocketClient(SolverClient):
 
         if not batch:
             return []
+        batch = list(batch)
         payloads = []
         clock = batch[0][0].clock
         for scheduler, pods in batch:
@@ -375,39 +525,20 @@ class SocketClient(SolverClient):
             "group": group,
             "nested": bool(nested),
             "trace": tracer.carrier(),
+            "request_ids": request_ids
+            or [api.new_request_id() for _ in batch],
+            "tenant": self.tenant if tenant is None else tenant,
             "payloads": payloads,
         }
         with self._lock:
             reply = self._rpc(msg)
-        if reply.get("spans"):
-            tracer.import_spans(reply["spans"])
-        if not reply.get("ok"):
-            err = reply.get("error", {})
-            cls = _ERROR_TYPES.get(err.get("type"))
-            if cls is not None:
-                raise cls(err.get("message", ""))
-            raise TransportError(
-                f"daemon error {err.get('type')}: {err.get('message')}"
-            )
+        self._check_reply(reply)
         out = []
         for item in reply.get("results", []):
             if item.get("ok"):
                 out.append((_unpack(item["payload"]), None))
             else:
-                err = item.get("error", {})
-                cls = _ERROR_TYPES.get(err.get("type"))
-                if cls is not None:
-                    out.append((None, cls(err.get("message", ""))))
-                else:
-                    out.append(
-                        (
-                            None,
-                            TransportError(
-                                f"daemon error {err.get('type')}: "
-                                f"{err.get('message')}"
-                            ),
-                        )
-                    )
+                out.append((None, self._error_from(item.get("error", {}))))
         if len(out) != len(batch):
             raise TransportError(
                 f"solve_many reply carried {len(out)} results for "
@@ -432,6 +563,8 @@ class SocketClient(SolverClient):
             "address": self.address,
             "reconnects": self.reconnects,
         }
+        if self.replica is not None:
+            out["replica"] = self.replica
         with self._lock:
             try:
                 # single attempt: the debug path has a graceful fallback, and
@@ -442,6 +575,9 @@ class SocketClient(SolverClient):
                 out["error"] = str(e)
                 return out
         if reply and reply.get("ok"):
+            if reply.get("replica"):
+                self.replica = reply["replica"]
+                out["replica"] = reply["replica"]
             daemon_stats = dict(reply.get("stats", {}))
             daemon_stats.update(out)
             return daemon_stats
@@ -466,6 +602,7 @@ class SolverDaemon:
         service: SolverService,
         address: str = "127.0.0.1:0",
         engine_factory=None,
+        replica_id: str = "",
     ):
         self.service = service
         self.engine_factory = engine_factory or _default_engine_factory()
@@ -502,6 +639,10 @@ class SolverDaemon:
             self.address = f"{host}:{port}"
         else:
             self.address = str(self._path)
+        # the pool identity this daemon answers as: every reply carries it,
+        # so client-side failover spans and /debug/solverd name the replica
+        # that actually served each solve
+        self.replica_id = replica_id or self.address
 
     def start(self) -> "SolverDaemon":
         self._thread = threading.Thread(
@@ -546,6 +687,7 @@ class SolverDaemon:
                     # error-status daemon spans are exactly what a user
                     # debugging the failure drills into
                     self._attach_spans(reply, msg.get("trace"))
+                reply.setdefault("replica", self.replica_id)
                 try:
                     send_frame(conn, reply)
                 except OSError:
@@ -574,7 +716,9 @@ class SolverDaemon:
         self._attach_spans(reply, trace)
         return reply
 
-    def _decode_request(self, msg: dict, payload: str) -> SolveRequest:
+    def _decode_request(
+        self, msg: dict, payload: str, request_id: Optional[str] = None
+    ) -> SolveRequest:
         body = _unpack(payload)
         scheduler = body["scheduler"]
         catalog = body.get("catalog")
@@ -596,6 +740,12 @@ class SolverDaemon:
             trace_context=msg.get("trace"),
             group=msg.get("group"),
             group_nested=bool(msg.get("nested", False)),
+            request_id=(
+                request_id
+                if request_id is not None
+                else msg.get("request_id", "") or ""
+            ),
+            tenant=msg.get("tenant", "") or "",
         )
 
     def _process_many(self, msg: dict) -> dict:
@@ -606,9 +756,11 @@ class SolverDaemon:
         like an in-process one. Verdicts travel back per item — a failed
         probe reports its typed error without voiding its siblings."""
         trace = msg.get("trace")
+        payloads = msg.get("payloads", [])
+        ids = msg.get("request_ids") or [""] * len(payloads)
         requests = [
-            self._decode_request(msg, payload)
-            for payload in msg.get("payloads", [])
+            self._decode_request(msg, payload, request_id=rid)
+            for payload, rid in zip(payloads, ids)
         ]
         entries = self.service.solve_many(requests)
         results = []
@@ -622,6 +774,23 @@ class SolverDaemon:
         reply = {"ok": True, "results": results}
         self._attach_spans(reply, trace)
         return reply
+
+    def drain_and_stop(self, grace: float = 10.0, poll: float = 0.05) -> bool:
+        """Graceful SIGTERM exit: flip the service into draining mode (new
+        requests get a typed DrainingError reply — shed, never block; a
+        pool client fails over on it), let in-flight and already-admitted
+        batches finish, then tear the listener down. Returns True when the
+        service quiesced inside the grace window, False when the grace
+        expired and still-running work was abandoned to stop()."""
+        self.service.drain()
+        deadline = time.monotonic() + max(0.0, grace)
+        quiesced = self.service.quiesced()
+        while not quiesced and time.monotonic() < deadline:
+            time.sleep(poll)
+            quiesced = self.service.quiesced()
+        self.stop()
+        self.service.close()
+        return quiesced
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,0 +1,766 @@
+"""solverd fleet: pool-aware client failover, affinity routing, request-id
+dedup, tenant fairness, graceful drain, and the admission pipeline
+(ISSUE 10 acceptance criteria)."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.operator.harness import CircuitBreaker
+from karpenter_tpu.solverd import (
+    KIND_SOLVE,
+    AdmissionPipeline,
+    AdmissionQueue,
+    DrainingError,
+    FleetClient,
+    InProcessClient,
+    QueueFullError,
+    SocketClient,
+    SolveRequest,
+    SolverClient,
+    SolverDaemon,
+    SolverService,
+    TenantQuotaExceededError,
+    TransportError,
+    build_solver,
+    parse_tenant_weights,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+from test_solverd import build_scheduler, decisions
+
+
+class FakeReplica(SolverClient):
+    """A scriptable replica: answers (replica_id, request_id) or raises the
+    scripted error. Records every prepared request it saw."""
+
+    transport = "fake"
+
+    def __init__(self, rid, fail_with=None):
+        self.rid = rid
+        self.fail_with = fail_with
+        self.calls = []
+
+    def encode(self, kind, scheduler, pods, timeout=None, deadline=None,
+               request_id=None, tenant=None, trace_carrier=None):
+        from karpenter_tpu.solverd import new_request_id
+
+        return {
+            "kind": kind,
+            "scheduler": scheduler,
+            "request_id": request_id or new_request_id(),
+            "tenant": tenant,
+        }
+
+    def solve_prepared(self, prepared):
+        self.calls.append(prepared)
+        if self.fail_with is not None:
+            raise self.fail_with
+        return (self.rid, prepared["request_id"])
+
+    def solve_many(self, kind, batch, timeout=None, deadline=None, group=None,
+                   nested=False, request_ids=None, tenant=None):
+        self.calls.append({"group": group, "request_ids": request_ids})
+        if self.fail_with is not None:
+            raise self.fail_with
+        return [(self.rid, rid) for rid in request_ids]
+
+
+def fleet_of(n=2, clock=None, tenant="t", threshold=3, cooldown=5.0):
+    replicas = [FakeReplica(f"r{i}") for i in range(n)]
+    client = FleetClient(
+        [(r.rid, r) for r in replicas],
+        clock=clock or FakeClock(),
+        tenant=tenant,
+        breaker_threshold=threshold,
+        breaker_cooldown=cooldown,
+    )
+    return client, replicas
+
+
+class SchedStub:
+    engine = None
+    clock = FakeClock()
+
+
+class TestRouting:
+    def test_affinity_is_deterministic_and_sticky(self):
+        client, replicas = fleet_of(3)
+        first = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        for _ in range(5):
+            assert client.solve(KIND_SOLVE, SchedStub(), [])[0] == first
+
+    def test_tenants_spread_over_replicas(self):
+        # with enough tenants, rendezvous hashing must not collapse onto
+        # one replica
+        clock = FakeClock()
+        hit = set()
+        for i in range(16):
+            client, _ = fleet_of(4, clock=clock, tenant=f"tenant-{i}")
+            hit.add(client.solve(KIND_SOLVE, SchedStub(), [])[0])
+        assert len(hit) >= 2
+
+    def test_unhealthy_preferred_replica_skipped(self):
+        client, replicas = fleet_of(2)
+        preferred = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        handle = next(
+            r for r in client._replicas if r.replica_id == preferred
+        )
+        # force its breaker open
+        for _ in range(3):
+            handle.breaker.record_failure()
+        assert handle.breaker.state == CircuitBreaker.OPEN
+        other = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        assert other != preferred
+
+
+class TestFailover:
+    def test_transport_error_fails_over_and_opens_breaker(self):
+        client, replicas = fleet_of(2, threshold=2)
+        preferred = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        dead = next(r for r in replicas if r.rid == preferred)
+        dead.fail_with = TransportError("connection refused")
+        # each solve: dead replica fails -> survivor answers
+        for _ in range(2):
+            rid, _req = client.solve(KIND_SOLVE, SchedStub(), [])
+            assert rid != preferred
+        stats = client.stats()
+        assert stats["failovers"] == 2
+        assert stats["replays"] == 2
+        breakers = {r["id"]: r["breaker"] for r in stats["replicas"]}
+        assert breakers[preferred] == CircuitBreaker.OPEN
+        assert stats["healthy_replicas"] == 1
+        # breaker open: the dead replica is no longer attempted
+        calls_before = len(dead.calls)
+        client.solve(KIND_SOLVE, SchedStub(), [])
+        assert len(dead.calls) == calls_before
+
+    def test_request_id_pinned_across_failover(self):
+        client, replicas = fleet_of(2)
+        preferred = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        dead = next(r for r in replicas if r.rid == preferred)
+        survivor = next(r for r in replicas if r.rid != preferred)
+        dead.fail_with = TransportError("gone")
+        _rid, req_id = client.solve(KIND_SOLVE, SchedStub(), [])
+        # the dead replica SAW the request (same id) before the failover
+        assert dead.calls[-1]["request_id"] == req_id
+        assert survivor.calls[-1]["request_id"] == req_id
+
+    def test_rejections_do_not_fail_over(self):
+        client, replicas = fleet_of(2)
+        for r in replicas:
+            r.fail_with = QueueFullError("full")
+        with pytest.raises(QueueFullError):
+            client.solve(KIND_SOLVE, SchedStub(), [])
+        assert client.stats()["failovers"] == 0
+        # exactly one replica was asked: backpressure answers surface as-is
+        assert sum(len(r.calls) for r in replicas) == 1
+
+    def test_tenant_quota_does_not_fail_over(self):
+        client, replicas = fleet_of(2)
+        for r in replicas:
+            r.fail_with = TenantQuotaExceededError("quota")
+        with pytest.raises(TenantQuotaExceededError):
+            client.solve(KIND_SOLVE, SchedStub(), [])
+        assert sum(len(r.calls) for r in replicas) == 1
+
+    def test_draining_replica_fails_over_and_is_routed_around(self):
+        clock = FakeClock()
+        client, replicas = fleet_of(2, clock=clock, cooldown=5.0)
+        preferred = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        draining = next(r for r in replicas if r.rid == preferred)
+        draining.fail_with = DrainingError("draining")
+        rid, _ = client.solve(KIND_SOLVE, SchedStub(), [])
+        assert rid != preferred
+        stats = client.stats()
+        assert stats["draining_failovers"] == 1
+        assert stats["healthy_replicas"] == 1
+        # routed around without another attempt while the window holds
+        calls_before = len(draining.calls)
+        client.solve(KIND_SOLVE, SchedStub(), [])
+        assert len(draining.calls) == calls_before
+
+    def test_drained_replica_rejoins_after_cooldown_window(self):
+        """A drained replica must NOT be exiled forever: the draining
+        window expires like a breaker cooldown, the next solve probes it,
+        and a success restores it to rotation — the rolling-restart path
+        where every replica drains once."""
+        clock = FakeClock()
+        client, replicas = fleet_of(2, clock=clock, cooldown=5.0)
+        preferred = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        draining = next(r for r in replicas if r.rid == preferred)
+        draining.fail_with = DrainingError("draining")
+        client.solve(KIND_SOLVE, SchedStub(), [])
+        assert client.stats()["healthy_replicas"] == 1
+        # the restarted replica is back; the window expires; it is probed
+        # and rejoins with its affinity share
+        draining.fail_with = None
+        clock.step(6.0)
+        assert client.stats()["healthy_replicas"] == 2
+        assert client.solve(KIND_SOLVE, SchedStub(), [])[0] == preferred
+
+    def test_rolling_drain_of_every_replica_never_bricks_the_pool(self):
+        clock = FakeClock()
+        client, replicas = fleet_of(2, clock=clock, cooldown=5.0)
+        for victim in replicas:
+            victim.fail_with = DrainingError("rolling restart")
+            client.solve(KIND_SOLVE, SchedStub(), [])  # served by the other
+            victim.fail_with = None
+            clock.step(6.0)  # restart finishes inside the window
+        # both replicas drained once and both are back
+        assert client.stats()["healthy_replicas"] == 2
+        assert client.solve(KIND_SOLVE, SchedStub(), [])
+
+    def test_all_replicas_dead_raises_typed_retryable(self):
+        client, replicas = fleet_of(2)
+        for r in replicas:
+            r.fail_with = TransportError("refused")
+        with pytest.raises(TransportError) as exc:
+            client.solve(KIND_SOLVE, SchedStub(), [])
+        assert exc.value.retryable is True
+        assert "exhausted" in str(exc.value)
+
+    def test_all_breakers_open_fast_fails(self):
+        clock = FakeClock()
+        client, replicas = fleet_of(2, clock=clock, threshold=1)
+        for r in replicas:
+            r.fail_with = TransportError("refused")
+        with pytest.raises(TransportError):
+            client.solve(KIND_SOLVE, SchedStub(), [])
+        # both breakers open now: no replica is attempted at all
+        calls = sum(len(r.calls) for r in replicas)
+        with pytest.raises(TransportError) as exc:
+            client.solve(KIND_SOLVE, SchedStub(), [])
+        assert "no healthy replica" in str(exc.value)
+        assert sum(len(r.calls) for r in replicas) == calls
+        assert "error" in client.stats()
+        # cooldown elapses -> half-open probe flows again
+        clock.step(10.0)
+        for r in replicas:
+            r.fail_with = None
+        assert client.solve(KIND_SOLVE, SchedStub(), [])[0] in {"r0", "r1"}
+        assert client.stats()["healthy_replicas"] >= 1
+
+    def test_finish_failure_with_no_sibling_chains_the_real_error(self):
+        """In-flight finish fails and every sibling is inadmissible: the
+        raise must carry the actual transport failure, not a misleading
+        'no healthy replica' total-outage answer."""
+        client, replicas = fleet_of(2, threshold=1)
+        preferred = client.solve(KIND_SOLVE, SchedStub(), [])[0]
+        begun = next(r for r in replicas if r.rid == preferred)
+        sibling = next(r for r in client._replicas if r.replica_id != preferred)
+        for _ in range(2):
+            sibling.breaker.record_failure()  # sibling already open
+        begun.fail_with = TransportError("connection reset mid-reply")
+        token = client.solve_begin(
+            client.encode(KIND_SOLVE, SchedStub(), [])
+        )
+        with pytest.raises(TransportError) as exc:
+            client.solve_finish(token)
+        assert "connection reset mid-reply" in str(exc.value)
+
+    def test_solve_many_routes_whole_group_and_pins_ids(self):
+        client, replicas = fleet_of(2)
+        out = client.solve_many(KIND_SOLVE, [(SchedStub(), []), (SchedStub(), [])])
+        served = {rid for (rid, _), _err in zip(out, [None, None])}
+        assert len(served) == 1  # one replica served the whole group
+        # now kill the serving replica: the group replays as a unit with
+        # the same ids
+        serving = next(r for r in replicas if r.rid in served)
+        survivor = next(r for r in replicas if r.rid not in served)
+        serving.fail_with = TransportError("gone")
+        out2 = client.solve_many(
+            KIND_SOLVE, [(SchedStub(), []), (SchedStub(), [])]
+        )
+        assert all(rid == survivor.rid for (rid, _), _e in zip(out2, [0, 0]))
+        assert (
+            serving.calls[-1]["request_ids"]
+            == survivor.calls[-1]["request_ids"]
+        )
+
+
+class TestRequestIdDedup:
+    def test_service_executes_a_replayed_id_once(self):
+        svc = SolverService(clock=FakeClock())
+        scheduler, pods = build_scheduler(n_pods=2)
+        req = SolveRequest(
+            KIND_SOLVE, scheduler, pods, timeout=60.0, request_id="req-x"
+        )
+        first = svc.solve(req)
+        # the replay: same id, fresh request object (as a re-sent frame
+        # decodes into)
+        scheduler2, pods2 = build_scheduler(n_pods=2)
+        replay = SolveRequest(
+            KIND_SOLVE, scheduler2, pods2, timeout=60.0, request_id="req-x"
+        )
+        second = svc.solve(replay)
+        assert second is first  # answered from the dedup record
+        assert svc.executed == 1
+        assert svc.deduped == 1
+        assert svc.executed_ids == {"req-x": 1}
+
+    def test_replay_attaches_to_inflight_entry(self):
+        svc = SolverService(clock=FakeClock())
+        scheduler, pods = build_scheduler(n_pods=1)
+        entry = svc.submit(
+            SolveRequest(KIND_SOLVE, scheduler, pods, request_id="req-y")
+        )
+        again = svc.submit(
+            SolveRequest(KIND_SOLVE, scheduler, pods, request_id="req-y")
+        )
+        assert again is entry
+        assert svc.queue.depth() == 1  # never admitted twice
+        svc.run_pending()
+        assert svc.executed == 1
+
+    def test_socket_replayed_frame_executes_once(self):
+        svc = SolverService(clock=FakeClock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            scheduler, pods = build_scheduler(n_pods=2)
+            prepared = client.encode(KIND_SOLVE, scheduler, pods, 60.0)
+            r1 = client.solve_prepared(prepared)
+            # the _rpc replay path re-sends the SAME frame verbatim
+            r2 = client.solve_prepared(prepared)
+            assert decisions(r1) == decisions(r2)
+            assert svc.executed == 1
+            assert svc.deduped == 1
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+
+    def test_midgroup_shed_releases_cancelled_dedup_slots(self):
+        """A shed solve_many group un-admits its siblings AND releases
+        their dedup slots: a replay of the same ids (the lost-error-reply
+        path) must re-admit and execute fresh, never attach to cancelled
+        entries that no drain will ever finish."""
+        svc = SolverService(clock=FakeClock(), max_queue_depth=2)
+        reqs = []
+        for i in range(3):
+            s, p = build_scheduler(n_pods=1)
+            reqs.append(
+                SolveRequest(
+                    KIND_SOLVE, s, list(p), timeout=60.0,
+                    request_id=f"grp-{i}",
+                )
+            )
+        with pytest.raises(QueueFullError):
+            svc.solve_many(reqs)
+        assert svc._dedup == {}  # cancelled ids released
+        # the replayed group (same ids) admits and executes normally
+        replay = []
+        for i in range(2):
+            s, p = build_scheduler(n_pods=1)
+            replay.append(
+                SolveRequest(
+                    KIND_SOLVE, s, list(p), timeout=60.0,
+                    request_id=f"grp-{i}",
+                )
+            )
+        entries = svc.solve_many(replay)
+        assert all(e.error is None for e in entries)
+        assert svc.executed == 2
+        svc.close()
+
+    def test_midgroup_shed_keeps_other_callers_dedup_entries(self):
+        """A dedup hit hands solve_many ANOTHER caller's in-flight entry;
+        shedding the group must not un-admit it or release its slot — its
+        real owner is still waiting on it."""
+        svc = SolverService(clock=FakeClock(), max_queue_depth=2)
+        s0, p0 = build_scheduler(n_pods=1)
+        other = svc.submit(
+            SolveRequest(KIND_SOLVE, s0, list(p0), timeout=60.0,
+                         request_id="owned-elsewhere")
+        )
+        reqs = []
+        for i, rid in enumerate(["owned-elsewhere", "grp-a", "grp-b"]):
+            s, p = build_scheduler(n_pods=1)
+            reqs.append(
+                SolveRequest(KIND_SOLVE, s, list(p), timeout=60.0,
+                             request_id=rid)
+            )
+        with pytest.raises(QueueFullError):
+            svc.solve_many(reqs)  # grp-b tops the depth-2 queue
+        # the other caller's entry survived the group cancel
+        assert svc._dedup.get("owned-elsewhere") is other
+        assert svc.queue.depth() == 1
+        assert svc.run_pending() == 1
+        assert other.done and other.error is None
+        svc.close()
+
+    def test_dedup_record_does_not_pin_the_request(self):
+        svc = SolverService(clock=FakeClock())
+        scheduler, pods = build_scheduler(n_pods=1)
+        svc.solve(
+            SolveRequest(KIND_SOLVE, scheduler, pods, request_id="req-z")
+        )
+        from karpenter_tpu.solverd.service import _Completed
+
+        assert isinstance(svc._dedup["req-z"], _Completed)
+
+
+class TestTenantFairness:
+    def _entry(self, tenant, deadline=None):
+        class E:
+            def __init__(self):
+                self.request = SolveRequest(
+                    KIND_SOLVE, None, [], tenant=tenant, deadline=deadline
+                )
+                self.enqueued_at = 0.0
+
+        return E()
+
+    def test_quota_sheds_noisy_tenant_only(self):
+        q = AdmissionQueue(FakeClock(), max_depth=16, tenant_quota=3)
+        for _ in range(3):
+            q.offer(self._entry("noisy"))
+        with pytest.raises(TenantQuotaExceededError):
+            q.offer(self._entry("noisy"))
+        # the quiet tenant's headroom is untouched
+        q.offer(self._entry("quiet"))
+        assert q.tenant_depths() == {"noisy": 3, "quiet": 1}
+
+    def test_quota_zero_disables(self):
+        q = AdmissionQueue(FakeClock(), max_depth=8, tenant_quota=0)
+        for _ in range(8):
+            q.offer(self._entry("only"))
+        with pytest.raises(QueueFullError):
+            q.offer(self._entry("only"))
+
+    def test_weighted_fair_drain_interleaves(self):
+        q = AdmissionQueue(
+            FakeClock(), tenant_quota=0,
+            tenant_weights={"gold": 2.0, "free": 1.0},
+        )
+        entries = []
+        for _ in range(4):
+            entries.append(self._entry("free"))
+            q.offer(entries[-1])
+        for _ in range(4):
+            entries.append(self._entry("gold"))
+            q.offer(entries[-1])
+        ready, _ = q.drain()
+        order = [e.request.tenant for e in ready]
+        # gold (weight 2) lands 2 entries before free's first repeat wave;
+        # free is NOT pushed behind gold's whole burst either
+        assert order[0] == "gold"  # 1/2 < 1/1
+        assert "free" in order[:3]
+        assert order != ["free"] * 4 + ["gold"] * 4  # not FIFO
+        assert sorted(order) == ["free"] * 4 + ["gold"] * 4
+
+    def test_single_tenant_batch_keeps_fifo(self):
+        q = AdmissionQueue(FakeClock(), tenant_weights={"a": 2.0})
+        entries = [self._entry("a") for _ in range(4)]
+        for e in entries:
+            q.offer(e)
+        ready, _ = q.drain()
+        assert ready == entries
+
+    def test_remove_rebuilds_tenant_depths(self):
+        q = AdmissionQueue(FakeClock(), tenant_quota=2)
+        first = self._entry("t")
+        q.offer(first)
+        q.offer(self._entry("t"))
+        assert q.remove([first]) == [first]
+        # quota headroom returned by the un-admit
+        q.offer(self._entry("t"))
+        assert q.tenant_depths() == {"t": 2}
+
+    def test_parse_tenant_weights(self):
+        assert parse_tenant_weights("gold=4, free=1") == {
+            "gold": 4.0, "free": 1.0,
+        }
+        assert parse_tenant_weights("") == {}
+        assert parse_tenant_weights("bad, x=0, y=-1, z=nan2") == {}
+
+    def test_tenant_rides_the_wire(self):
+        svc = SolverService(clock=FakeClock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address, tenant="cluster-a")
+        seen = []
+        orig = svc.submit
+
+        def spy(request):
+            seen.append((request.tenant, bool(request.request_id)))
+            return orig(request)
+
+        svc.submit = spy
+        try:
+            scheduler, pods = build_scheduler(n_pods=1)
+            client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            assert seen == [("cluster-a", True)]
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+
+
+class TestGracefulDrain:
+    def test_drain_rejects_new_work_typed(self):
+        svc = SolverService(clock=FakeClock())
+        svc.drain()
+        scheduler, pods = build_scheduler(n_pods=1)
+        with pytest.raises(DrainingError) as exc:
+            svc.submit(SolveRequest(KIND_SOLVE, scheduler, pods))
+        assert exc.value.retryable is True
+        assert exc.value.failover is True
+        assert svc.quiesced()
+
+    def test_inflight_finishes_while_draining(self):
+        svc = SolverService(clock=FakeClock())
+        started, release = threading.Event(), threading.Event()
+        orig = svc.coalescer.execute
+
+        def gated(entries):
+            started.set()
+            assert release.wait(timeout=5)
+            return orig(entries)
+
+        svc.coalescer.execute = gated
+        scheduler, pods = build_scheduler(n_pods=1)
+        result_box = []
+        worker = threading.Thread(
+            target=lambda: result_box.append(
+                svc.solve(SolveRequest(KIND_SOLVE, scheduler, pods, timeout=60.0))
+            )
+        )
+        worker.start()
+        assert started.wait(timeout=5)
+        svc.drain()
+        assert not svc.quiesced()  # batch still executing
+        release.set()
+        worker.join(timeout=10)
+        assert result_box and result_box[0].new_node_claims is not None
+        assert svc.quiesced()
+
+    def test_daemon_drain_and_stop_quiesces(self):
+        svc = SolverService(clock=FakeClock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            scheduler, pods = build_scheduler(n_pods=1)
+            client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            assert daemon.drain_and_stop(grace=5.0) is True
+            # the listener is gone: a fresh solve fails typed + retryable
+            with pytest.raises(TransportError):
+                client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+
+    def test_draining_rejection_crosses_the_wire_typed(self):
+        svc = SolverService(clock=FakeClock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            svc.drain()
+            scheduler, pods = build_scheduler(n_pods=1)
+            with pytest.raises(DrainingError):
+                client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+
+    def test_mid_drain_client_fails_over_to_healthy_replica(self):
+        """ISSUE 10 satellite 1: a client caught mid-drain re-routes the
+        request to a replica that is not exiting — over real sockets."""
+        clock = FakeClock()
+        services = [SolverService(clock=clock) for _ in range(2)]
+        daemons = [
+            SolverDaemon(s, address="127.0.0.1:0", replica_id=f"r{i}").start()
+            for i, s in enumerate(services)
+        ]
+        clients = [
+            (d.replica_id, SocketClient(d.address)) for d in daemons
+        ]
+        fleet = FleetClient(clients, clock=clock, tenant="drain-test")
+        try:
+            scheduler, pods = build_scheduler(n_pods=1)
+            first = fleet.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            served = next(
+                r.replica_id for r in fleet._replicas if r.solves == 1
+            )
+            idx = int(served[1:])
+            services[idx].drain()  # SIGTERM landed on the serving replica
+            scheduler2, pods2 = build_scheduler(n_pods=1)
+            second = fleet.solve(KIND_SOLVE, scheduler2, pods2, timeout=60.0)
+            assert decisions(first) == decisions(second)
+            stats = fleet.stats()
+            assert stats["draining_failovers"] == 1
+            other = f"r{1 - idx}"
+            assert {
+                r["id"]: r["solves"] for r in stats["replicas"]
+            }[other] == 1
+        finally:
+            fleet.close()
+            for d in daemons:
+                d.stop()
+            for s in services:
+                s.close()
+
+
+class PipeStub(SolverClient):
+    """Synthetic begin/finish transport with real wall costs: encode burns
+    `encode_s` on the caller's thread; begin starts a timer thread standing
+    in for the daemon's device execution; finish joins it."""
+
+    transport = "stub"
+
+    def __init__(self, encode_s=0.01, execute_s=0.02, fail_index=None):
+        self.encode_s = encode_s
+        self.execute_s = execute_s
+        self.fail_index = fail_index
+        self.encoded = 0
+
+    def encode(self, kind, scheduler, pods, timeout=None, deadline=None,
+               request_id=None, tenant=None, trace_carrier=None):
+        index = self.encoded
+        self.encoded += 1
+        time.sleep(self.encode_s)
+        if self.fail_index == ("encode", index):
+            raise ValueError(f"encode {index} failed")
+        return index
+
+    def solve_begin(self, prepared):
+        done = threading.Event()
+        timer = threading.Timer(self.execute_s, done.set)
+        timer.start()
+        return (prepared, done)
+
+    def solve_finish(self, handle):
+        index, done = handle
+        done.wait()
+        if self.fail_index == ("solve", index):
+            raise QueueFullError(f"solve {index} shed")
+        return f"ok-{index}"
+
+    def solve_prepared(self, prepared):
+        return self.solve_finish(self.solve_begin(prepared))
+
+
+class TestAdmissionPipeline:
+    def test_results_in_order_with_per_item_errors(self):
+        stub = PipeStub(encode_s=0.0, execute_s=0.0, fail_index=("solve", 1))
+        pipeline = AdmissionPipeline(stub)
+        out = pipeline.run(KIND_SOLVE, [(None, [])] * 3)
+        assert out[0] == ("ok-0", None)
+        assert out[1][0] is None and isinstance(out[1][1], QueueFullError)
+        assert out[2] == ("ok-2", None)
+        assert pipeline.stats()["batches"] == 3
+
+    def test_encode_error_is_per_item(self):
+        stub = PipeStub(encode_s=0.0, execute_s=0.0, fail_index=("encode", 1))
+        out = AdmissionPipeline(stub).run(KIND_SOLVE, [(None, [])] * 3)
+        assert out[0] == ("ok-0", None)
+        assert isinstance(out[1][1], ValueError)
+        assert out[2] == ("ok-2", None)
+
+    def test_pipelined_hides_encode_behind_execution(self):
+        stub = PipeStub(encode_s=0.01, execute_s=0.03)
+        pipeline = AdmissionPipeline(stub)
+        out = pipeline.run(KIND_SOLVE, [(None, [])] * 6)
+        assert all(err is None for _r, err in out)
+        stats = pipeline.stats()
+        # 5 of 6 encodes ran while the previous batch executed
+        assert stats["encode_overlap_fraction"] >= 0.5, stats
+        assert stats["hidden_encode_s"] > 0
+
+    def test_unpipelined_hides_nothing(self):
+        stub = PipeStub(encode_s=0.005, execute_s=0.01)
+        pipeline = AdmissionPipeline(stub)
+        pipeline.run(KIND_SOLVE, [(None, [])] * 4, pipelined=False)
+        assert pipeline.stats()["hidden_encode_s"] == 0.0
+        assert pipeline.stats()["encode_overlap_fraction"] == 0.0
+
+    def test_socket_inflight_begin_finish_roundtrip(self):
+        svc = SolverService(clock=FakeClock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        client = SocketClient(daemon.address)
+        try:
+            scheduler, pods = build_scheduler(n_pods=2)
+            direct = client.solve(KIND_SOLVE, scheduler, pods, timeout=60.0)
+            scheduler2, pods2 = build_scheduler(n_pods=2)
+            handle = client.solve_begin(
+                client.encode(KIND_SOLVE, scheduler2, pods2, 60.0)
+            )
+            via_pipeline = client.solve_finish(handle)
+            assert decisions(direct) == decisions(via_pipeline)
+        finally:
+            client.close()
+            daemon.stop()
+            svc.close()
+
+    def test_socket_finish_replays_after_daemon_restart(self, tmp_path):
+        """Reply lost mid-flight: the daemon restarts between begin and
+        finish; finish replays the frame through the backoff path and the
+        solve still answers (fresh daemon: executes once there)."""
+        # unix socket: restart-on-same-address without TCP TIME_WAIT games
+        address = str(tmp_path / "solverd.sock")
+        svc = SolverService(clock=FakeClock())
+        daemon = SolverDaemon(svc, address=address).start()
+        client = SocketClient(address)
+        scheduler, pods = build_scheduler(n_pods=1)
+        handle = client.solve_begin(
+            client.encode(KIND_SOLVE, scheduler, pods, 60.0)
+        )
+        daemon.stop()  # the reply will never come
+        svc2 = SolverService(clock=FakeClock())
+        daemon2 = SolverDaemon(svc2, address=address).start()
+        try:
+            result = client.solve_finish(handle)
+            assert result.new_node_claims is not None
+            assert svc2.executed == 1
+        finally:
+            client.close()
+            daemon2.stop()
+            svc2.close()
+            svc.close()
+
+
+class TestBuildSolver:
+    def _opts(self, **kw):
+        from karpenter_tpu.operator.options import Options
+
+        return Options(**kw)
+
+    def test_comma_list_builds_fleet(self):
+        opts = self._opts(
+            solver_transport="socket",
+            solver_daemon_address="127.0.0.1:9901,127.0.0.1:9902",
+            cluster_name="prod-a",
+        )
+        client = build_solver(opts, FakeClock())
+        assert isinstance(client, FleetClient)
+        assert client.tenant == "prod-a"
+        assert [r.replica_id for r in client._replicas] == [
+            "127.0.0.1:9901", "127.0.0.1:9902",
+        ]
+
+    def test_single_address_stays_plain_socket(self):
+        opts = self._opts(
+            solver_transport="socket",
+            solver_daemon_address="127.0.0.1:9901",
+            cluster_name="prod-a",
+        )
+        client = build_solver(opts, FakeClock())
+        assert isinstance(client, SocketClient)
+        assert client.tenant == "prod-a"
+
+    def test_inprocess_gets_tenant_policy(self):
+        opts = self._opts(
+            solverd_tenant_quota=4,
+            solverd_tenant_weights="gold=2,free=1",
+            cluster_name="solo",
+        )
+        client = build_solver(opts, FakeClock())
+        assert isinstance(client, InProcessClient)
+        assert client.tenant == "solo"
+        assert client.service.queue.tenant_quota == 4
+        assert client.service.queue.tenant_weights == {
+            "gold": 2.0, "free": 1.0,
+        }

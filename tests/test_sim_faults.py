@@ -171,7 +171,8 @@ class TestFlakySolverClient:
         class Inner:
             transport = "inprocess"
 
-            def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+            def solve(self, kind, scheduler, pods, timeout=None, deadline=None,
+                      request_id=None, tenant=None):
                 return "solved"
 
             def stats(self):

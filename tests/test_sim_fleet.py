@@ -1,0 +1,112 @@
+"""Fleet replica-kill simulation: 3 tenant clusters on a 2-replica solverd
+pool, one replica SIGKILLed mid-run — deterministic recovery, zero
+double-executed solves, no SLO breach (ISSUE 10 acceptance criteria)."""
+
+import pytest
+
+from karpenter_tpu.sim import scenarios
+from karpenter_tpu.sim import trace as tracemod
+from karpenter_tpu.sim.fleet import FleetSimulation, run_fleet_scenario
+
+SEED = 11
+
+
+@pytest.fixture(scope="module")
+def result():
+    return run_fleet_scenario(scenarios.resolve("fleet-replica-kill", SEED), SEED)
+
+
+class TestTraceSchema:
+    def test_generator_is_seed_deterministic(self):
+        a = scenarios.resolve("fleet-replica-kill", 3)
+        b = scenarios.resolve("fleet-replica-kill", 3)
+        assert a == b
+        assert a["fleet"]["replicas"] == 2
+        assert len(a["tenants"]) == 3
+
+    def test_validate_rejects_bad_fleet_traces(self):
+        base = scenarios.resolve("fleet-replica-kill", 1)
+        bad = dict(base, fleet=dict(base["fleet"], replicas=0))
+        with pytest.raises(ValueError, match="replicas"):
+            tracemod.validate(bad)
+        bad = dict(base, tenants=[])
+        with pytest.raises(ValueError, match="tenants"):
+            tracemod.validate(bad)
+        bad = dict(
+            base,
+            fleet=dict(base["fleet"], kills=[{"at": 1.0, "replica": 7}]),
+        )
+        with pytest.raises(ValueError, match="unknown replica"):
+            tracemod.validate(bad)
+        dupe = dict(base, tenants=[base["tenants"][0], base["tenants"][0]])
+        with pytest.raises(ValueError, match="duplicate"):
+            tracemod.validate(dupe)
+
+    def test_fleet_simulation_requires_fleet_section(self):
+        plain = scenarios.resolve("steady-state", 1)
+        with pytest.raises(ValueError, match="fleet"):
+            FleetSimulation(plain, 1)
+
+
+class TestReplicaKillScenario:
+    def test_replica_killed_and_recovered(self, result):
+        fleet = result.report["fleet"]
+        assert fleet["replica_kills"] == ["replica-0"]
+        replicas = {r["id"]: r for r in fleet["replicas"]}
+        assert replicas["replica-0"]["killed"] is True
+        assert replicas["replica-1"]["killed"] is False
+        # the survivor served real post-kill traffic
+        assert replicas["replica-1"]["executed"] > 0
+        # at least one tenant actually rode the failover path
+        assert sum(c["failovers"] for c in fleet["clients"].values()) > 0
+        assert sum(c["replays"] for c in fleet["clients"].values()) > 0
+        # ... and its client-side breaker took the dead replica out
+        assert any(
+            c["breakers"]["replica-0"] == "open"
+            for c in fleet["clients"].values()
+        )
+
+    def test_zero_double_executed_solves(self, result):
+        audit = result.report["fleet"]["double_executed"]
+        assert audit == {
+            "same_replica": 0,
+            "cross_replica": 0,
+            "total": 0,
+            "audit_overflow": False,
+        }
+
+    def test_no_slo_breach_for_any_tenant(self, result):
+        for name, report in result.report["tenants"].items():
+            slo = report["slo"]
+            assert slo["pods_submitted"] > 0, name
+            assert slo["pods_never_bound"] == 0, (
+                f"tenant {name} stranded {slo['pods_never_bound']} pods "
+                f"after the replica kill"
+            )
+
+    def test_surviving_replica_zero_steady_recompiles(self, result):
+        assert result.report["kernels"]["steady_recompiles"] == 0
+
+    def test_kill_event_in_merged_log(self, result):
+        kills = result.log.entries("replica-kill")
+        assert len(kills) == 1
+        assert kills[0]["replica"] == "replica-0"
+        # tenant streams are tagged in the merged log
+        tenants = {
+            e.get("tenant")
+            for e in result.log.entries("pod-submitted")
+        }
+        assert tenants == {"tenant-web", "tenant-batch", "tenant-ml"}
+
+    def test_deterministic_report_and_digest(self, result):
+        again = run_fleet_scenario(
+            scenarios.resolve("fleet-replica-kill", SEED), SEED
+        )
+        assert again.digest == result.digest
+        assert again.report == result.report
+
+    def test_different_seed_different_digest(self, result):
+        other = run_fleet_scenario(
+            scenarios.resolve("fleet-replica-kill", SEED + 1), SEED + 1
+        )
+        assert other.digest != result.digest

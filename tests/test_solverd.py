@@ -556,6 +556,109 @@ class TestSolveMany:
         assert svc.stats()["cancelled"] == 2
         svc.close()
 
+    def test_remove_after_concurrent_drain_is_a_noop(self):
+        """AdmissionQueue.remove vs a concurrent leader's drain: entries
+        the leader already took are simply not found — remove() must not
+        resurrect, double-complete, or corrupt the queue."""
+        from karpenter_tpu.solverd import AdmissionQueue
+
+        q = AdmissionQueue(FakeClock())
+        entries = []
+        for _ in range(3):
+            s, p = build_scheduler(n_pods=1)
+
+            class E:
+                pass
+
+            e = E()
+            e.request = SolveRequest(KIND_SIMULATE, s, list(p))
+            e.enqueued_at = 0.0
+            entries.append(e)
+            q.offer(e)
+        ready, _ = q.drain()  # the concurrent leader won the race
+        assert len(ready) == 3
+        assert q.remove(entries) == []
+        assert q.depth() == 0
+        # partial race: one entry still queued, two already drained
+        q.offer(entries[0])
+        assert q.remove(entries) == [entries[0]]
+        assert q.depth() == 0
+
+    def test_midgroup_shed_unadmits_while_leader_executes(self):
+        """A solve_many group shed mid-admission while a concurrent leader
+        is EXECUTING an earlier batch: the group's admitted prefix must be
+        un-admitted (the later drain runs none of it) and the in-flight
+        batch must be untouched."""
+        svc = SolverService(clock=FakeClock(), max_queue_depth=2)
+        started, release = threading.Event(), threading.Event()
+        orig = svc.coalescer.execute
+
+        def gated(entries):
+            started.set()
+            assert release.wait(timeout=10)
+            return orig(entries)
+
+        svc.coalescer.execute = gated
+        s0, p0 = build_scheduler(n_pods=1)
+        leader_box = []
+        leader = threading.Thread(
+            target=lambda: leader_box.append(
+                svc.solve(SolveRequest(KIND_SOLVE, s0, list(p0), timeout=60.0))
+            )
+        )
+        leader.start()
+        assert started.wait(timeout=10)  # leader drained its batch, executing
+        batch = []
+        for _ in range(3):
+            s, p = build_scheduler(n_pods=1)
+            batch.append(SolveRequest(KIND_SIMULATE, s, list(p), timeout=60.0))
+        with pytest.raises(QueueFullError):
+            svc.solve_many(batch)  # third offer tops the depth-2 queue
+        assert svc.queue.depth() == 0  # admitted prefix un-admitted
+        assert svc.stats()["cancelled"] == 2
+        release.set()
+        leader.join(timeout=10)
+        assert leader_box and leader_box[0].new_node_claims is not None
+        assert svc.run_pending() == 0  # nothing abandoned left to execute
+        assert svc.stats()["executed"] == 1
+        svc.close()
+
+    def test_leader_loss_mid_round_fails_followers_not_hangs(self):
+        """The batch leader dying mid-frontier-round (its coalescer pass
+        raising) must complete every drained entry with a terminal error —
+        followers waiting on the group observe failure, never a hang —
+        and the service must stay serviceable afterwards."""
+        svc = SolverService(clock=FakeClock())
+        s1, p1 = build_scheduler(n_pods=1)
+        s2, p2 = build_scheduler(n_pods=1)
+        follower_entries = [
+            svc.submit(SolveRequest(KIND_SIMULATE, s1, list(p1), timeout=60.0)),
+            svc.submit(SolveRequest(KIND_SIMULATE, s2, list(p2), timeout=60.0)),
+        ]
+
+        def dying(entries):
+            raise RuntimeError("leader lost mid-round")
+
+        orig = svc.coalescer.execute
+        svc.coalescer.execute = dying
+        s0, p0 = build_scheduler(n_pods=1)
+        with pytest.raises(RuntimeError, match="leader lost"):
+            # this caller becomes the leader and drains ALL three entries
+            svc.solve(SolveRequest(KIND_SOLVE, s0, list(p0), timeout=60.0))
+        for entry in follower_entries:
+            assert entry.done, "follower stranded by the dead leader"
+            assert isinstance(entry.error, RuntimeError)
+            assert "aborted" in str(entry.error)
+        # the service recovered: the next group runs normally
+        svc.coalescer.execute = orig
+        s3, p3 = build_scheduler(n_pods=1)
+        entries = svc.solve_many(
+            [SolveRequest(KIND_SIMULATE, s3, list(p3), timeout=60.0)]
+        )
+        assert entries[0].error is None
+        assert entries[0].result.new_node_claims is not None
+        svc.close()
+
     def test_socket_solve_many_matches_inprocess(self):
         batch_sizes = (2, 3)
         inproc_svc = SolverService(clock=FakeClock())
@@ -617,7 +720,8 @@ class TestSolveMany:
         calls = []
 
         class Seq(SolverClient):
-            def solve(self, kind, scheduler, pods, timeout=None, deadline=None):
+            def solve(self, kind, scheduler, pods, timeout=None, deadline=None,
+                      request_id=None, tenant=None):
                 calls.append(scheduler)
                 if scheduler == "bad":
                     raise RuntimeError("nope")
