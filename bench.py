@@ -40,6 +40,21 @@ TOPO_TARGET_MS = 250.0
 RESPECT_TARGET_MS = 300.0
 
 
+# Mesh hyperscale leg (ROADMAP item 1): the feasibility x packing sweep —
+# the device portion of a serving solve — at 1M pending pods, sharded over
+# an 8-device mesh. Runs in a SUBPROCESS because the virtual device count
+# (XLA_FLAGS=--xla_force_host_platform_device_count) must be set before jax
+# initializes. Near-linear solves/sec scaling vs device count is asserted
+# only when the host actually has the parallelism to show it (cpu_count >=
+# devices, or a real multi-chip backend): on a 1-core container all 8
+# virtual devices share one core and wall-clock scaling is physically
+# impossible — the leg still runs, proves decision identity at every mesh
+# size and 0 steady recompiles, and reports the measured (gated) ratio.
+MESH_LEG_DEVICES = 8
+MESH_HYPERSCALE_PODS = 1_000_000
+MESH_SCALING_FLOOR = 3.0
+
+
 def build_catalog():
     from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
     from karpenter_tpu.cloudprovider.types import InstanceType
@@ -833,6 +848,336 @@ def topology_bench(engine, n: int = 20000, runs: int = 7) -> tuple[float, float]
     return float(np.percentile(times, 50)), cold_ms
 
 
+def mesh_hyperscale_leg(
+    n_pods: int = MESH_HYPERSCALE_PODS, mesh_sizes=(1, 8), reps: int = 5
+) -> dict:
+    """1M pending pods through the feasibility x packing sweep at every
+    mesh size (runs inside the 8-device subprocess, see run_mesh_leg).
+
+    The pod population draws from 64 requirement shapes x 256 request
+    ladders, so the batch collapses to ~16k distinct groups — a pod axis
+    wide enough that sharding it is real work, not padding. Decisions
+    (choice / feasible / nodes / unschedulable per group) must be
+    bit-identical across every mesh size AND the unsharded baseline, and
+    the steady timing loop runs under the observatory seal (0 recompiles).
+    Reports pods/sec per leg and the mesh-8-over-mesh-1 ratio."""
+    import os
+
+    import jax
+    from jax.sharding import Mesh
+
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.cloudprovider.kwok.instance_types import (
+        construct_instance_types,
+    )
+    from karpenter_tpu.observability import kernels as kobs
+    from karpenter_tpu.ops.catalog import CatalogEngine
+    from karpenter_tpu.ops.packer import GroupSolver, encode_pods_for_packer
+    from karpenter_tpu.scheduling.requirements import (
+        Operator,
+        Requirement,
+        Requirements,
+    )
+
+    catalog = construct_instance_types()
+    probe = CatalogEngine(catalog)
+    rng = np.random.RandomState(17)
+    zones = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+
+    shapes = []
+    for i in range(64):
+        reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        if i % 2:
+            reqs.add(
+                Requirement(
+                    wk.LABEL_ARCH, Operator.IN, [["amd64", "arm64"][i % 4 // 2]]
+                )
+            )
+        if i % 3 == 0:
+            reqs.add(
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [zones[i % 4]])
+            )
+        if i % 5 == 0:
+            reqs.add(
+                Requirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY,
+                    Operator.IN,
+                    [wk.CAPACITY_TYPE_SPOT],
+                )
+            )
+        shapes.append(reqs)
+
+    # 1M pods as (shape ref, request row): shapes repeat by identity so the
+    # encode collapses them without building a million Pod objects
+    picks = rng.randint(len(shapes), size=n_pods)
+    pods_requirements = [shapes[i] for i in picks]
+    D = len(probe.resource_dims)
+    requests = np.zeros((n_pods, D))
+    cpu_ladder = np.linspace(0.1, 3.2, 16)
+    mem_ladder = np.linspace(128, 4096, 16) * 2**20
+    requests[:, probe.resource_dims[wk.RESOURCE_CPU]] = cpu_ladder[
+        rng.randint(16, size=n_pods)
+    ]
+    requests[:, probe.resource_dims[wk.RESOURCE_MEMORY]] = mem_ladder[
+        rng.randint(16, size=n_pods)
+    ]
+    requests[:, probe.resource_dims[wk.RESOURCE_PODS]] = 1.0
+
+    devices = jax.devices()
+    registry = kobs.registry()
+    legs: dict[str, dict] = {}
+    baseline = None
+    t0 = time.perf_counter()
+    grouped0 = encode_pods_for_packer(probe, pods_requirements, requests)
+    encode_ms = (time.perf_counter() - t0) * 1000.0
+    groups = int(grouped0.membership.shape[0])
+
+    def run_leg(name: str, mesh, engine=None, grouped=None) -> tuple:
+        nonlocal baseline
+        if engine is None:
+            engine = CatalogEngine(catalog, mesh=mesh)
+        if grouped is None:
+            grouped = encode_pods_for_packer(engine, pods_requirements, requests)
+        solver = GroupSolver(engine)
+        out = solver.solve(grouped)  # warm: encode upload + compile
+        if baseline is None:
+            baseline = out
+        else:
+            for a, b in zip(baseline, out):
+                np.testing.assert_array_equal(a, b)
+        registry.seal()
+        rc0 = registry.steady_recompiles()
+        import gc
+
+        gc.collect()
+        gc.disable()  # gc pauses are ~10% of a solve at this scale
+        times = []
+        try:
+            for _ in range(reps):
+                start = time.perf_counter()
+                out = solver.solve(grouped)
+                times.append((time.perf_counter() - start) * 1000.0)
+        finally:
+            gc.enable()
+        steady_rc = registry.steady_recompiles() - rc0
+        registry.unseal()
+        assert steady_rc == 0, (
+            f"mesh leg {name} recompiled {steady_rc} time(s) under seal"
+        )
+        best = float(min(times))
+        legs[name] = {
+            "best_ms": round(best, 2),
+            "p50_ms": round(float(np.percentile(times, 50)), 2),
+            "pods_per_sec": round(n_pods / (best / 1000.0)),
+        }
+        return out
+
+    # the probe engine IS the unsharded leg: its encode (grouped0) is
+    # reused instead of paying a second million-pod host encode
+    run_leg("unsharded", None, engine=probe, grouped=grouped0)
+    for n in mesh_sizes:
+        if len(devices) < n:
+            continue
+        run_leg(f"mesh{n}", Mesh(np.array(devices[:n]), ("pods",)))
+
+    lo, hi = f"mesh{min(mesh_sizes)}", f"mesh{max(mesh_sizes)}"
+    speedup = (
+        legs[hi]["pods_per_sec"] / legs[lo]["pods_per_sec"]
+        if lo in legs and hi in legs
+        else None
+    )
+    # wall-clock scaling needs real parallel hardware under the mesh: on a
+    # host with fewer cores than devices every shard shares one core and
+    # the ratio is ~1 by construction, so the floor is asserted only where
+    # the measurement can be meaningful
+    cpu_count = os.cpu_count() or 1
+    scaling_assertable = (
+        speedup is not None
+        and (jax.default_backend() != "cpu" or cpu_count >= max(mesh_sizes))
+    )
+    if scaling_assertable:
+        assert speedup >= MESH_SCALING_FLOOR, (
+            f"mesh scaling {speedup:.2f}x below the "
+            f"{MESH_SCALING_FLOOR:.0f}x floor at {max(mesh_sizes)} devices"
+        )
+    return {
+        "pods": n_pods,
+        "groups": groups,
+        "instance_types": probe.num_instances,
+        "encode_ms": round(encode_ms, 2),
+        "devices_available": len(devices),
+        "cpu_count": cpu_count,
+        "backend": jax.default_backend(),
+        "legs": legs,
+        "speedup_mesh8_over_mesh1": (
+            round(speedup, 3) if speedup is not None else None
+        ),
+        "scaling_floor": MESH_SCALING_FLOOR,
+        "scaling_asserted": bool(scaling_assertable),
+        "decisions": "bit-identical across unsharded and every mesh size",
+        "steady_recompiles": 0,  # asserted per leg above
+    }
+
+
+def serving_mesh_leg(n_pods: int = 20_000) -> dict:
+    """The REAL serving path (Topology + Scheduler.solve, device fast path
+    forced) with the engine mesh-sharded over all 8 devices vs unsharded:
+    decisions must be identical, and the sharded cube kernel must actually
+    serve the sweep. This is the MULTICHIP measurement taken from the
+    production solve instead of the dryrun harness."""
+    import itertools
+
+    import jax
+    from jax.sharding import Mesh
+
+    from karpenter_tpu.apis.core import ObjectMeta
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.cloudprovider.kwok.instance_types import (
+        construct_instance_types,
+    )
+    from karpenter_tpu.events.recorder import Recorder
+    from karpenter_tpu.observability import kernels as kobs
+    from karpenter_tpu.ops import catalog as cat
+    from karpenter_tpu.ops import ffd
+    from karpenter_tpu.ops.catalog import CatalogEngine
+    from karpenter_tpu.runtime.store import Store
+    from karpenter_tpu.scheduler import nodeclaim as ncmod
+    from karpenter_tpu.scheduler.scheduler import Scheduler
+    from karpenter_tpu.scheduler.topology import Topology
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.state.informer import StateInformer
+    from karpenter_tpu.utils.clock import FakeClock
+
+    catalog = construct_instance_types()
+    pods = build_pods()[:n_pods]
+    mesh = Mesh(np.array(jax.devices()[:MESH_LEG_DEVICES]), ("pods",))
+
+    def decisions(results):
+        return sorted(
+            (
+                tuple(sorted(p.metadata.name for p in nc.pods)),
+                tuple(sorted(it.name for it in nc.instance_type_options)),
+                tuple(
+                    sorted(
+                        (r.key, tuple(sorted(r.values)), r.complement)
+                        for r in nc.requirements
+                    )
+                ),
+            )
+            for nc in results.new_node_claims
+        )
+
+    def one_solve(engine):
+        import copy
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        cluster = Cluster(clock, store, cloud_provider=None)
+        StateInformer(store, cluster).flush()
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.set_condition("Ready", "True")
+        store.create(pool)
+        solve_pods = copy.deepcopy(pods)
+        topology = Topology(
+            store, cluster, [], [pool], {"default": catalog}, solve_pods
+        )
+        scheduler = Scheduler(
+            store, [pool], cluster, [], topology, {"default": catalog},
+            [], Recorder(clock=clock), clock, engine=engine,
+        )
+        t0 = time.perf_counter()
+        results = scheduler.solve(solve_pods)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        assert not results.pod_errors
+        return results, wall_ms
+
+    old_force = cat.FORCE_BACKEND
+    old_counter = ncmod._hostname_counter
+    cat.FORCE_BACKEND = "device"
+    solves0 = ffd.DEVICE_SOLVES
+    sharded_disp0 = (
+        kobs.registry().debug_snapshot("feasibility.cube_sharded") or {}
+    ).get("dispatches", 0)
+    try:
+        ncmod._hostname_counter = itertools.count(1)
+        sharded, sharded_ms = one_solve(CatalogEngine(catalog, mesh=mesh))
+        ncmod._hostname_counter = itertools.count(1)
+        plain, plain_ms = one_solve(CatalogEngine(catalog))
+    finally:
+        cat.FORCE_BACKEND = old_force
+        ncmod._hostname_counter = old_counter
+    assert ffd.DEVICE_SOLVES - solves0 == 2, "serving mesh leg fell back"
+    sharded_disp = (
+        kobs.registry().debug_snapshot("feasibility.cube_sharded") or {}
+    ).get("dispatches", 0)
+    assert sharded_disp > sharded_disp0, (
+        "the mesh-sharded cube never dispatched on the serving path"
+    )
+    assert decisions(sharded) == decisions(plain), (
+        "sharded vs single-device serving decisions diverged"
+    )
+    return {
+        "pods": n_pods,
+        "devices": MESH_LEG_DEVICES,
+        "claims": len(sharded.new_node_claims),
+        "decisions_identical": True,
+        "sharded_cube_dispatches": sharded_disp - sharded_disp0,
+        "sharded_solve_ms": round(sharded_ms, 2),
+        "unsharded_solve_ms": round(plain_ms, 2),
+    }
+
+
+def _mesh_leg_main() -> None:
+    """Subprocess entry (`python bench.py --mesh-leg`): expects the virtual
+    8-device CPU platform in the environment; prints ONE JSON line."""
+    out = {
+        "mesh_hyperscale": mesh_hyperscale_leg(),
+        "serving": serving_mesh_leg(),
+    }
+    print(json.dumps(out))
+
+
+def run_mesh_leg(timeout_s: float = 1800.0) -> dict:
+    """Run the mesh legs in a child process with the 8-device virtual CPU
+    platform forced (the parent's jax is already initialized single-device,
+    and XLA's device count is fixed at backend init)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    # only fall back to the virtual CPU platform when the parent doesn't
+    # already see a real multi-chip backend — on actual TPU hardware the
+    # mesh legs must measure the chips, not CPU emulation
+    import jax
+
+    real_mesh_backend = (
+        jax.default_backend() != "cpu"
+        and len(jax.devices()) >= MESH_LEG_DEVICES
+    )
+    if not real_mesh_backend:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={MESH_LEG_DEVICES}"
+            ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh-leg"],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"mesh leg subprocess failed rc={proc.returncode}:\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"mesh leg emitted no JSON:\n{proc.stdout[-2000:]}")
+
+
 def main() -> None:
     from karpenter_tpu.apis.nodepool import NodePool
     from karpenter_tpu.apis.core import ObjectMeta
@@ -940,6 +1285,11 @@ def main() -> None:
         f"admission pipeline hid only "
         f"{fleet['encode_overlap_fraction']:.0%} of host encode time"
     )
+    # Mesh legs (subprocess: the virtual device count must be set before
+    # jax initializes): 1M-pod hyperscale sweep at mesh sizes 1 and 8 plus
+    # the mesh-sharded REAL serving solve — decision identity and the
+    # zero-recompile seal asserted inside
+    mesh = run_mesh_leg()
 
     # Cold-vs-warm restart leg (LAST: it drops every jit executable). Three
     # restarts of the same daemon: the pre-AOT lazy cold path, the AOT cold
@@ -1025,7 +1375,21 @@ def main() -> None:
                     f"{fleet['encode_overlap_fraction']:.0%} of host encode "
                     f"(asserted >=50%), pipelined "
                     f"{fleet['pipelined']['best_ms']:.0f}ms vs unpipelined "
-                    f"{fleet['unpipelined']['best_ms']:.0f}ms best-of-3"
+                    f"{fleet['unpipelined']['best_ms']:.0f}ms best-of-3; "
+                    f"mesh hyperscale @1M pods "
+                    f"({mesh['mesh_hyperscale']['groups']} groups x "
+                    f"{mesh['mesh_hyperscale']['instance_types']} types): "
+                    f"unsharded "
+                    f"{mesh['mesh_hyperscale']['legs']['unsharded']['best_ms']:.0f}ms, "
+                    f"mesh8 "
+                    f"{mesh['mesh_hyperscale']['legs'].get('mesh8', {}).get('best_ms', float('nan')):.0f}ms "
+                    f"best-of-5 "
+                    f"({mesh['mesh_hyperscale']['speedup_mesh8_over_mesh1']}x "
+                    f"mesh8/mesh1 on {mesh['mesh_hyperscale']['cpu_count']} "
+                    f"core(s); >=3x floor asserted when cores >= devices), "
+                    f"decisions bit-identical at every mesh size, 0 steady "
+                    f"recompiles; serving path @20k pods mesh-sharded over "
+                    f"8 devices: decisions identical to single-device"
                 ),
                 "value": round(p50, 2),
                 "unit": "ms",
@@ -1045,6 +1409,12 @@ def main() -> None:
                 # fixed batch stream, with the encode-overlap fraction the
                 # perf floor enforces
                 "fleet": fleet,
+                # mesh legs (ROADMAP item 1): the 1M-pod hyperscale sweep
+                # per mesh size (pods/sec, decision identity, 0 steady
+                # recompiles) and the mesh-sharded REAL serving solve — the
+                # MULTICHIP line now comes from here, not the dryrun
+                "mesh_hyperscale": mesh["mesh_hyperscale"],
+                "serving_mesh": mesh["serving"],
                 "cold_start": {
                     "prewarm_ms": round(warmup_ms, 2),
                     "first_batch_ms": round(cold_ms, 2),
@@ -1078,4 +1448,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--mesh-leg" in sys.argv:
+        _mesh_leg_main()
+    else:
+        main()

@@ -76,27 +76,36 @@ def _toolchain_fingerprint() -> str:
 
 
 def cache_key(
-    catalog_hash: str, kernel: str, sig: str, ladder_version: int
+    catalog_hash: str, kernel: str, sig: str, ladder_version: int,
+    scope: str = "",
 ) -> str:
-    parts = "\n".join(
-        (
-            catalog_hash,
-            _toolchain_fingerprint(),
-            kernel,
-            sig,
-            f"ladder-v{ladder_version}",
-        )
-    )
-    return hashlib.sha256(parts.encode()).hexdigest()
+    """`scope` folds the device layout of a sharded executable into its
+    identity (ops/feasibility.mesh_scope) — sharded global shapes are
+    mesh-size-invariant by design, so without the scope an executable
+    compiled for an 8-way mesh could load into a 1-device process. An
+    empty scope (every unsharded kernel) contributes NOTHING to the key,
+    so persistent caches filled by pre-mesh builds stay valid."""
+    fields = [
+        catalog_hash,
+        _toolchain_fingerprint(),
+        kernel,
+        sig,
+        f"ladder-v{ladder_version}",
+    ]
+    if scope:
+        fields.append(scope)
+    return hashlib.sha256("\n".join(fields).encode()).hexdigest()
 
 
 # -- abstract-shape builders --------------------------------------------------
 
 
-def _sds(shape, dtype):
+def _sds(shape, dtype, sharding=None):
     import jax
 
-    return jax.ShapeDtypeStruct(tuple(int(d) for d in shape), np.dtype(dtype))
+    return jax.ShapeDtypeStruct(
+        tuple(int(d) for d in shape), np.dtype(dtype), sharding=sharding
+    )
 
 
 def _sig(args) -> str:
@@ -176,6 +185,90 @@ def _row_compat_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
     return plans
 
 
+def _mesh_shardings(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = mesh.axis_names[0]
+    return NamedSharding(mesh, PartitionSpec(axis)), NamedSharding(
+        mesh, PartitionSpec()
+    )
+
+
+def _sharded_cube_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
+    """Mesh twins of the cube plans: global bucket shapes with the serving
+    path's exact input layout (entity axes sharded over the mesh, catalog
+    matrices replicated). Only buckets the mesh splits evenly compile —
+    the others are unreachable by construction (bucket_for multiple_of)."""
+    from karpenter_tpu.ops import feasibility as feas
+
+    mesh = engine.mesh
+    n = int(np.prod(mesh.devices.shape))
+    shard, rep = _mesh_shardings(mesh)
+    scope = feas.mesh_scope(mesh)
+    I, O, K = engine.num_instances, engine.num_offerings, engine._key_capacity
+    b = np.bool_
+    plans = []
+    for P, R in ladder.buckets("feasibility.cube_sharded"):
+        if P % n:
+            continue
+        args = (
+            _sds((P, R), b, shard),
+            _sds((R, I), b, rep),
+            _sds((R, O), b, rep),
+            _sds((O, K), b, rep),
+            _sds((P, K), b, shard),
+            _sds((O,), b, rep),
+            _sds((O, I), b, rep),
+        )
+        plans.append(
+            (
+                "feasibility.cube_sharded",
+                feas.sharded_cube(mesh),
+                args,
+                _sig(args),
+                scope,
+            )
+        )
+    return plans
+
+
+def _sharded_solve_block_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
+    """Mesh twins of the packer plans (group axis sharded, catalog
+    replicated), compiled through the SAME jitted shard_map wrapper the
+    serving path dispatches (packer.sharded_solve_block)."""
+    from karpenter_tpu.ops import feasibility as feas
+    from karpenter_tpu.ops import packer
+
+    mesh = engine.mesh
+    n = int(np.prod(mesh.devices.shape))
+    shard, rep = _mesh_shardings(mesh)
+    scope = feas.mesh_scope(mesh)
+    I, O, K = engine.num_instances, engine.num_offerings, engine._key_capacity
+    R = max(1, engine._computed_rows)
+    D = len(engine.resource_dims)
+    b, i32, f32 = np.bool_, np.int32, np.float32
+    fn = packer.sharded_solve_block(mesh)
+    plans = []
+    for (G,) in ladder.buckets("packer.solve_block_sharded"):
+        if G % n:
+            continue
+        args = (
+            _sds((G, R + K), b, shard),
+            _sds((G, D + 1), i32, shard),
+            _sds((R, I), b, rep),
+            _sds((R, O), b, rep),
+            _sds((O, K), b, rep),
+            _sds((O,), b, rep),
+            _sds((O, I), b, rep),
+            _sds((I, D), i32, rep),
+            _sds((I,), f32, rep),
+        )
+        plans.append(
+            ("packer.solve_block_sharded", fn, args, _sig(args), scope)
+        )
+    return plans
+
+
 def _solve_block_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
     """Packer buckets. The catalog-side row axis is the engine's CURRENT
     interned row count (taken after warmup, when the probe rows exist) —
@@ -217,9 +310,10 @@ def _ensure_executable(
 ) -> None:
     """Load-or-compile one bucket; installs into the runtime table and
     records the bucket into the observatory (phase aot-warm)."""
-    kernel, fn, abstract_args, sig = plan
+    kernel, fn, abstract_args, sig = plan[:4]
+    scope = plan[4] if len(plan) > 4 else ""
     summary["buckets"] += 1
-    if aotrt.lookup(kernel, sig) is not None:
+    if aotrt.lookup(kernel, sig, scope) is not None:
         # another engine with identical content already warmed this bucket
         # this process — record it like a cache hit so warm-start telemetry
         # is a pure function of the walk, not of process history
@@ -228,7 +322,7 @@ def _ensure_executable(
         return
     from jax.experimental import serialize_executable as se
 
-    key = cache_key(catalog_hash, kernel, sig, ladder.version)
+    key = cache_key(catalog_hash, kernel, sig, ladder.version, scope=scope)
     t0 = time.perf_counter()
     if cache is not None:
         body = cache.get(key)
@@ -236,7 +330,7 @@ def _ensure_executable(
             try:
                 payload, in_tree, out_tree = pickle.loads(body)
                 exe = se.deserialize_and_load(payload, in_tree, out_tree)
-                aotrt.install(kernel, sig, exe)
+                aotrt.install(kernel, sig, exe, scope=scope)
                 cache.count_hit()  # a hit = an executable actually served
                 summary["cache_hits"] += 1
                 registry.record(
@@ -256,7 +350,7 @@ def _ensure_executable(
         )
         return
     seconds = time.perf_counter() - t0
-    aotrt.install(kernel, sig, exe)
+    aotrt.install(kernel, sig, exe, scope=scope)
     summary["fresh_compiles"] += 1
     registry.record(kernel, sig, seconds, compiled=True, fenced=True, aot=False)
     if cache is not None:
@@ -281,14 +375,22 @@ def warm_start(
     capacities, load/compile every bucket, then run the engine's own warmup
     (whose probe dispatch now rides the AOT table). Idempotent per engine.
 
+    A mesh-sharded engine walks the `_sharded` twin plans instead — same
+    buckets as GLOBAL shapes, entity axes sharded over its mesh, catalog
+    replicated — with the mesh shape folded into both the runtime table
+    scope and the persistent cache key, so warm start precompiles the
+    sharded executables and the zero-recompile seal holds with the mesh on
+    (a restart under a different mesh shape is a cache miss, never a wrong
+    load). The row kernel stays single-device on either path (the catalog
+    is replicated; rows encode once).
+
     Returns the walk summary (buckets / cache_hits / fresh_compiles /
-    already_loaded / errors), or None when AOT is disabled or the engine is
-    mesh-sharded (sharded executables are not AOT-managed yet)."""
+    already_loaded / errors), or None when AOT is disabled."""
     if ladder is None:
         ladder = aotrt.active_ladder()
     if cache is None:
         cache = aotrt.active_cache()
-    if ladder is None or engine is None or engine.mesh is not None:
+    if ladder is None or engine is None:
         if engine is not None:
             engine.warmup()
         return None
@@ -317,7 +419,14 @@ def warm_start(
     chash = content_hash(engine.instance_types)
     registry = kobs.registry()
     with registry.phase_scope("aot-warm"):
-        for plan in _cube_plans(engine, ladder):
+        # a mesh engine serves its sweeps through the sharded twins — the
+        # unsharded executables would be dead weight (and vice versa)
+        cube_plans = (
+            _sharded_cube_plans(engine, ladder)
+            if engine.mesh is not None and engine.num_offerings
+            else _cube_plans(engine, ladder)
+        )
+        for plan in cube_plans:
             _ensure_executable(plan, chash, ladder, cache, registry, summary)
         for plan in _row_compat_plans(engine, ladder):
             _ensure_executable(plan, chash, ladder, cache, registry, summary)
@@ -325,7 +434,12 @@ def warm_start(
         # rides the table) and BEFORE the packer plans (whose row axis is
         # the post-probe interned row count)
         engine.warmup()
-        for plan in _solve_block_plans(engine, ladder):
+        packer_plans = (
+            _sharded_solve_block_plans(engine, ladder)
+            if engine.mesh is not None
+            else _solve_block_plans(engine, ladder)
+        )
+        for plan in packer_plans:
             _ensure_executable(plan, chash, ladder, cache, registry, summary)
     aotrt.note_warm_start(summary["fresh_compiles"])
     engine._aot_warmed = True

@@ -36,12 +36,32 @@ from typing import Optional, Sequence
 LADDER_VERSION = 1
 
 # Kernels the ladder governs, with the number of variable axes each buckets.
+# The `_sharded` twins run the same math shard_mapped over a device mesh;
+# their buckets are GLOBAL (pre-split) shapes, constrained at lookup time to
+# be divisible by the mesh size (bucket_for(multiple_of=)).
 LADDER_KERNELS = {
     "feasibility.cube": 2,
     "feasibility.membership": 2,
     "catalog.row_compat": 1,
     "packer.solve_block": 1,
+    "feasibility.cube_sharded": 2,
+    "packer.solve_block_sharded": 1,
 }
+
+# Sharded dispatches align their entity axis to a multiple of lcm(mesh size,
+# MESH_ALIGN) so the padded GLOBAL shape — the executable key, the
+# observatory bucket, the AOT cache identity — is the same for every mesh
+# size dividing MESH_ALIGN. That is what lets the mesh-smoke CI job demand
+# byte-identical kernel digests at mesh sizes 1 and 8: the mesh changes how
+# a shape splits across chips, never which shape dispatches.
+MESH_ALIGN = 8
+
+
+def mesh_multiple(n: int) -> int:
+    """The entity-axis alignment for an n-device mesh: lcm(n, MESH_ALIGN)."""
+    import math
+
+    return (n * MESH_ALIGN) // math.gcd(max(1, n), MESH_ALIGN)
 
 
 def _pow2(n: int) -> int:
@@ -55,10 +75,14 @@ class Ladder:
     version: int = LADDER_VERSION
     kernels: dict = field(default_factory=dict)  # name -> tuple[tuple[int,...]]
 
-    def bucket_for(self, kernel: str, dims: Sequence[int]) -> Optional[tuple]:
+    def bucket_for(
+        self, kernel: str, dims: Sequence[int], multiple_of: int = 1
+    ) -> Optional[tuple]:
         """The smallest bucket (by cell count) that fits `dims` on every
         axis, or None when the request is off-ladder (no bucket fits, or the
-        kernel has no ladder)."""
+        kernel has no ladder). `multiple_of` constrains the FIRST axis (the
+        sharded entity axis) to buckets divisible by it, so a mesh dispatch
+        can split the bucket evenly across its devices."""
         buckets = self.kernels.get(kernel)
         if not buckets:
             return None
@@ -66,6 +90,8 @@ class Ladder:
         best_cells = None
         for b in buckets:
             if len(b) != len(dims):
+                continue
+            if multiple_of > 1 and b[0] % multiple_of:
                 continue
             if all(bd >= d for bd, d in zip(b, dims)):
                 cells = 1
@@ -117,6 +143,12 @@ def make(kernels: dict, version: int = LADDER_VERSION) -> Ladder:
 # compute either 8x-overpadded to 512 or, past 512, jit-compiled a shape
 # the AOT walk never prepaid (a steady-state recompile, which the
 # observatory seal treats as a bug).
+#
+# The `_sharded` rungs are GLOBAL (pre-split) shapes for mesh dispatches.
+# Every entity rung is a multiple of MESH_ALIGN (8), so one rung serves
+# every mesh size dividing 8 with an even shard split and a mesh-size-
+# invariant executable key; the 4096 packer rung is the hyperscale ceiling
+# (a 1M-pod batch of diverse shapes collapses to low-thousands of groups).
 DEFAULT = make(
     {
         "feasibility.cube": [
@@ -127,6 +159,10 @@ DEFAULT = make(
         ],
         "catalog.row_compat": [(32,), (64,), (128,)],
         "packer.solve_block": [(8,), (64,), (512,)],
+        "feasibility.cube_sharded": [
+            (p, r) for p in (8, 64, 128, 256, 512, 1024) for r in (4, 16, 64)
+        ],
+        "packer.solve_block_sharded": [(8,), (64,), (512,), (4096,)],
     }
 )
 

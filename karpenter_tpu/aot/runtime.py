@@ -39,8 +39,9 @@ _OFF_LADDER = global_registry.counter(
     "karpenter_aot_offladder_dispatches_total",
     "device dispatches of laddered kernels whose shape missed every "
     "configured AOT bucket (each one jit-compiles a shape the warm start "
-    "never prepaid)",
-    labels=["kernel"],
+    "never prepaid); mesh labels the device layout of sharded dispatches "
+    "('' = unsharded)",
+    labels=["kernel", "mesh"],
 )
 _EXEC_FALLBACKS = global_registry.counter(
     "karpenter_aot_executable_fallbacks_total",
@@ -103,33 +104,41 @@ def active_cache() -> Optional[ExecutableCache]:
 # -- the executable table -----------------------------------------------------
 
 
-def lookup(kernel: Optional[str], sig: Optional[str]):
+def lookup(kernel: Optional[str], sig: Optional[str], scope: str = ""):
+    """`scope` separates executables that share a (kernel, shape) identity
+    but were compiled for different device layouts — a shard_mapped kernel's
+    global shape is mesh-size-invariant by design (ladder.MESH_ALIGN), so
+    the mesh shape must live in the TABLE key, never in the observatory's
+    shape signature (kernel digests stay mesh-invariant)."""
     if kernel is None or not _EXECUTABLES:
         return None
-    return _EXECUTABLES.get((kernel, sig))
+    return _EXECUTABLES.get((kernel, sig, scope))
 
 
-def install(kernel: str, sig: str, executable) -> None:
+def install(kernel: str, sig: str, executable, scope: str = "") -> None:
     with _lock:
-        _EXECUTABLES[(kernel, sig)] = executable
+        _EXECUTABLES[(kernel, sig, scope)] = executable
 
 
-def discard(kernel: str, sig: str, error: Optional[str] = None) -> None:
+def discard(
+    kernel: str, sig: str, error: Optional[str] = None, scope: str = ""
+) -> None:
     """An installed executable failed at call time (backend change, aval
     drift): drop it and count the fallback — dispatch re-runs through jit."""
     with _lock:
-        _EXECUTABLES.pop((kernel, sig), None)
+        _EXECUTABLES.pop((kernel, sig, scope), None)
     _EXEC_FALLBACKS.inc({"kernel": kernel})
     _log.warning(
         "AOT executable failed; falling back to JIT",
-        kernel=kernel, shape=sig, error=error or "",
+        kernel=kernel, shape=sig, scope=scope or None, error=error or "",
     )
 
 
 def executables() -> list[dict]:
     with _lock:
         return [
-            {"kernel": k, "shape": s} for (k, s) in sorted(_EXECUTABLES)
+            {"kernel": k, "shape": s, **({"scope": sc} if sc else {})}
+            for (k, s, sc) in sorted(_EXECUTABLES)
         ]
 
 
@@ -156,26 +165,35 @@ def on_off_ladder(cb: Callable[[str, str], None], key: str = "default") -> None:
         _OFF_LADDER_CBS[key] = cb
 
 
-def note_off_ladder(kernel: str, shape: str) -> None:
+def note_off_ladder(kernel: str, shape: str, mesh: str = "") -> None:
+    """`mesh` carries the device layout of a sharded dispatch (e.g.
+    "mesh=8:pods"): it labels the counter and the event so a mis-sized
+    ladder's warnings name WHICH mesh shape missed, not just the kernel."""
     global _OFF_LADDER_COUNT
     with _lock:
         _OFF_LADDER_COUNT += 1
-        _OFF_LADDER_EVENTS.append({"kernel": kernel, "shape": shape})
+        event = {"kernel": kernel, "shape": shape}
+        if mesh:
+            event["mesh"] = mesh
+        _OFF_LADDER_EVENTS.append(event)
         del _OFF_LADDER_EVENTS[:-50]
-        first = (kernel, shape) not in _OFF_LADDER_SEEN
-        _OFF_LADDER_SEEN.add((kernel, shape))
+        first = (kernel, shape, mesh) not in _OFF_LADDER_SEEN
+        _OFF_LADDER_SEEN.add((kernel, shape, mesh))
         cbs = tuple(_OFF_LADDER_CBS.values())
-    _OFF_LADDER.inc({"kernel": kernel})
+    _OFF_LADDER.inc({"kernel": kernel, "mesh": mesh})
     if first:
         _log.warning(
             "dispatch missed the AOT bucket ladder; this shape jit-compiles "
             "instead of warm-starting — tune the ladder "
             "(/debug/kernels?view=ladder)",
-            kernel=kernel, shape=shape,
+            kernel=kernel, shape=shape, mesh=mesh or None,
         )
+    # callbacks keep the 2-arg (kernel, shape) contract; a sharded
+    # dispatch's shape carries the mesh so the published event names it
+    cb_shape = f"{shape}@{mesh}" if mesh else shape
     for cb in cbs:
         try:
-            cb(kernel, shape)
+            cb(kernel, cb_shape)
         except Exception:  # noqa: BLE001 — observers never break dispatch
             pass
 
@@ -247,7 +265,9 @@ def ladder_view() -> dict:
     snap = kobs.registry().counts_snapshot()
     observed: dict[str, list] = {}
     with _lock:
-        installed = set(_EXECUTABLES)
+        # on_ladder is a (kernel, shape) question — any scope's executable
+        # (a mesh variant included) makes the observed bucket prepaid
+        installed = {(k, s) for (k, s, _scope) in _EXECUTABLES}
         off_events = list(_OFF_LADDER_EVENTS)
         off_count = _OFF_LADDER_COUNT
     for name in sorted(snap):

@@ -74,8 +74,12 @@ def _type_fingerprint(it) -> tuple:
 
 def _build_solver_mesh(shard_devices: int):
     """jax Mesh over the first `shard_devices` local devices for DP-sharded
-    cube sweeps (options.solver_pod_shard_axis); None when unavailable."""
-    if shard_devices <= 1:
+    cube sweeps (options.solver_pod_shard_axis, i.e. --shard-devices /
+    --mesh); None when off (< 1) or unavailable. A 1-device mesh is real:
+    it routes the `_sharded` kernels and is bit-identical to the unsharded
+    path. Logs the mesh shape and device kinds once per build — the
+    startup line that says which chips the pod axis landed on."""
+    if shard_devices < 1:
         return None
     try:
         import jax
@@ -84,13 +88,36 @@ def _build_solver_mesh(shard_devices: int):
 
         devices = jax.devices()
         if len(devices) < shard_devices:
+            _log.warning(
+                "not enough devices for the requested solver mesh; "
+                "running single-device (for a CPU dryrun set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N)",
+                shard_devices=shard_devices,
+                available=len(devices),
+                kinds=sorted({getattr(d, "device_kind", "?") for d in devices}),
+            )
             return None
-        return Mesh(_np.array(devices[:shard_devices]), ("pods",))
-    except Exception:  # noqa: BLE001 — no usable backend: single device
+        mesh = Mesh(_np.array(devices[:shard_devices]), ("pods",))
+        _log.info(
+            "solver mesh built: pod axis sharded over local devices",
+            shard_devices=shard_devices,
+            mesh_shape=dict(mesh.shape),
+            device_kinds=sorted(
+                {getattr(d, "device_kind", "?") for d in devices[:shard_devices]}
+            ),
+            backend=jax.default_backend(),
+        )
+        return mesh
+    except Exception as e:  # noqa: BLE001 — no usable backend: single device
+        _log.warning(
+            "solver mesh unavailable; running single-device",
+            shard_devices=shard_devices,
+            error=f"{type(e).__name__}: {e}",
+        )
         return None
 
 
-def default_engine_factory(shard_devices: int = 1):
+def default_engine_factory(shard_devices: int = 0):
     """CatalogEngine per distinct instance-type union. Two cache levels: an
     id-keyed fast path (providers return stable InstanceType objects, so the
     steady-state lookup is free) backed by a process-wide content-keyed cache

@@ -62,7 +62,14 @@ class Options:
 
     # TPU-solver knobs (ours, not the reference's)
     solver_backend: str = "tpu"  # "tpu" | "host"
-    solver_pod_shard_axis: int = 1  # devices to shard the pod axis over
+    # --shard-devices / --mesh: devices to put the pod axis on. 0 (default)
+    # = no mesh, single-device dispatch; N >= 1 builds an N-device
+    # jax.sharding.Mesh over the local devices and routes every feasibility
+    # x packing sweep through the `_sharded` kernels (a 1-device mesh is
+    # bit-identical to the unsharded path — it exists so digests compare
+    # across mesh sizes). 8-device CPU dryrun:
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8 when no TPU.
+    solver_pod_shard_axis: int = 0
     # solverd: the batched solver service fronting every solve/simulation
     # (karpenter_tpu/solverd). "inprocess" runs the service inside the
     # operator; "socket" forwards solves to a sidecar daemon
@@ -149,7 +156,12 @@ class Options:
         parser.add_argument("--cluster-name")
         parser.add_argument("--feature-gates", dest="feature_gates_raw")
         parser.add_argument("--solver-backend")
-        parser.add_argument("--solver-pod-shard-axis", type=int)
+        parser.add_argument(
+            "--shard-devices", "--mesh", "--solver-pod-shard-axis",
+            type=int, dest="solver_pod_shard_axis",
+            help="devices to shard the solver's pod axis over (0 = no "
+            "mesh; 1 = 1-device mesh, decision-identical to unsharded)",
+        )
         parser.add_argument("--solver-transport")
         parser.add_argument("--solver-daemon-address")
         parser.add_argument("--solverd-queue-depth", type=int)
@@ -181,6 +193,7 @@ class Options:
             "min_values_policy": "MIN_VALUES_POLICY",
             "cluster_name": "CLUSTER_NAME",
             "solver_backend": "SOLVER_BACKEND",
+            "solver_pod_shard_axis": "SHARD_DEVICES",
             "solver_transport": "SOLVER_TRANSPORT",
             "solver_daemon_address": "SOLVER_DAEMON_ADDRESS",
             "solverd_tenant_quota": "SOLVERD_TENANT_QUOTA",

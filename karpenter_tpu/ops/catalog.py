@@ -563,19 +563,50 @@ class CatalogEngine:
         # host-vs-device decision.
         host_cells = P2 * R2 * (self.num_instances + self.num_offerings)
         on_device = _use_device(host_cells, _HOST_MATMUL_CELLS_PER_S)
-        ladder_kernel = (
-            "feasibility.cube" if self.num_offerings else "feasibility.membership"
+        # The mesh serves the production cube (offerings present); a
+        # membership-only engine is a degenerate catalog too small to shard.
+        mesh_n = (
+            int(np.prod(self.mesh.devices.shape))
+            if self.mesh is not None and self.num_offerings
+            else 0
         )
-        if on_device and self.aot_ladder is not None and self.mesh is None:
+        ladder_kernel = (
+            "feasibility.cube_sharded"
+            if mesh_n
+            else (
+                "feasibility.cube"
+                if self.num_offerings
+                else "feasibility.membership"
+            )
+        )
+        if on_device and mesh_n:
+            # mesh-size-INVARIANT global entity axis: align the pow2 bucket
+            # to lcm(n, MESH_ALIGN), so a 1-device and an 8-device mesh
+            # dispatch the SAME padded shape (the mesh changes how it
+            # splits, never what it is) and kernel digests stay comparable
+            from karpenter_tpu.aot import ladder as ladder_mod
+
+            align = ladder_mod.mesh_multiple(mesh_n)
+            P2 = -(-max(P2, align) // align) * align
+        if on_device and self.aot_ladder is not None:
             # look up by the RAW dims, not the pow2-inflated ones: a tuned
             # ladder may carry non-power-of-two buckets, and (P2, R2) would
-            # make them unreachable
-            bucket = self.aot_ladder.bucket_for(ladder_kernel, (P, R))
+            # make them unreachable. A mesh constrains the entity axis to
+            # buckets its devices split evenly.
+            bucket = self.aot_ladder.bucket_for(
+                ladder_kernel, (P, R), multiple_of=mesh_n or 1
+            )
             if bucket is None:
-                # past the largest bucket: keep pow2 padding and flag it —
-                # this dispatch jit-compiles a shape the warm start never
-                # prepaid (the ladder-tuning signal)
-                aotrt.note_off_ladder(ladder_kernel, f"{P2}x{R2}")
+                # past the largest bucket (or a ladder with no rung this
+                # mesh divides): keep pow2 padding and flag it — this
+                # dispatch jit-compiles a shape the warm start never
+                # prepaid (the ladder-tuning signal). The mesh rides the
+                # label so the warning names the device layout that missed.
+                aotrt.note_off_ladder(
+                    ladder_kernel,
+                    f"{P2}x{R2}",
+                    mesh=feas.mesh_scope(self.mesh) if mesh_n else "",
+                )
             else:
                 P2, R2 = bucket
         membership = np.zeros((P2, R2), dtype=bool)
@@ -620,24 +651,29 @@ class CatalogEngine:
             # ONE fused dispatch (both matmuls + offering reduce): through a
             # tunneled chip the round-trip dominates, so program count is the
             # cost model. With a mesh, the entity axis shards across chips.
-            if self.mesh is not None:
-                # pad the entity axis to a multiple of the mesh size (P2 is a
-                # power of two but the mesh need not be)
-                n = int(np.prod(self.mesh.devices.shape))
-                P3 = -(-max(P2, n) // n) * n
-                if P3 > P2:
-                    membership = np.pad(membership, ((0, P3 - P2), (0, 0)))
-                    key_present_p = np.pad(key_present_p, ((0, P3 - P2), (0, 0)))
+            if mesh_n:
+                # entity axis already aligned to the mesh above; commit the
+                # per-query arrays with their intended shardings so the
+                # dispatch matches the AOT-compiled input layout exactly
+                # (entity-sharded queries, replicated catalog — all-gather
+                # only when the result leaves the mesh)
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                axis = self.mesh.axis_names[0]
+                shard = NamedSharding(self.mesh, PartitionSpec(axis))
+                rep = NamedSharding(self.mesh, PartitionSpec())
                 compat_d, offering_d = ktime.dispatch(
                     feas.sharded_cube(self.mesh),
-                    membership,
-                    req_compat_h,
-                    offer_compat_h,
+                    jax.device_put(membership, shard),
+                    jax.device_put(req_compat_h, rep),
+                    jax.device_put(offer_compat_h, rep),
                     self._mesh_dev("custom_need", self.offering_custom_need),
-                    key_present_p,
+                    jax.device_put(key_present_p, shard),
                     self._mesh_dev("available", self.offering_available),
                     self._mesh_dev("owner_onehot", self._owner_onehot),
                     kernel="feasibility.cube_sharded",
+                    aot_scope=feas.mesh_scope(self.mesh),
                 )
             else:
                 compat_d, offering_d = ktime.dispatch(

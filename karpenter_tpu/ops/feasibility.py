@@ -291,6 +291,17 @@ production_cube = jax.jit(_cube_math)
 _sharded_cube_cache: dict = {}
 
 
+def mesh_scope(mesh) -> str:
+    """The AOT table/cache scope of a mesh: device count + axis names.
+    Sharded dispatches pad to mesh-size-INVARIANT global shapes
+    (aot/ladder.MESH_ALIGN), so the device layout must be carried by this
+    scope — in the runtime executable table and the persistent cache key,
+    never in the observatory shape signature (kernel digests stay
+    mesh-invariant by construction)."""
+    n = int(np.prod(mesh.devices.shape))
+    return f"mesh={n}:{','.join(mesh.axis_names)}"
+
+
 def sharded_cube(mesh):
     """The production cube under shard_map: the entity axis (pods/groups ×
     templates) is data-parallel across the mesh, the catalog matrices are

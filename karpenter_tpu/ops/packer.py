@@ -124,6 +124,37 @@ def _solve_block(
 
 solve_block_jit = jax.jit(_solve_block)
 
+# One jitted shard_map per (mesh, axis), shared by every GroupSolver on the
+# mesh AND by the AOT compiler's warm-start walk — the walk must pre-compile
+# through the SAME wrapper the serving path dispatches, or the jit caches
+# (and the compile accounting) would split.
+_SHARDED_SOLVE_FNS: dict[tuple, object] = {}
+
+
+def sharded_solve_block(mesh: Mesh, axis: str = "pods"):
+    """jit(shard_map(_solve_block)) for `mesh`: groups data-parallel over
+    `axis`, the full catalog replicated per chip, the packed result
+    all-gathered only at emit (out_specs=P(axis)) — no collectives inside
+    the solve."""
+    fn = _SHARDED_SOLVE_FNS.get((mesh, axis))
+    if fn is None:
+        n_catalog_args = 7
+        in_specs = (P(axis), P(axis)) + tuple(P() for _ in range(n_catalog_args))
+        fn = jax.jit(
+            shard_map(
+                _solve_block, mesh=mesh, in_specs=in_specs,
+                out_specs=P(axis), **_SHARD_MAP_UNCHECKED,
+            )
+        )
+        _SHARDED_SOLVE_FNS[(mesh, axis)] = fn
+    return fn
+
+
+# the AOT table/cache scope of a mesh — defined beside the sharded cube
+# (ops/feasibility.mesh_scope) so ops/catalog shares it without importing
+# this module
+mesh_scope = feas.mesh_scope
+
 
 def _pack_groups(grouped: "GroupedPods") -> tuple[np.ndarray, np.ndarray]:
     group_bools = np.concatenate([grouped.membership, grouped.key_present], axis=1)
@@ -138,7 +169,10 @@ class GroupSolver:
 
     def __init__(self, engine: CatalogEngine, mesh: Optional[Mesh] = None):
         self.engine = engine
-        self.mesh = mesh
+        # an explicit mesh wins; otherwise inherit the engine's — a solver
+        # built on a mesh-sharded engine serves mesh-sharded solves without
+        # every call site knowing about meshes
+        self.mesh = mesh if mesh is not None else engine.mesh
         # cheapest available offering price per instance type
         price = np.full(engine.num_instances, np.inf, dtype=np.float32)
         for o_idx, owner in enumerate(engine.offering_owner):
@@ -151,7 +185,8 @@ class GroupSolver:
         ).astype(np.int32)
         self._dev_args = None
         self._dev_rows = -1
-        self._sharded_fns: dict[tuple, object] = {}
+        self._mesh_args = None
+        self._mesh_args_key = None
 
     def _catalog_args(self):
         """Device-resident catalog matrices, uploaded once per row-set."""
@@ -171,14 +206,49 @@ class GroupSolver:
         self._dev_rows = e._computed_rows
         return self._dev_args
 
+    def _mesh_catalog_args(self, mesh: Mesh) -> tuple:
+        """Mesh-replicated catalog matrices, shipped to every chip once per
+        (mesh, row-set) — the _catalog_args analogue for sharded solves.
+        Replicates from the HOST copies: bouncing the cached single-device
+        jnp arrays through np.asarray would round-trip the whole catalog
+        device→host→mesh on every solve."""
+        e = self.engine
+        e._ensure_rows()
+        key = (mesh, e._computed_rows)
+        if self._mesh_args_key == key:
+            return self._mesh_args
+        rep = NamedSharding(mesh, P())
+        host = (
+            e._req_compat
+            if e._computed_rows
+            else np.zeros((1, e.num_instances), bool),
+            e._offer_compat
+            if e._computed_rows
+            else np.zeros((1, e.num_offerings), bool),
+            e.offering_custom_need,
+            e.offering_available,
+            e._owner_onehot,
+            self.alloc_q,
+            self.price,
+        )
+        self._mesh_args = tuple(
+            jax.device_put(np.asarray(a), rep) for a in host
+        )
+        self._mesh_args_key = key
+        return self._mesh_args
+
     def solve(self, grouped: GroupedPods):
-        """Single-device fused solve; returns host arrays
+        """Fused solve; returns host arrays
         (choice, feasible, nodes-per-group, unschedulable-per-group).
-        Dispatch goes through the kernel timer so the solve span can split
-        wall time into compile vs execute (tracing/kernel.py). With an AOT
-        ladder attached to the engine, the group axis pads up to its bucket
-        (zero rows: counts 0 → nodes 0, sliced off) so the dispatch hits a
-        warm-started executable."""
+        With a mesh attached (GroupSolver(mesh=) or the engine's), the
+        group axis shards across its devices via solve_sharded — same
+        decisions, computed in parallel. Dispatch goes through the kernel
+        timer so the solve span can split wall time into compile vs execute
+        (tracing/kernel.py). With an AOT ladder attached to the engine, the
+        group axis pads up to its bucket (zero rows: counts 0 → nodes 0,
+        sliced off) so the dispatch hits a warm-started executable."""
+        if self.mesh is not None:
+            return self.solve_sharded(grouped, self.mesh)
         args = self._catalog_args()
         group_bools, group_ints = _pack_groups(grouped)
         G = group_bools.shape[0]
@@ -210,39 +280,57 @@ class GroupSolver:
 
     def solve_sharded(self, grouped: GroupedPods, mesh: Mesh, axis: str = "pods"):
         """Multi-chip solve: groups sharded over `axis`, catalog replicated
-        (the §7 DP-style layout — collectives only for the final sums)."""
+        (the §7 DP-style layout — collectives only for the final sums).
+
+        The group axis pads to a mesh-size-INVARIANT global shape: the AOT
+        ladder's sharded rung when one fits (divisible by the mesh size, so
+        every shard gets an equal slab), else pow2 aligned to
+        lcm(n, MESH_ALIGN). Padding rows carry counts 0 — they pack to 0
+        nodes / 0 unschedulable on whatever shard they land on (an entirely-
+        padding shard computes only zeros) and are sliced off before any
+        claim is emitted."""
+        from karpenter_tpu.aot import ladder as ladder_mod
+
         n = mesh.shape[axis]
         G = grouped.membership.shape[0]
-        pad = (-G) % n
-        def pad0(a):
-            return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
-
         group_bools, group_ints = _pack_groups(grouped)
-        group_bools = pad0(group_bools)
-        group_ints = pad0(group_ints)
-        catalog_args = self._catalog_args()
 
-        in_specs = (P(axis), P(axis)) + tuple(P() for _ in catalog_args)
-        out_specs = P(axis)
-
-        fn_key = (id(mesh), axis)
-        fn = self._sharded_fns.get(fn_key)
-        if fn is None:
-            fn = jax.jit(
-                shard_map(
-                    _solve_block, mesh=mesh, in_specs=in_specs,
-                    out_specs=out_specs, **_SHARD_MAP_UNCHECKED,
-                )
+        align = ladder_mod.mesh_multiple(n)
+        G2 = max(1 << max(0, (G - 1).bit_length()), align)
+        G2 = -(-G2 // align) * align
+        ladder = getattr(self.engine, "aot_ladder", None)
+        scope = mesh_scope(mesh)
+        if ladder is not None:
+            bucket = ladder.bucket_for(
+                "packer.solve_block_sharded", (G,), multiple_of=n
             )
-            self._sharded_fns[fn_key] = fn
+            if bucket is None:
+                # off-ladder: this global shape jit-compiles a sharded
+                # executable the warm start never prepaid; the mesh rides
+                # the shape label so the event names the layout that missed
+                from karpenter_tpu.aot import runtime as aotrt
+
+                aotrt.note_off_ladder(
+                    "packer.solve_block_sharded", str(G2), mesh=scope
+                )
+            else:
+                G2 = bucket[0]
+        if G2 > G:
+            pad = G2 - G
+            group_bools = np.pad(group_bools, ((0, pad), (0, 0)))
+            group_ints = np.pad(group_ints, ((0, pad), (0, 0)))
+
+        fn = sharded_solve_block(mesh, axis)
         sharding = NamedSharding(mesh, P(axis))
-        rep = NamedSharding(mesh, P())
         dev_args = [
             jax.device_put(group_bools, sharding),
             jax.device_put(group_ints, sharding),
-        ] + [jax.device_put(np.asarray(a), rep) for a in catalog_args]
+        ] + list(self._mesh_catalog_args(mesh))
         out = np.asarray(
-            ktime.dispatch(fn, *dev_args, kernel="packer.solve_block_sharded")
+            ktime.dispatch(
+                fn, *dev_args,
+                kernel="packer.solve_block_sharded", aot_scope=scope,
+            )
         )
         return (
             out[:G, 0],
@@ -271,6 +359,37 @@ def scatter_add_counts(
         counts = grown
     np.add.at(counts, idx, amount)
     return counts
+
+
+def merge_shard_group_counts(
+    shard_group_ids: Sequence[np.ndarray],
+    num_groups: int,
+    shard_amounts: Optional[Sequence[np.ndarray]] = None,
+) -> np.ndarray:
+    """Segment-reduce per-shard group-membership streams into ONE global
+    [num_groups] count vector — the claim-emission merge for a pod-axis-
+    sharded encode, where one group's pods may land on several shards and
+    each shard only knows its local tally. Ids past num_groups are padding
+    rows (the mesh-alignment remainder) and are MASKED OUT, never counted.
+    With `shard_amounts`, entry j of shard s contributes amounts[s][j]
+    instead of 1 (pre-reduced per-shard count tensors merge the same way).
+    Semantics match np.add.at over the concatenated streams — duplicates
+    accumulate, exactly like scatter_add_counts and the host dict walk.
+    NOTE: the shipped encode (encode_pods_for_packer) groups on the host
+    before sharding, so group counts arrive whole; this is the merge
+    primitive for encodes that split the raw pod stream across shards
+    (spec'd against the concatenated-scatter oracle in tests/test_mesh.py)."""
+    out = np.zeros(num_groups, dtype=np.int64)
+    for s, ids in enumerate(shard_group_ids):
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        amounts = (
+            np.ones(ids.shape[0], dtype=np.int64)
+            if shard_amounts is None
+            else np.asarray(shard_amounts[s], dtype=np.int64).reshape(-1)
+        )
+        keep = (ids >= 0) & (ids < num_groups)
+        np.add.at(out, ids[keep], amounts[keep])
+    return out
 
 
 def encode_pods_for_packer(

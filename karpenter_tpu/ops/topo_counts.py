@@ -153,6 +153,49 @@ class GroupCounts:
             self._np = scatter_add_counts(self._np, idx)
         self.synced_gen = tg._gen
 
+    def record_shards(self, shard_domain_batches) -> None:
+        """Placement-batch record for a mesh-sharded emit: each shard of
+        the pod axis reports the domains its local placements landed in,
+        and the increments merge into the tensor by ONE segment reduction
+        (merge_shard_counts) — duplicates across shards accumulate exactly
+        as the sequential host walk would, so the merged tensor is
+        bit-identical to recording the flattened stream domain-by-domain
+        (spec'd against the TopologyGroup oracle in tests/test_mesh.py).
+        The host dict stays the single source of truth: it absorbs the
+        same flattened stream through tg.record. NOTE: today's serving
+        scan walks placements sequentially and records through `record`;
+        this is the merge primitive for emit paths that produce per-shard
+        placement batches (the device-resident scan, ROADMAP item 2)."""
+        flat = [d for batch in shard_domain_batches for d in batch]
+        if not flat:
+            return
+        tg = self.tg
+        drifted = self.synced_gen != tg._gen
+        tg.record(*flat)
+        if drifted:
+            self.resync()
+            return
+        counts = self.counts
+        vocab_id = self.vocab.id
+        idx_batches = []
+        for batch in shard_domain_batches:
+            ids = []
+            for d in batch:
+                i = vocab_id(d)
+                if i >= len(counts):
+                    counts.extend([-1] * (i + 1 - len(counts)))
+                # -1 marks an absent domain; first increment revives it at 1
+                if counts[i] < 0:
+                    counts[i] = 0
+                ids.append(i)
+            idx_batches.append(np.asarray(ids, dtype=np.int64))
+        merged = merge_shard_counts(idx_batches, len(counts))
+        for i in np.nonzero(merged)[0]:
+            counts[int(i)] += int(merged[i])
+        if self._np is not None:
+            self._np = None  # rebuilt lazily from the merged host list
+        self.synced_gen = tg._gen
+
     # (no register() counterpart: hostname groups — the only registration
     # path in the solver — stay dict-backed, so registrations go straight
     # to the host group and any tensor resyncs on the gen drift)
@@ -173,6 +216,21 @@ class GroupCounts:
         if self._np is None or len(self._np) != len(self.counts):
             self._np = np.maximum(np.asarray(self.counts, dtype=np.int64), 0)
         return self._np
+
+
+def merge_shard_counts(
+    shard_idx_batches, size: int, amount: int = 1
+) -> np.ndarray:
+    """Segment-reduce per-shard domain-id increment streams into one dense
+    [size] vector: the merge-at-emit step of a mesh-sharded placement
+    batch. One implementation of the mask-and-scatter semantics
+    (ops/packer.merge_shard_group_counts); every kept index contributes
+    `amount`. Indices outside [0, size) are padding remainders and
+    contribute nothing."""
+    from karpenter_tpu.ops.packer import merge_shard_group_counts
+
+    out = merge_shard_group_counts(shard_idx_batches, size)
+    return out * amount if amount != 1 else out
 
 
 def _unconstrained(req) -> bool:

@@ -56,6 +56,14 @@ def main(argv=None) -> int:
         "'off' (a --compile-cache-dir implies 'default')",
     )
     parser.add_argument(
+        "--shard-devices", type=int, default=0, dest="shard_devices",
+        help="devices to shard the solver's pod axis over: the run's "
+        "engines carry an N-device jax Mesh and route sweeps through the "
+        "sharded kernels (0 = single device; 1 = 1-device mesh, "
+        "decision-identical — event digests match across mesh sizes; "
+        "CPU dryrun: XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     parser.add_argument(
@@ -85,12 +93,13 @@ def main(argv=None) -> int:
             f.write(tracemod.dumps(trace) + "\n")
 
     options = None
-    if args.compile_cache_dir or args.aot_ladder:
+    if args.compile_cache_dir or args.aot_ladder or args.shard_devices:
         from karpenter_tpu.operator.options import Options
 
         options = Options(
             compile_cache_dir=args.compile_cache_dir,
             aot_ladder=args.aot_ladder,
+            solver_pod_shard_axis=args.shard_devices,
         )
 
     if trace.get("fleet"):

@@ -82,6 +82,12 @@ register(
     "3 tenant clusters on a 2-replica solverd pool; one replica SIGKILLed mid-run",
 )
 register(
+    "mesh-sweep",
+    tracemod.mesh_sweep,
+    "shape-diverse waves wide enough to engage the device sweep; the mesh-smoke "
+    "scenario (digests match across --shard-devices sizes)",
+)
+register(
     "consolidation-churn",
     tracemod.consolidation_churn,
     "fan-out waves drain into underutilized fleets; multi-node frontier consolidation folds them",

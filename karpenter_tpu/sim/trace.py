@@ -267,6 +267,63 @@ def tpu_training(rng: Random) -> dict:
     return trace
 
 
+def mesh_sweep(rng: Random) -> dict:
+    """A shape-diverse fleet wide enough to engage the DEVICE feasibility
+    sweep under the sim's pinned routing: each wave submits dozens of
+    distinct (zone, arch, capacity-type, size) combinations in ONE batch,
+    so the joint-mask priming sweep crosses the device-RTT threshold
+    instead of taking the host twin (every other scenario's one-or-two-
+    shape batches stay host-side). The second wave lands NEW shapes in the
+    SAME padded bucket — post-seal device dispatches that must not
+    recompile. This is the mesh-smoke scenario: sharded dispatches pad to
+    mesh-size-invariant global shapes, so runs at --shard-devices 1 and 8
+    must produce byte-identical event AND kernel digests."""
+    trace = _base("mesh-sweep", duration=240.0)
+    zones = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+    cpus = ["500m", "1", "2", "4"]
+    mems = ["1Gi", "2Gi", "4Gi"]
+    # the full selector cross product: 5 zone options x 3 arch x 2 capacity
+    # = 30 distinct requirement ROW-SETS in one batch — wide enough that the
+    # joint-mask priming sweep (P2=32, R2~16 against the 144x1152 kwok
+    # catalog) clears the pinned-RTT device threshold
+    combos = [
+        (z, a, c)
+        for z in [None, *zones]
+        for a in (None, "amd64", "arm64")
+        for c in (None, "spot")
+    ]
+
+    def wave(salt: int, at: float, until=None) -> list[dict]:
+        events = []
+        for i, (zone, arch, ct) in enumerate(combos):
+            pod = {"cpu": cpus[(i + salt) % 4], "memory": mems[(i + salt) % 3]}
+            if zone:
+                pod["zone"] = zone
+            if arch:
+                pod["arch"] = arch
+            if ct:
+                pod["capacity_type"] = ct
+            ev = {
+                "at": at,
+                "kind": "submit",
+                "group": f"sweep-{salt}-{i}",
+                # 3-4 pods per combo: the wave lands ~100 pods in ONE
+                # provisioner batch, clearing ffd.DEVICE_MIN_PODS so the
+                # solve takes the device fast path (every other scenario's
+                # batches fall back to the host scan)
+                "count": 3 + rng.randrange(2),
+                "pod": pod,
+                "replace": True,
+            }
+            if until is not None:
+                ev["until"] = until
+            events.append(ev)
+        return events
+
+    trace["events"] = wave(0, 4.0) + wave(1, 120.0, until=200.0)
+    return trace
+
+
 def consolidation_churn(rng: Random) -> dict:
     """The consolidation-heavy shape the frontier search exists for: waves
     of large short-lived pods fan the cluster out to many nodes, each

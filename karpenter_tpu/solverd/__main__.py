@@ -62,6 +62,14 @@ def main(argv=None) -> int:
         help="seconds SIGTERM waits for in-flight batches before exiting",
     )
     parser.add_argument(
+        "--shard-devices", "--mesh", type=int, default=0, dest="shard_devices",
+        help="devices to shard the solver's pod axis over: every engine "
+        "this daemon rebuilds carries an N-device jax Mesh and routes its "
+        "feasibility x packing sweeps through the sharded kernels (0 = "
+        "single device; 1 = 1-device mesh, decision-identical; CPU dryrun: "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+    )
+    parser.add_argument(
         "--compile-cache-dir", default="",
         help="persistent AOT executable cache directory; restarts "
         "warm-start their engines from it instead of re-compiling",
@@ -97,7 +105,8 @@ def main(argv=None) -> int:
         tenant_weights=parse_tenant_weights(ns.tenant_weights),
     )
     daemon = SolverDaemon(
-        service, address=ns.listen, replica_id=ns.replica_id
+        service, address=ns.listen, replica_id=ns.replica_id,
+        shard_devices=ns.shard_devices,
     ).start()
     log.info(
         "solver daemon listening",
@@ -106,6 +115,7 @@ def main(argv=None) -> int:
         queue_depth=ns.queue_depth,
         coalesce_window=ns.coalesce_window,
         tenant_quota=ns.tenant_quota,
+        shard_devices=ns.shard_devices or None,
         aot=aotrt.enabled(),
         compile_cache_dir=ns.compile_cache_dir or None,
     )

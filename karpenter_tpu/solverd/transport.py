@@ -603,9 +603,12 @@ class SolverDaemon:
         address: str = "127.0.0.1:0",
         engine_factory=None,
         replica_id: str = "",
+        shard_devices: int = 0,
     ):
         self.service = service
-        self.engine_factory = engine_factory or _default_engine_factory()
+        self.engine_factory = engine_factory or _default_engine_factory(
+            shard_devices
+        )
         family, target = parse_address(address)
         if family == "tcp" and target[0] not in ("127.0.0.1", "localhost", "::1"):
             # the payload is a pickle: deserializing it executes code, so the
@@ -836,10 +839,15 @@ def _detached(results):
     return results
 
 
-def _default_engine_factory():
+def _default_engine_factory(shard_devices: int = 0):
     """Content-cached CatalogEngine builder for the daemon: one engine per
-    distinct catalog (by instance-type fingerprint), encoded once."""
+    distinct catalog (by instance-type fingerprint), encoded once. With
+    `shard_devices` >= 1 (the daemon's --shard-devices flag) every rebuilt
+    engine carries an N-device mesh, so sweeps shipped to this sidecar run
+    shard_mapped over its local chips — the daemon owns the accelerator,
+    so the mesh lives HERE, not in the operator that shipped the catalog."""
     from karpenter_tpu.controllers.provisioning.provisioner import (
+        _build_solver_mesh,
         _type_fingerprint,
     )
 
@@ -851,7 +859,9 @@ def _default_engine_factory():
         key = tuple(_type_fingerprint(it) for it in catalog)
         engine = cache.get(key)
         if engine is None:
-            engine = CatalogEngine(catalog)
+            engine = CatalogEngine(
+                catalog, mesh=_build_solver_mesh(shard_devices)
+            )
             # warm-start path for daemon restarts: with the AOT compile
             # service configured (--compile-cache-dir / --aot-ladder), a
             # rebuilt engine loads its ladder executables from the

@@ -72,7 +72,7 @@ def _cache_size(fn) -> Optional[int]:
         return None
 
 
-def dispatch(fn, *args, kernel: Optional[str] = None):
+def dispatch(fn, *args, kernel: Optional[str] = None, aot_scope: str = ""):
     """Call a jitted function, block until its outputs are ready, and
     attribute the wall time to compile or execute. Transparent (returns the
     outputs) and free when no measurement context is open and no kernel
@@ -83,12 +83,15 @@ def dispatch(fn, *args, kernel: Optional[str] = None):
     loaded executable directly — no jit cache, no compile, so a
     warm-started daemon's first solve pays zero compiles. An AOT
     executable that fails at call time (backend drift) is discarded and
-    the dispatch falls back to the jit path."""
+    the dispatch falls back to the jit path. `aot_scope` narrows the table
+    lookup to executables compiled for a specific device layout (the mesh
+    shape of a shard_mapped kernel); it never reaches the observatory, so
+    kernel telemetry stays a pure function of the dispatched shapes."""
     acc = _ACC.get()
     if acc is None and kernel is None:
         return fn(*args)
     sig = kobs.shape_signature(args) if kernel is not None else None
-    aexe = aotrt.lookup(kernel, sig)
+    aexe = aotrt.lookup(kernel, sig, aot_scope)
     stack = _NEST.get()
     if stack is None:
         stack = []
@@ -104,7 +107,10 @@ def dispatch(fn, *args, kernel: Optional[str] = None):
                 out = aexe(*args)
                 served_aot = True
             except Exception as e:  # noqa: BLE001 — degrade to JIT, never fail
-                aotrt.discard(kernel, sig, error=f"{type(e).__name__}: {e}")
+                aotrt.discard(
+                    kernel, sig,
+                    error=f"{type(e).__name__}: {e}", scope=aot_scope,
+                )
         if not served_aot:
             before = _cache_size(fn)
             out = fn(*args)

@@ -428,10 +428,10 @@ class TestOffLadder:
         fired = []
         aotrt.on_off_ladder(lambda k, s: fired.append((k, s)), key="spec")
         ctr = global_registry.get("karpenter_aot_offladder_dispatches_total")
-        base = ctr.value({"kernel": "spec.k"})
+        base = ctr.value({"kernel": "spec.k", "mesh": ""})
         aotrt.note_off_ladder("spec.k", "1024x8")
         aotrt.note_off_ladder("spec.k", "1024x8")
-        assert ctr.value({"kernel": "spec.k"}) == base + 2
+        assert ctr.value({"kernel": "spec.k", "mesh": ""}) == base + 2
         assert fired == [("spec.k", "1024x8")] * 2
         assert aotrt.stats()["off_ladder_dispatches"] == 2
 

@@ -621,8 +621,12 @@ def run_case(
     cluster: bool = False,
     strict: bool = False,
     best_effort: bool = False,
+    mesh_devices: int = 0,
 ):
-    """Returns (host_decisions, device_decisions, device_ran)."""
+    """Returns (host_decisions, device_decisions, device_ran). With
+    `mesh_devices` >= 1 the device engine carries an N-device mesh, so the
+    sweep runs through the `_sharded` kernels — the host oracle must still
+    match bit-for-bit at every mesh size."""
     reserved = reserved or strict
     pools, nodes, bound, ds_pods, build_pods = build_case(
         seed, topo, reserved, cluster, best_effort
@@ -653,8 +657,17 @@ def run_case(
     old_strict = ffd.STRICT
     ffd.STRICT = True
     ncmod._hostname_counter = itertools.count(1)
+    mesh = None
+    if mesh_devices:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:mesh_devices]), ("pods",))
     try:
-        dev = decisions(env(CatalogEngine(catalog)).schedule(build_pods()))
+        dev = decisions(
+            env(CatalogEngine(catalog, mesh=mesh)).schedule(build_pods())
+        )
     finally:
         ffd.STRICT = old_strict
     return host, dev, ffd.DEVICE_SOLVES > solves0
@@ -770,6 +783,24 @@ class TestDeviceParity:
         _, _, ran = run_case(12345)
         assert ran
 
+    @pytest.mark.parametrize("seed", range(10))
+    @pytest.mark.parametrize("mesh_devices", [1, 8])
+    def test_mesh_sharded_decision_parity(self, seed, mesh_devices):
+        """The sweep shard_mapped over a device mesh (pod axis sharded,
+        catalog replicated) must match the host oracle at EVERY mesh size —
+        a 1-device mesh included (bit-identity with the unsharded path)."""
+        host, dev, ran = run_case(seed, mesh_devices=mesh_devices)
+        assert host == dev
+        assert ran, "mesh device path unexpectedly fell back"
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_mesh_with_topology_decision_parity(self, seed):
+        """Topology-engaged solves (count-tensor gates) with the cube
+        sharded over the full 8-device mesh."""
+        host, dev, ran = run_case(seed, topo=True, mesh_devices=8)
+        assert host == dev
+        assert ran, "mesh+topo device path unexpectedly fell back"
+
 
 def main(
     n_cases: int,
@@ -778,6 +809,7 @@ def main(
     cluster: bool = False,
     strict: bool = False,
     best_effort: bool = False,
+    mesh_devices: int = 0,
 ) -> int:
     failures = 0
     fallbacks = 0
@@ -793,8 +825,13 @@ def main(
         else "topo" if topo else "reserved" if reserved else
         "cluster" if cluster else "plain"
     )
+    if mesh_devices:
+        label = f"{label}@mesh{mesh_devices}"
     for seed in range(n_cases):
-        host, dev, ran = run_case(seed, topo, reserved, cluster, strict, best_effort)
+        host, dev, ran = run_case(
+            seed, topo, reserved, cluster, strict, best_effort,
+            mesh_devices=mesh_devices,
+        )
         if host != dev:
             failures += 1
             print(f"{label} seed {seed}: DIVERGED")
@@ -828,6 +865,12 @@ if __name__ == "__main__":
         rc |= main(n, strict=True)
     if mode in ("besteffort", "all"):
         rc |= main(n, best_effort=True)
+    if mode in ("mesh", "all"):
+        # host-oracle identity at every mesh size, padding edges included
+        for devices in (1, 2, 3, 8):
+            rc |= main(n, mesh_devices=devices)
+    if mode in ("meshtopo", "all"):
+        rc |= main(n, topo=True, mesh_devices=8)
     if mode in ("betopo", "all"):
         rc |= main(n, topo=True, best_effort=True)
     sys.exit(rc)

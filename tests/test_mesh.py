@@ -1,0 +1,449 @@
+"""Mesh-sharded serving solves: pod-axis shard math edge cases (pod counts
+not divisible by the mesh, entirely-padding shards, 1-device bit-identity),
+segment-reduction merges of per-shard count tensors vs the host
+TopologyGroup oracle, mesh-aware AOT (warm start on a mesh engine, the
+mesh-labelled off-ladder guard for mis-sized ladders, mesh-scoped cache
+keys), and the --shard-devices option/daemon wiring."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from karpenter_tpu import aot
+from karpenter_tpu.aot import compiler as aotc
+from karpenter_tpu.aot import ladder as lmod
+from karpenter_tpu.aot import runtime as aotrt
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.instance_types import (
+    construct_instance_types,
+)
+from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.observability import kernels as kobs
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.ops import catalog as catmod
+from karpenter_tpu.ops import topo_counts as tc
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.ops.packer import (
+    GroupSolver,
+    encode_pods_for_packer,
+    merge_shard_group_counts,
+    mesh_scope,
+)
+from karpenter_tpu.scheduling.requirements import (
+    Operator,
+    Requirement,
+    Requirements,
+)
+
+
+def make_mesh(n: int) -> Mesh:
+    return Mesh(np.array(jax.devices("cpu")[:n]), ("pods",))
+
+
+@pytest.fixture(scope="module")
+def workload():
+    """A shape-diverse 500-pod batch against the kwok catalog."""
+    catalog = construct_instance_types()
+    probe = CatalogEngine(catalog)
+    rng = np.random.RandomState(3)
+    zones = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+    shapes = []
+    for i in range(20):
+        reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        if i % 2:
+            reqs.add(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+        if i % 3 == 0:
+            reqs.add(
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [zones[i % 4]])
+            )
+        shapes.append(reqs)
+    picks = rng.randint(len(shapes), size=500)
+    reqs_list = [shapes[i] for i in picks]
+    requests = np.zeros((500, len(probe.resource_dims)))
+    requests[:, probe.resource_dims[wk.RESOURCE_CPU]] = rng.choice(
+        [0.1, 0.5, 1.0, 2.0], size=500
+    )
+    requests[:, probe.resource_dims[wk.RESOURCE_MEMORY]] = (
+        rng.choice([128, 512, 1024], size=500) * 2**20
+    )
+    requests[:, probe.resource_dims[wk.RESOURCE_PODS]] = 1.0
+    return catalog, shapes, reqs_list, requests
+
+
+def solve_with(catalog, reqs_list, requests, mesh):
+    engine = CatalogEngine(catalog, mesh=mesh)
+    grouped = encode_pods_for_packer(engine, reqs_list, requests)
+    return grouped, GroupSolver(engine).solve(grouped)
+
+
+@pytest.fixture
+def clean_aot():
+    reg = kobs.registry()
+    reg.reset()
+    aotrt.clear_executables()
+    aotrt.reset_off_ladder()
+    yield
+    aotrt.configure(None, None)
+    aotrt.clear_executables()
+    aotrt.reset_off_ladder()
+    reg.reset()
+
+
+class TestShardMath:
+    def test_group_count_not_divisible_by_mesh(self, workload):
+        """500 pods collapse to a group count no mesh size divides; the
+        padding remainder must be invisible in every returned array."""
+        catalog, shapes, reqs_list, requests = workload
+        g0, base = solve_with(catalog, reqs_list, requests, None)
+        assert g0.membership.shape[0] % 8, "workload must exercise padding"
+        for n in (2, 3, 8):
+            g, out = solve_with(catalog, reqs_list, requests, make_mesh(n))
+            assert all(a.shape[0] == g.membership.shape[0] for a in out)
+            for a, b in zip(base, out):
+                np.testing.assert_array_equal(a, b)
+
+    def test_empty_shards_compute_only_zeros(self, workload):
+        """3 groups over 8 devices: five shards are pure padding; counts 0
+        pack to 0 nodes / 0 unschedulable, so totals match unsharded."""
+        catalog, shapes, reqs_list, requests = workload
+        small, sreq = reqs_list[:3], requests[:3]
+        _, base = solve_with(catalog, small, sreq, None)
+        _, out = solve_with(catalog, small, sreq, make_mesh(8))
+        for a, b in zip(base, out):
+            np.testing.assert_array_equal(a, b)
+        assert out[2].sum() == base[2].sum()  # nodes
+        assert out[3].sum() == base[3].sum()  # unschedulable
+
+    def test_one_device_mesh_bit_identical(self, workload):
+        catalog, shapes, reqs_list, requests = workload
+        _, base = solve_with(catalog, reqs_list, requests, None)
+        _, out = solve_with(catalog, reqs_list, requests, make_mesh(1))
+        for a, b in zip(base, out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_feasibility_cube_parity_across_mesh_sizes(self, workload):
+        """The serving sweep (CatalogEngine.feasibility) forced onto the
+        device must produce the identical cube at every mesh size."""
+        catalog, shapes, reqs_list, requests = workload
+        old = catmod.FORCE_BACKEND
+        catmod.FORCE_BACKEND = "device"
+        try:
+            eng0 = CatalogEngine(catalog)
+            rows0 = [eng0.rows_for(r) for r in shapes]
+            zero = np.zeros((len(shapes), len(eng0.resource_dims)))
+            f0 = eng0.feasibility(rows0, zero, eng0.key_presence(shapes))
+            for n in (1, 3, 8):
+                eng = CatalogEngine(catalog, mesh=make_mesh(n))
+                rows = [eng.rows_for(r) for r in shapes]
+                f = eng.feasibility(rows, zero, eng.key_presence(shapes))
+                np.testing.assert_array_equal(f0.feasible, f.feasible)
+        finally:
+            catmod.FORCE_BACKEND = old
+
+    def test_sharded_global_shape_is_mesh_size_invariant(
+        self, workload, clean_aot
+    ):
+        """The digest contract behind the mesh-smoke CI job: mesh sizes 1
+        and 8 dispatch the SAME padded global shapes under the SAME kernel
+        names — the mesh changes how a shape splits, never what it is."""
+        catalog, shapes, reqs_list, requests = workload
+        reg = kobs.registry()
+        old = catmod.FORCE_BACKEND
+        catmod.FORCE_BACKEND = "device"
+        try:
+            sigs = {}
+            for n in (1, 8):
+                reg.reset()
+                eng = CatalogEngine(catalog, mesh=make_mesh(n))
+                rows = [eng.rows_for(r) for r in shapes]
+                eng.feasibility(
+                    rows,
+                    np.zeros((len(shapes), len(eng.resource_dims))),
+                    eng.key_presence(shapes),
+                )
+                grouped = encode_pods_for_packer(eng, reqs_list, requests)
+                GroupSolver(eng).solve(grouped)
+                snap = reg.counts_snapshot()
+                sigs[n] = {
+                    k: sorted(snap[k]["shapes"])
+                    for k in (
+                        "feasibility.cube_sharded",
+                        "packer.solve_block_sharded",
+                    )
+                }
+            assert sigs[1] == sigs[8], sigs
+        finally:
+            catmod.FORCE_BACKEND = old
+
+    def test_mesh_multiple_alignment(self):
+        assert lmod.mesh_multiple(1) == 8
+        assert lmod.mesh_multiple(2) == 8
+        assert lmod.mesh_multiple(8) == 8
+        assert lmod.mesh_multiple(3) == 24
+        assert lmod.mesh_multiple(16) == 16
+
+
+class TestSegmentMerge:
+    def test_merge_matches_concatenated_scatter(self):
+        rng = np.random.RandomState(5)
+        num_groups = 37
+        shards = [rng.randint(0, num_groups, size=rng.randint(0, 40))
+                  for _ in range(8)]
+        merged = merge_shard_group_counts(shards, num_groups)
+        oracle = np.zeros(num_groups, dtype=np.int64)
+        np.add.at(oracle, np.concatenate(shards).astype(np.int64), 1)
+        np.testing.assert_array_equal(merged, oracle)
+
+    def test_merge_masks_padding_rows(self):
+        """Ids at/past num_groups are the mesh-alignment remainder: they
+        must never leak into counts (or, downstream, into claims)."""
+        merged = merge_shard_group_counts(
+            [np.array([0, 1, 5, 6]), np.array([1, 7, -1])], 5
+        )
+        np.testing.assert_array_equal(merged, [1, 2, 0, 0, 0])
+
+    def test_merge_with_amounts_and_empty_shard(self):
+        merged = merge_shard_group_counts(
+            [np.array([0, 2]), np.array([], dtype=np.int64), np.array([2])],
+            3,
+            shard_amounts=[np.array([3, 1]), np.array([]), np.array([4])],
+        )
+        np.testing.assert_array_equal(merged, [3, 0, 5])
+
+    def test_record_shards_matches_topology_group_oracle(self):
+        """Per-shard domain batches merged by segment reduction must leave
+        the count tensor bit-identical to the host TopologyGroup walked
+        domain-by-domain over the flattened stream."""
+        from karpenter_tpu.apis.core import LabelSelector, ObjectMeta, Pod, PodSpec
+        from karpenter_tpu.scheduler.topology import (
+            TYPE_SPREAD,
+            TopologyDomainGroup,
+            TopologyGroup,
+        )
+
+        rng = np.random.RandomState(11)
+        domains = [f"z{i}" for i in range(6)]
+
+        def fresh_group():
+            dg = TopologyDomainGroup()
+            for d in domains:
+                dg.insert(d, [])
+            pod = Pod(
+                metadata=ObjectMeta(name="p", uid="uid-p", labels={"app": "a"}),
+                spec=PodSpec(),
+            )
+            return TopologyGroup(
+                TYPE_SPREAD,
+                wk.LABEL_TOPOLOGY_ZONE,
+                pod,
+                {"default"},
+                LabelSelector(match_labels={"app": "a"}),
+                1,
+                None,
+                None,
+                None,
+                dg,
+            )
+
+        shard_batches = [
+            [domains[rng.randint(6)] for _ in range(rng.randint(0, 12))]
+            for _ in range(8)
+        ]
+        # oracle: the host dict walked sequentially over the flat stream
+        oracle_tg = fresh_group()
+        for batch in shard_batches:
+            for d in batch:
+                oracle_tg.record(d)
+        oracle = tc.GroupCounts(oracle_tg)
+
+        tg = fresh_group()
+        gc = tc.GroupCounts(tg)
+        gc.record_shards(shard_batches)
+        assert tg.domains == oracle_tg.domains
+        assert gc.synced_gen == tg._gen
+        np.testing.assert_array_equal(gc.tensor(), oracle.tensor())
+        for d in domains:
+            assert gc.count(d) == oracle.count(d)
+
+    def test_merge_shard_counts_dense(self):
+        out = tc.merge_shard_counts(
+            [np.array([0, 0, 3]), np.array([3, 99, -2])], 4
+        )
+        np.testing.assert_array_equal(out, [2, 0, 0, 2])
+
+
+class TestMeshAOT:
+    def test_bucket_for_multiple_of(self):
+        lad = lmod.make({"k": [(8, 4), (12, 4), (64, 4)]})
+        assert lad.bucket_for("k", (5, 2), multiple_of=4) == (8, 4)
+        assert lad.bucket_for("k", (9, 2), multiple_of=8) == (64, 4)
+        assert lad.bucket_for("k", (9, 2), multiple_of=3) == (12, 4)
+        assert lad.bucket_for("k", (65, 2), multiple_of=8) is None
+
+    def test_default_ladder_sharded_rungs_align(self):
+        for kernel in ("feasibility.cube_sharded", "packer.solve_block_sharded"):
+            buckets = lmod.DEFAULT.buckets(kernel)
+            assert buckets, kernel
+            assert all(b[0] % lmod.MESH_ALIGN == 0 for b in buckets), kernel
+
+    def test_mesh_folds_into_cache_key(self):
+        base = aotc.cache_key("h", "feasibility.cube_sharded", "8x4", 1)
+        m1 = aotc.cache_key(
+            "h", "feasibility.cube_sharded", "8x4", 1, scope="mesh=1:pods"
+        )
+        m8 = aotc.cache_key(
+            "h", "feasibility.cube_sharded", "8x4", 1, scope="mesh=8:pods"
+        )
+        assert len({base, m1, m8}) == 3
+
+    def test_scoped_executable_table(self):
+        aotrt.install("k", "8x4", "exe-one", scope="mesh=1:pods")
+        try:
+            assert aotrt.lookup("k", "8x4", "mesh=1:pods") == "exe-one"
+            assert aotrt.lookup("k", "8x4", "mesh=8:pods") is None
+            assert aotrt.lookup("k", "8x4") is None
+        finally:
+            aotrt.discard("k", "8x4", scope="mesh=1:pods")
+
+    def test_warm_start_mesh_engine_prepays_sharded_executables(
+        self, workload, clean_aot
+    ):
+        """warm_start on a mesh engine walks the `_sharded` twin plans,
+        installs mesh-scoped executables, and a forced-device serving
+        sweep is then SERVED from the table (0 compiles post-seal)."""
+        catalog, shapes, reqs_list, requests = workload
+        mesh = make_mesh(8)
+        aotrt.configure(lmod.DEFAULT, None)
+        engine = CatalogEngine(catalog, mesh=mesh)
+        summary = aot.warm_start(engine)
+        assert summary is not None and summary["buckets"] > 0
+        assert summary["errors"] == 0
+        scope = mesh_scope(mesh)
+        scoped = [e for e in aotrt.executables() if e.get("scope") == scope]
+        assert any(
+            e["kernel"] == "feasibility.cube_sharded" for e in scoped
+        ), scoped
+        assert any(
+            e["kernel"] == "packer.solve_block_sharded" for e in scoped
+        ), scoped
+
+        reg = kobs.registry()
+        reg.seal()
+        old = catmod.FORCE_BACKEND
+        catmod.FORCE_BACKEND = "device"
+        try:
+            rows = [engine.rows_for(r) for r in shapes]
+            engine.feasibility(
+                rows,
+                np.zeros((len(shapes), len(engine.resource_dims))),
+                engine.key_presence(shapes),
+            )
+        finally:
+            catmod.FORCE_BACKEND = old
+        snap = reg.debug_snapshot("feasibility.cube_sharded")
+        assert snap["aot_served"] >= 1, snap
+        assert reg.steady_recompiles() == 0, reg.debug_snapshot()
+
+    def test_mis_sized_ladder_warns_with_mesh_label(self, workload, clean_aot):
+        """A ladder whose sharded rungs are too small for the sweep (or
+        indivisible by the mesh) must fire AOTOffLadderDispatch machinery —
+        counter + event with the mesh in the label — and fall back to
+        aligned pow2 padding, which recompiles ONCE, not per pass."""
+        catalog, shapes, reqs_list, requests = workload
+        mesh = make_mesh(8)
+        tiny = lmod.make({"feasibility.cube_sharded": [(8, 4)]})
+        engine = CatalogEngine(catalog, mesh=mesh)
+        engine.aot_ladder = tiny
+        fired = []
+        aotrt.on_off_ladder(lambda k, s: fired.append((k, s)), key="spec")
+        ctr = global_registry.get("karpenter_aot_offladder_dispatches_total")
+        ctr_labels = {
+            "kernel": "feasibility.cube_sharded", "mesh": mesh_scope(mesh)
+        }
+        base_ctr = ctr.value(ctr_labels)
+
+        reg = kobs.registry()
+        old = catmod.FORCE_BACKEND
+        catmod.FORCE_BACKEND = "device"
+        try:
+            rows = [engine.rows_for(r) for r in shapes]
+            zero = np.zeros((len(shapes), len(engine.resource_dims)))
+            kp = engine.key_presence(shapes)
+            engine.feasibility(rows, zero, kp)
+            compiles_after_first = reg.debug_snapshot(
+                "feasibility.cube_sharded"
+            )["compiles"]
+            engine.feasibility(rows, zero, kp)  # second pass, same shapes
+        finally:
+            catmod.FORCE_BACKEND = old
+        cube_events = [
+            (k, s) for k, s in fired if k == "feasibility.cube_sharded"
+        ]
+        assert cube_events, f"off-ladder event never fired for the cube: {fired}"
+        kernel, shape = cube_events[0]
+        assert mesh_scope(mesh) in shape, shape
+        assert ctr.value(ctr_labels) >= base_ctr + 2
+        # warned, not silently recompiling per pass: the second identical
+        # sweep reuses the pow2-aligned executable
+        snap = reg.debug_snapshot("feasibility.cube_sharded")
+        assert snap["compiles"] == compiles_after_first, snap
+
+
+class TestWiring:
+    def test_shard_devices_flag_and_aliases(self):
+        assert Options.parse(["--shard-devices", "4"]).solver_pod_shard_axis == 4
+        assert Options.parse(["--mesh", "2"]).solver_pod_shard_axis == 2
+        assert (
+            Options.parse(["--solver-pod-shard-axis", "8"]).solver_pod_shard_axis
+            == 8
+        )
+        assert Options.parse([]).solver_pod_shard_axis == 0
+
+    def test_shard_devices_env(self):
+        opts = Options.parse([], env={"SHARD_DEVICES": "8"})
+        assert opts.solver_pod_shard_axis == 8
+        # the flag wins over the env
+        opts = Options.parse(["--shard-devices", "2"], env={"SHARD_DEVICES": "8"})
+        assert opts.solver_pod_shard_axis == 2
+
+    def test_build_solver_mesh_semantics(self):
+        from karpenter_tpu.controllers.provisioning.provisioner import (
+            _build_solver_mesh,
+        )
+
+        assert _build_solver_mesh(0) is None
+        one = _build_solver_mesh(1)
+        assert one is not None and int(np.prod(one.devices.shape)) == 1
+        eight = _build_solver_mesh(8)
+        assert eight is not None and int(np.prod(eight.devices.shape)) == 8
+        assert _build_solver_mesh(4096) is None  # shortfall: warn + degrade
+
+    def test_default_engine_factory_attaches_mesh(self, workload):
+        from karpenter_tpu.controllers.provisioning.provisioner import (
+            default_engine_factory,
+        )
+
+        catalog, *_ = workload
+        engine = default_engine_factory(shard_devices=8)({"np": catalog})
+        assert engine is not None and engine.mesh is not None
+        assert int(np.prod(engine.mesh.devices.shape)) == 8
+        plain = default_engine_factory()({"np": catalog})
+        assert plain is not None and plain.mesh is None
+
+    def test_daemon_engine_factory_attaches_mesh(self, workload):
+        from karpenter_tpu.solverd.transport import _default_engine_factory
+
+        catalog, *_ = workload
+        engine = _default_engine_factory(shard_devices=2)(list(catalog))
+        assert engine.mesh is not None
+        assert int(np.prod(engine.mesh.devices.shape)) == 2
+        assert _default_engine_factory()(list(catalog)).mesh is None
+
+    def test_group_solver_inherits_engine_mesh(self, workload):
+        catalog, *_ = workload
+        mesh = make_mesh(2)
+        engine = CatalogEngine(catalog, mesh=mesh)
+        assert GroupSolver(engine).mesh is mesh
+        assert GroupSolver(CatalogEngine(catalog)).mesh is None
