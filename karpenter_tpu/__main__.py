@@ -45,6 +45,8 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             trace_snapshot=operator.trace_snapshot,
             heap_stats=operator.heap_stats,
             kernel_snapshot=operator.kernel_snapshot,
+            slo_snapshot=operator.slo_snapshot,
+            flight_snapshot=operator.flight_snapshot,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())
@@ -62,9 +64,28 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
         log.info("shutdown requested", signal=signum)
         stop["requested"] = True
 
+    def _sigquit(signum, frame):
+        # the blackbox hotkey: dump the flight ring as a postmortem bundle
+        # without stopping the operator (kill -QUIT <pid>), like a JVM
+        # thread dump — the recorder's cooldown keeps repeats cheap.
+        # lock_timeout: the handler runs ON the main thread, which may be
+        # suspended inside record() holding the recorder lock — a blocking
+        # acquire would deadlock the whole operator; bounded, the dump is
+        # simply skipped and the loop resumes
+        bundle = operator.flight.dump("sigquit", cooldown=0.0, lock_timeout=1.0)
+        if bundle is not None:
+            log.info(
+                "flight bundle dumped",
+                bundle=bundle["name"],
+                path=bundle.get("path"),
+                frames=bundle["frames"],
+            )
+
     try:
         signal.signal(signal.SIGINT, _signal)
         signal.signal(signal.SIGTERM, _signal)
+        if hasattr(signal, "SIGQUIT"):
+            signal.signal(signal.SIGQUIT, _sigquit)
     except ValueError:
         pass  # not the main thread (tests)
 
@@ -82,6 +103,12 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             operator.run_once()
         except Exception:  # noqa: BLE001 — the loop must survive
             log.error("reconcile pass failed", exc_info=True)
+            # preserve the evidence: the last N passes of system state at
+            # the moment the loop blew up, before retrying clobbers it
+            try:
+                operator.flight.dump("operator-crash")
+            except Exception:  # noqa: BLE001 — the dump must not re-crash the loop
+                pass
         passes += 1
         if max_passes is not None and passes >= max_passes:
             break

@@ -137,19 +137,32 @@ class Controller:
 
         # candidate bases shared by this pass's methods (helpers.get_candidates)
         pass_cache: dict = {}
+        from karpenter_tpu.observability import slo
+
+        tenant = getattr(self.provisioner.options, "cluster_name", "")
         for method in self.methods:
             try:
                 if self._disrupt(method, pass_cache):
+                    slo.engine().record(
+                        "solverd-availability", good=1, tenant=tenant
+                    )
                     return True
             except (SolverRejection, TransportError) as e:
                 # The solver shed our simulations (or the sidecar is down):
                 # disruption is deferrable by definition — back off for a
                 # polling period instead of crashing the operator loop.
+                slo.engine().record(
+                    "solverd-availability", bad=1, tenant=tenant
+                )
                 _log.warning(
                     "disruption evaluation shed by solver; backing off",
                     method=method.reason(), error=type(e).__name__,
                 )
                 break
+        else:
+            # the whole evaluation ran without a shed: one good event on
+            # the availability objective (the burn-rate denominator)
+            slo.engine().record("solverd-availability", good=1, tenant=tenant)
         self._next_run = self.clock.now() + POLLING_PERIOD
         return False
 

@@ -52,6 +52,15 @@ _CONSOLIDATION_TIMEOUTS = global_registry.counter(
 MAX_PARALLEL_CONSOLIDATION = 100  # multinodeconsolidation.go:85-87
 
 
+def _slo_deadline(good: int = 0, bad: int = 0) -> None:
+    """Consolidation-deadline SLO feed: a computation that finished inside
+    its timeout is good, one that hit the deadline is bad (zero-tolerance
+    objective — any deadline hit is a breach)."""
+    from karpenter_tpu.observability import slo
+
+    slo.engine().record("consolidation-deadline", good=good, bad=bad)
+
+
 def _frontier_depth(c: Consolidation) -> int:
     """The configured speculation depth (--consolidation-frontier-depth),
     floored at 1 — depth 1 IS the sequential probe order, still riding the
@@ -295,6 +304,7 @@ class MultiNodeConsolidation:
             # like the sequential loop's per-probe check
             if self.c.clock.now() > deadline:
                 _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "multi"})
+                _slo_deadline(bad=1)
                 if rounds:
                     _FRONTIER_ROUNDS.observe(
                         float(rounds), {"consolidation_type": "multi"}
@@ -332,6 +342,7 @@ class MultiNodeConsolidation:
                 else:
                     hi_n = mid - 1
         _FRONTIER_ROUNDS.observe(float(rounds), {"consolidation_type": "multi"})
+        _slo_deadline(good=1)
         return last_saved
 
     def _probe_verdict(self, plan, candidates, mid, prices) -> Command:
@@ -387,6 +398,7 @@ class MultiNodeConsolidation:
         while lo_n <= hi_n:
             if self.c.clock.now() > deadline:
                 _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "multi"})
+                _slo_deadline(bad=1)
                 return last_saved
             mid = (lo_n + hi_n) // 2
             prefix = candidates[: mid + 1]
@@ -403,6 +415,7 @@ class MultiNodeConsolidation:
                 lo_n = mid + 1
             else:
                 hi_n = mid - 1
+        _slo_deadline(good=1)
         return last_saved
 
 
@@ -532,6 +545,7 @@ class SingleNodeConsolidation:
             for i, candidate in enumerate(candidates):
                 if self.c.clock.now() > deadline:
                     _CONSOLIDATION_TIMEOUTS.inc({"consolidation_type": "single"})
+                    _slo_deadline(bad=1)
                     self.previously_unseen_nodepools = unseen
                     return Command()
                 unseen.discard(candidate.node_pool.metadata.name)
@@ -552,10 +566,12 @@ class SingleNodeConsolidation:
                 if cmd.decision() == DECISION_NOOP:
                     continue
                 # Unvalidated: two-phase validation happens in the controller.
+                _slo_deadline(good=1)
                 return cmd
             if not constrained:
                 self.c.mark_consolidated()
             self.previously_unseen_nodepools = unseen
+            _slo_deadline(good=1)
             return Command()
         finally:
             if rounds:

@@ -147,6 +147,13 @@ def default_engine_factory(shard_devices: int = 0):
             )
             engine = _ENGINE_CONTENT_CACHE.get(content_key)
             if engine is None:
+                # fresh engine build = prior per-device gauge samples are
+                # stale (they describe evicted engines); clear the family
+                # so /metrics never serves dead allocations — the first
+                # solve batch (and the daemon rebuild path) resamples
+                from karpenter_tpu.observability import kernels as kobs
+
+                kobs.reset_device_memory()
                 engine = CatalogEngine(
                     all_types, mesh=_build_solver_mesh(shard_devices)
                 )
@@ -222,8 +229,16 @@ class Provisioner:
         with tracing.tracer().span(
             "provisioner.batch", parent=None, triggered=len(pending_since)
         ) as batch_span:
+            from karpenter_tpu.observability import slo
+
             try:
                 results = self.schedule(pending_since=pending_since)
+                # SLO feed: the solve was executed, not shed — one good
+                # event on the operator-visible availability objective
+                slo.engine().record(
+                    "solverd-availability", good=1,
+                    tenant=self.options.cluster_name,
+                )
                 if results is not None and not getattr(
                     self, "_kernels_sealed", False
                 ):
@@ -240,10 +255,18 @@ class Provisioner:
                 # Shed/unreachable solver: degrade, don't crash the loop. The
                 # operator re-triggers every provisionable pod each pass, so
                 # the batch re-forms and retries on its own.
+                slo.engine().record(
+                    "solverd-availability", bad=1,
+                    tenant=self.options.cluster_name,
+                )
                 batch_span.fail(e)
+                # NOTE: `message=` would collide with the logger's own
+                # positional message parameter and raise TypeError out of
+                # the except block — turning graceful degradation into a
+                # harness-counted reconcile failure
                 _log.warning(
                     "solve shed; will retry next batch",
-                    error=type(e).__name__, message=str(e),
+                    error=f"{type(e).__name__}: {e}",
                 )
                 return None
             if results is None or not results.new_node_claims:

@@ -80,6 +80,13 @@ class Gauge(Metric):
     def delete(self, labels: Optional[dict[str, str]] = None) -> None:
         self._values.pop(_label_key(labels or {}), None)
 
+    def clear(self) -> None:
+        """Drop every series of this gauge family atomically — the reset
+        path for families whose label sets describe evicted objects (e.g.
+        per-device memory after an engine rebuild)."""
+        with _LOCK:
+            self._values.clear()
+
     def value(self, labels: Optional[dict[str, str]] = None) -> float:
         return self._values.get(_label_key(labels or {}), 0.0)
 

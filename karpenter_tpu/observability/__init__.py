@@ -1,7 +1,13 @@
-"""Device observability: the kernel observatory (kernels.py).
+"""Observability: the kernel observatory (kernels.py), the SLO burn-rate
+engine (slo.py), and the flight recorder (flight.py).
 
 Where tracing/ answers "where did this request's time go", this package
-answers "what is the device itself doing" — per-kernel compile/execute
-accounting, shape-bucket telemetry, device memory, and the zero-recompile
-steady-state contract.
+answers the other operational questions: kernels.py — "what is the device
+itself doing" (per-kernel compile/execute accounting, shape-bucket
+telemetry, device memory, the zero-recompile steady-state contract);
+slo.py — "are we meeting our objectives, and how fast is the error budget
+burning" (declarative specs, multiwindow burn rates, per-tenant
+attribution, typed breaches); flight.py — "what did the system look like
+when it broke" (a bounded ring of per-pass snapshots, dumped as a
+digest-stamped postmortem bundle on breach/crash/SIGQUIT).
 """

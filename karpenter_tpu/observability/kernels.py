@@ -443,6 +443,19 @@ def registry() -> KernelRegistry:
     return _REGISTRY
 
 
+def reset_device_memory() -> None:
+    """Engines were evicted or are being rebuilt: the per-device gauge
+    series were sampled against the OLD engine's allocations and would
+    otherwise persist as stale values until the next solve batch happens
+    to resample them (PR 6 sampled per batch but never cleared). Drop the
+    whole family and the cached /debug/kernels view; the first post-rebuild
+    batch resamples fresh."""
+    _DEVICE_MEM.clear()
+    _LIVE_BYTES.set(0.0)
+    with _REGISTRY._lock:
+        _REGISTRY._last_memory = None
+
+
 def sample_device_memory() -> dict:
     """Live-array bytes + per-device allocator stats, pushed into the
     gauges and cached on the registry for /debug/kernels. Sampled after

@@ -86,6 +86,28 @@ class Operator:
         from karpenter_tpu import aot
 
         aot.configure_from_options(self.options)
+        # SLO engine + flight recorder (observability/slo.py, flight.py):
+        # the process-global burn-rate evaluator follows this operator's
+        # clock and objective set; the blackbox follows its clock and
+        # --flight-dir. Breaches publish a typed SLOBreach Warning event
+        # and ask the recorder for a postmortem bundle. Sources and
+        # subscribers use keyed-replace semantics, so rebuilding an
+        # Operator (tests, sims, HA standbys) swaps slots cleanly.
+        from karpenter_tpu.observability import flight as flightmod
+        from karpenter_tpu.observability import slo as slomod
+
+        self.slo = slomod.configure(
+            clock=self.clock, specs=slomod.load_specs(self.options.slo_specs)
+        )
+        self.flight = flightmod.configure(
+            clock=self.clock,
+            capacity=self.options.flight_capacity,
+            flight_dir=self.options.flight_dir,
+        )
+        self._flight_cell = f"cell:{self.options.cluster_name or 'operator'}"
+        self.slo.subscribe(
+            self._on_slo_breach, key=f"operator:{self.options.cluster_name}"
+        )
         # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
         # here it bounds the solver's interning/memo caches. The caps are
         # process-global, so only an EXPLICIT setting mutates them: -1 (the
@@ -165,7 +187,10 @@ class Operator:
         self.np_readiness = ReadinessController(store, self.clock)
         self.np_registration_health = RegistrationHealthController(store, self.clock)
         self.np_validation = ValidationController(store, self.clock)
-        self.binding = BindingController(store, self.cluster, self.clock, self.recorder)
+        self.binding = BindingController(
+            store, self.cluster, self.clock, self.recorder,
+            tenant=self.options.cluster_name,
+        )
         self.overlay_validation = None
         if self.options.feature_gates.node_overlay:
             from karpenter_tpu.controllers.nodeoverlay import (
@@ -245,6 +270,28 @@ class Operator:
         self.r_nodepool_metrics = reg("metrics.nodepool", self.nodepool_metrics.reconcile)
         self.r_condition_metrics = reg("metrics.status", self.condition_metrics.reconcile)
 
+        # flight-recorder sources: this cell's health/queue/breaker/SLO
+        # view, the process-wide kernel-registry deltas, and the active
+        # span summaries — every pass snapshots them all into one frame
+        from karpenter_tpu.observability import kernels as kobs
+
+        self.flight.register_source(self._flight_cell, self._flight_source)
+        self.flight.register_source("kernels", _kernel_delta_source())
+        self.flight.register_source(
+            "spans",
+            lambda: {"recent_traces": _span_summaries()},
+        )
+        # the steady-recompile SLO feed: every post-seal compile is one bad
+        # event on the zero-tolerance objective (keyed alongside the
+        # provisioner's KernelRecompiled event callback). The closure
+        # captures the ENGINE, not this operator — the registry slot must
+        # not pin a retired Operator's object graph alive.
+        slo_engine = self.slo
+        kobs.registry().on_recompile(
+            lambda kernel, shape: slo_engine.record("steady-recompiles", bad=1),
+            key="slo",
+        )
+
     # -- the loop -----------------------------------------------------------
 
     def run_once(self) -> dict:
@@ -275,6 +322,7 @@ class Operator:
             # a standby pass is supposed to do
             self.harness.note_pass()
             self._refresh_solver_health()
+            self._observe_pass()
             return summary
         if not getattr(self, "_was_leader", False):
             # just took over (or first pass): events dropped while standing
@@ -324,7 +372,20 @@ class Operator:
         self.r_condition_metrics()
         self.harness.note_pass()
         self._refresh_solver_health()
+        self._observe_pass()
         return summary
+
+    def _observe_pass(self) -> None:
+        """Per-pass observability epilogue: evaluate every SLO objective's
+        burn rates at the pass boundary (edge-triggered breaches fire their
+        subscribers here) and capture one flight-recorder frame — the
+        always-on blackbox. Both are clock-driven and deterministic under
+        FakeClock; neither may fail the pass."""
+        try:
+            self.slo.evaluate()
+            self.flight.record(f"pass:{self.options.cluster_name or 'operator'}")
+        except Exception:  # noqa: BLE001 — observability never breaks the loop
+            pass
 
     def _provision(self):
         """One provisioning reconcile: re-trigger every provisionable pod
@@ -419,10 +480,17 @@ class Operator:
     def shutdown(self) -> None:
         """Clean shutdown: release the leader lease so a standby replica
         takes over immediately instead of waiting out the lease duration,
-        and close the solver client (fails queued solves with typed
-        rejections instead of stranding their waiters)."""
+        close the solver client (fails queued solves with typed rejections
+        instead of stranding their waiters), and release this operator's
+        slots in the process-global SLO engine and flight recorder — keyed
+        replace only covers a successor with the SAME name, so a
+        differently-named operator later in the process must not keep
+        snapshotting this retired cell into its frames (the "kernels" and
+        "spans" sources are operator-independent closures and stay)."""
         self.elector.release()
         self.provisioner.solver.close()
+        self.flight.unregister_source(self._flight_cell)
+        self.slo.unsubscribe(f"operator:{self.options.cluster_name}")
 
     # -- observability ------------------------------------------------------
 
@@ -470,6 +538,85 @@ class Operator:
             "traces": self.tracer.ring.summaries(limit),
             "journeys": self.tracer.journeys.stats(),
         }
+
+    def _on_slo_breach(self, breach) -> None:
+        """SLO breach subscriber: publish the typed Warning event and dump
+        a flight bundle (the recorder's per-trigger cooldown keeps a
+        burning objective from shedding one bundle per pass). Breaches for
+        other tenants' series are theirs to handle — aggregate ("") and
+        own-tenant breaches are this operator's."""
+        if breach.tenant and breach.tenant != self.options.cluster_name:
+            return
+        from karpenter_tpu.events.recorder import Event
+
+        self.recorder.publish(
+            Event(
+                None,
+                "Warning",
+                "SLOBreach",
+                f"objective {breach.objective} burning at "
+                f"{breach.burn_rate:.1f}x in its {breach.window} window "
+                f"(budget remaining {breach.budget_remaining:.3f}"
+                + (f", tenant {breach.tenant}" if breach.tenant else "")
+                + ")",
+                dedupe_values=(
+                    "slo-breach", breach.objective, breach.tenant, breach.window,
+                ),
+            )
+        )
+        self.flight.dump(
+            f"slo:{breach.objective}", context=breach.to_dict()
+        )
+
+    def _flight_source(self) -> dict:
+        """This cell's per-pass flight frame: harness health ledger,
+        breaker state, solverd reachability (cached — a frame must never
+        RPC a daemon), in-process admission-queue/tenant-quota state, the
+        fleet replica view when the pool client is wired, and the SLO burn
+        summary."""
+        out = {
+            "harness": self.harness.snapshot(),
+            "breaker": self.breaker.snapshot(),
+            "solverd": self._solver_health(),
+            "slo": {
+                "burning": self.slo.burning(),
+                "worst": self.slo.worst_burning(),
+                "hard_breached": self.slo.hard_breached(),
+            },
+        }
+        solver = self.provisioner.solver
+        service = getattr(solver, "service", None)
+        if service is not None and hasattr(service, "queue"):
+            out["admission_queue"] = {
+                "depth": service.queue.depth(),
+                "cap": service.queue.max_depth,
+                "tenant_quota": service.queue.tenant_quota,
+                "tenant_depths": service.queue.tenant_depths(),
+                "draining": service.draining,
+            }
+        if getattr(solver, "_replicas", None) is not None:
+            # fleet client: the client-side pool view is RPC-free by design
+            stats = solver.stats()
+            out["fleet"] = {
+                "replicas": stats.get("replicas", []),
+                "healthy_replicas": stats.get("healthy_replicas"),
+                "failovers": stats.get("failovers"),
+                "replays": stats.get("replays"),
+            }
+        return out
+
+    def slo_snapshot(
+        self, objective: Optional[str] = None, tenant: Optional[str] = None
+    ) -> Optional[dict]:
+        """/debug/slo (operator/serving.py): the objective table with
+        per-window burn rates and budget remaining, or one objective's
+        per-tenant drill-down. None => unknown objective (404)."""
+        return self.slo.snapshot(objective=objective, tenant=tenant)
+
+    def flight_snapshot(self, bundle: Optional[str] = None) -> Optional[dict]:
+        """/debug/flight (operator/serving.py): ring summary + bundle
+        listing, or one bundle's frames. None => unknown bundle (404)."""
+        return self.flight.snapshot(bundle=bundle)
 
     def healthy(self) -> bool:
         """Real liveness: degraded when any controller is failing
@@ -529,6 +676,10 @@ class Operator:
             reasons.append("solverd unreachable")
         if self.harness.stale():
             reasons.append("no successful reconcile pass recently")
+        for objective in self.slo.hard_breached():
+            reasons.append(
+                f"SLO availability objective {objective} in hard breach"
+            )
         return reasons
 
     def heap_stats(self) -> dict:
@@ -571,8 +722,59 @@ class Operator:
             "leader": getattr(self, "_was_leader", False),
             "cloud_provider_breaker": self.breaker.snapshot(),
             "solverd": solver_health,
+            # the SLO fold: worst-burning objective + its error budget, and
+            # any availability objective in hard breach (those also appear
+            # in degraded_reasons, turning the probe 503)
+            "slo": {
+                "worst_burning": self.slo.worst_burning(),
+                "hard_breached": self.slo.hard_breached(),
+            },
             **snap,
         }
+
+
+def _kernel_delta_source():
+    """Flight source: per-kernel dispatch-count deltas by phase since the
+    PREVIOUS frame — the kernel-registry movement each pass, not process
+    history (so same-seed sim runs record identical frames even when the
+    registry carries counts from earlier runs in the process)."""
+    from karpenter_tpu.observability import kernels as kobs
+
+    state = {"base": kobs.registry().counts_snapshot()}
+
+    def source() -> dict:
+        now = kobs.registry().counts_snapshot()
+        base = state["base"]
+        state["base"] = now
+        deltas: dict = {}
+        recompiles = 0
+        for name in sorted(now):
+            shapes = now[name]["shapes"]
+            base_shapes = base.get(name, {}).get("shapes", {})
+            totals: dict[str, int] = {}
+            for shape, phases in shapes.items():
+                b = base_shapes.get(shape, {})
+                for phase, count in phases.items():
+                    d = count - b.get(phase, 0)
+                    if d:
+                        totals[phase] = totals.get(phase, 0) + d
+            if totals:
+                deltas[name] = totals
+            recompiles += now[name]["recompiles"] - base.get(name, {}).get(
+                "recompiles", 0
+            )
+        return {"dispatch_deltas": deltas, "recompiles": recompiles}
+
+    return source
+
+
+def _span_summaries(limit: int = 5) -> list[dict]:
+    """Flight source: the most recent trace summaries from the CURRENT
+    process-global tracer (resolved per frame — a sim reconfigures the
+    tracer after the operator is built)."""
+    from karpenter_tpu import tracing
+
+    return tracing.tracer().ring.summaries(limit)
 
 
 def _obj_item(obj) -> str:

@@ -114,6 +114,16 @@ class Options:
     tracing_sample_rate: float = 1.0
     trace_buffer_size: int = 4096
 
+    # SLO burn-rate engine (observability/slo.py): which objective set the
+    # engine evaluates — "default"/"" = the built-in serving-path specs,
+    # "off" = disabled, anything else = a JSON spec file. The flight
+    # recorder (observability/flight.py) keeps the last flight_capacity
+    # per-pass snapshots and dumps breach bundles under flight_dir
+    # (empty = in-memory bundles only, still served at /debug/flight).
+    slo_specs: str = "default"
+    flight_dir: str = ""
+    flight_capacity: int = 64
+
     # reconciler harness (operator/harness.py): per-item exponential
     # backoff bounds for failing reconciles, and the cloud-provider circuit
     # breaker (consecutive retryable create/delete failures before opening;
@@ -173,6 +183,9 @@ class Options:
         parser.add_argument("--consolidation-frontier-depth", type=int)
         parser.add_argument("--compile-cache-dir")
         parser.add_argument("--aot-ladder")
+        parser.add_argument("--slo-specs")
+        parser.add_argument("--flight-dir")
+        parser.add_argument("--flight-capacity", type=int)
         parser.add_argument("--tracing-sample-rate", type=float)
         parser.add_argument("--trace-buffer-size", type=int)
         parser.add_argument("--requeue-base-delay", type=float)
@@ -200,6 +213,8 @@ class Options:
             "solverd_tenant_weights": "SOLVERD_TENANT_WEIGHTS",
             "compile_cache_dir": "COMPILE_CACHE_DIR",
             "aot_ladder": "AOT_LADDER",
+            "slo_specs": "SLO_SPECS",
+            "flight_dir": "FLIGHT_DIR",
         }
         for f in fields(cls):
             if f.name == "feature_gates":

@@ -31,6 +31,8 @@ class ServingConfig:
         trace_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         heap_stats: Optional[Callable[[], dict]] = None,
         kernel_snapshot: Optional[Callable[..., Optional[dict]]] = None,
+        slo_snapshot: Optional[Callable[..., Optional[dict]]] = None,
+        flight_snapshot: Optional[Callable[..., Optional[dict]]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -55,6 +57,14 @@ class ServingConfig:
         # serves the per-kernel compile/execute table, ?kernel= drill-down
         # into per-shape-bucket stats (404 when unknown); unwired => 404
         self.kernel_snapshot = kernel_snapshot
+        # SLO engine (operator.slo_snapshot): /debug/slo serves the
+        # objective table with burn rates and budget remaining,
+        # ?objective= drill-down (404 when unknown); unwired => 404
+        self.slo_snapshot = slo_snapshot
+        # flight recorder (operator.flight_snapshot): /debug/flight serves
+        # the ring summary + bundle listing, ?bundle= drill-down into one
+        # bundle's frames (404 when unknown); unwired => 404
+        self.flight_snapshot = flight_snapshot
 
 
 def _profile_sample(seconds: float, interval: float = 0.01) -> str:
@@ -238,6 +248,33 @@ class _Handler(BaseHTTPRequestHandler):
                 if snap is None:
                     self._respond(
                         404, json.dumps({"error": "unknown kernel"}),
+                        "application/json",
+                    )
+                else:
+                    self._respond(200, json.dumps(snap), "application/json")
+            elif url.path == "/debug/slo" and cfg.slo_snapshot is not None:
+                import json
+
+                q = parse_qs(url.query)
+                snap = cfg.slo_snapshot(
+                    objective=q.get("objective", [None])[0],
+                    tenant=q.get("tenant", [None])[0],
+                )
+                if snap is None:
+                    self._respond(
+                        404, json.dumps({"error": "unknown objective"}),
+                        "application/json",
+                    )
+                else:
+                    self._respond(200, json.dumps(snap), "application/json")
+            elif url.path == "/debug/flight" and cfg.flight_snapshot is not None:
+                import json
+
+                q = parse_qs(url.query)
+                snap = cfg.flight_snapshot(bundle=q.get("bundle", [None])[0])
+                if snap is None:
+                    self._respond(
+                        404, json.dumps({"error": "unknown bundle"}),
                         "application/json",
                     )
                 else:

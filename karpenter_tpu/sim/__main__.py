@@ -64,6 +64,13 @@ def main(argv=None) -> int:
         "CPU dryrun: XLA_FLAGS=--xla_force_host_platform_device_count=8)",
     )
     parser.add_argument(
+        "--flight-dir",
+        default="",
+        help="flight-recorder bundle directory: SLO breaches during the "
+        "run dump postmortem bundles (JSONL + sha256) here; same-seed "
+        "runs dump byte-identical bundles",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     parser.add_argument(
@@ -93,13 +100,19 @@ def main(argv=None) -> int:
             f.write(tracemod.dumps(trace) + "\n")
 
     options = None
-    if args.compile_cache_dir or args.aot_ladder or args.shard_devices:
+    if (
+        args.compile_cache_dir
+        or args.aot_ladder
+        or args.shard_devices
+        or args.flight_dir
+    ):
         from karpenter_tpu.operator.options import Options
 
         options = Options(
             compile_cache_dir=args.compile_cache_dir,
             aot_ladder=args.aot_ladder,
             solver_pod_shard_axis=args.shard_devices,
+            flight_dir=args.flight_dir,
         )
 
     if trace.get("fleet"):

@@ -161,6 +161,13 @@ class FleetSimulation:
         for cell in self.cells:
             cell.tracer = self.tracer
             cell.operator.tracer = self.tracer
+        # pool-level SLO breach subscription: the cells each registered the
+        # single-tenant "sim" subscriber at construction — keyed replace
+        # swaps in ONE pool-level tap so every breach (tenant-tagged or
+        # aggregate) lands exactly once, in the fleet stream
+        from karpenter_tpu.observability import slo as slomod
+
+        slomod.engine().subscribe(self._on_slo_breach, key="sim")
         self._kills = sorted(
             fleet.get("kills", []), key=lambda k: (k["at"], k["replica"])
         )
@@ -170,6 +177,17 @@ class FleetSimulation:
 
     def _rel(self, t: float) -> float:
         return t - self.t0
+
+    def _on_slo_breach(self, breach) -> None:
+        self.fleet_log.append(
+            self._rel(breach.t),
+            "slo-breach",
+            objective=breach.objective,
+            tenant=breach.tenant,
+            window=breach.window,
+            burn_rate=round(breach.burn_rate, 6),
+            budget_remaining=round(breach.budget_remaining, 6),
+        )
 
     def _apply_kills(self) -> None:
         while self._kills and self.t0 + self._kills[0]["at"] <= self.clock.now():
@@ -264,11 +282,18 @@ class FleetSimulation:
         }
 
     def _finalize(self, end: float) -> dict:
+        from karpenter_tpu.observability import flight as flightmod
         from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.observability import slo as slomod
 
+        engine = slomod.engine()
         tenants = {}
         for name, cell in zip(self.names, self.cells):
             tenants[name] = cell.finalize(end, process_sections=False)
+            # the per-tenant SLO section: this tenant's burn/budget state
+            # for every objective its tag appeared on — the shape the
+            # ~100-cell macrobench scales to
+            tenants[name]["slo"]["objectives"] = engine.tenant_section(name)
         replicas = []
         for service, replica in zip(self.services, self.replicas):
             replicas.append(
@@ -324,6 +349,11 @@ class FleetSimulation:
             "kernels": kobs.registry().report(
                 self.cells[0]._kernels_base if self.cells else None
             ),
+            # pool-level SLO verdict (per-tenant attribution inside) and
+            # the flight recorder's ring/bundle digests — one engine, one
+            # blackbox, folded once like the tracing section
+            "slo": engine.report(),
+            "flight": flightmod.recorder().report(),
         }
         return report
 

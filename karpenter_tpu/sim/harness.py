@@ -130,12 +130,20 @@ def sim_globals(seed: int, clock: FakeClock):
     multi-tenant FleetSimulation — the globals are process-wide either
     way, so they must be entered once per run, never per cell."""
     from karpenter_tpu.controllers.provisioning import provisioner as provmod
+    from karpenter_tpu.observability import flight as flightmod
     from karpenter_tpu.observability import kernels as kobs
+    from karpenter_tpu.observability import slo as slomod
     from karpenter_tpu.ops import catalog as catmod
 
     apicore.set_uid_source(Random(f"{seed}:uids"))
     clock.enable_blocking_sleep()
     kobs.registry().unseal()
+    # fresh SLO/flight state per run (specs, sources, and subscribers were
+    # wired at operator construction and survive): burn-rate series,
+    # breach history, frames, and bundle sequence all restart at zero so
+    # report["slo"]/report["flight"] are pure functions of (scenario, seed)
+    slomod.engine().reset()
+    flightmod.recorder().reset()
     provmod._ENGINE_CONTENT_CACHE.clear()
     pinned_prev = catmod.PINNED_RTT
     catmod.PINNED_RTT = PINNED_RTT_S
@@ -221,6 +229,14 @@ class Simulation:
                 self._rel(self.clock.now()), "breaker", **{"from": old, "to": new}
             )
         )
+        # SLO breaches are part of the scenario's observable record: every
+        # edge-triggered breach lands in the event log (deterministic —
+        # burn rates over virtual time) exactly like breaker transitions.
+        # Keyed replace: a multi-tenant coordinator overrides this with one
+        # pool-level subscription after building its cells.
+        from karpenter_tpu.observability import slo as slomod
+
+        slomod.engine().subscribe(self._on_slo_breach, key="sim")
         # kept for solverd-restart: the rebuilt client must re-wrap with the
         # SAME flaky profile and the SAME rng stream (mid-stream — byte
         # determinism depends on continuing it, not reseeding)
@@ -272,6 +288,17 @@ class Simulation:
 
     def _on_fault(self, ev: str, **fields) -> None:
         self.log.append(self._rel(self.clock.now()), ev, **fields)
+
+    def _on_slo_breach(self, breach) -> None:
+        self.log.append(
+            self._rel(breach.t),
+            "slo-breach",
+            objective=breach.objective,
+            tenant=breach.tenant,
+            window=breach.window,
+            burn_rate=round(breach.burn_rate, 6),
+            budget_remaining=round(breach.budget_remaining, 6),
+        )
 
     def _rel(self, t: float) -> float:
         return t - self.t0
@@ -374,6 +401,19 @@ class Simulation:
             key: round(snap[key] - self._frontier_base[key], 6)
             for key in snap
         }
+        # the SLO engine's verdict over the run — per-objective burn/budget
+        # state, the breach stream, and its own digest — folded into the
+        # accounting slo section; plus the flight recorder's ring/bundle
+        # digests. Both are pure functions of (scenario, seed).
+        from karpenter_tpu.observability import flight as flightmod
+        from karpenter_tpu.observability import slo as slomod
+
+        engine_report = slomod.engine().report()
+        report["slo"]["objectives"] = engine_report["objectives"]
+        report["slo"]["breaches"] = engine_report["breaches"]
+        report["slo"]["breaches_total"] = engine_report["breaches_total"]
+        report["slo"]["digest"] = engine_report["digest"]
+        report["flight"] = flightmod.recorder().report()
         return report
 
     @staticmethod

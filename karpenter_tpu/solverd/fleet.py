@@ -244,6 +244,11 @@ class FleetClient(SolverClient):
         with self._lock:
             self.failovers += 1
         _FAILOVERS.inc({"from": replica.replica_id, "reason": reason})
+        # SLO feed: a solve that had to leave its routed replica — the
+        # fleet's failover-rate objective, attributed to this tenant
+        from karpenter_tpu.observability import slo
+
+        slo.engine().record("solverd-failover", bad=1, tenant=self.tenant)
         tracing.tracer().event(
             "solverd.failover",
             **{"from": replica.replica_id, "reason": reason},
@@ -256,6 +261,9 @@ class FleetClient(SolverClient):
             self._publish_health()
         replica.solves += 1
         _SOLVES.inc({"replica": replica.replica_id})
+        from karpenter_tpu.observability import slo
+
+        slo.engine().record("solverd-failover", good=1, tenant=self.tenant)
 
     def _attempt(self, key: str, call, exclude=None, prior_error=None):
         """Run `call(replica)` against the candidate order for `key`,

@@ -163,12 +163,16 @@ class SolverService:
                     self.deduped += 1
                 _DEDUP_HITS.inc()
                 return known
+        from karpenter_tpu.observability import slo
+
         if self._draining:
             with self._stats_lock:
                 self.rejected += 1
             from karpenter_tpu.solverd.queue import _REJECTIONS
 
             _REJECTIONS.inc({"reason": "draining"})
+            # draining is NOT an admission-SLO violation: the fleet client
+            # fails the request over to a healthy replica — no slo feed
             raise DrainingError(
                 "solver service is draining; replay on another replica"
             )
@@ -178,7 +182,18 @@ class SolverService:
         except Exception:
             with self._stats_lock:
                 self.rejected += 1
+            # per-tenant admission SLO: the request was shed (queue full,
+            # deadline, tenant quota) — attributed by the tenant tag every
+            # SolveRequest carries (PR 9), aggregate when untagged
+            slo.engine().record(
+                "solverd-admission", bad=1, tenant=request.tenant,
+                now=self.clock.now(),
+            )
             raise
+        slo.engine().record(
+            "solverd-admission", good=1, tenant=request.tenant,
+            now=self.clock.now(),
+        )
         if rid:
             with self._dedup_lock:
                 self._dedup[rid] = entry

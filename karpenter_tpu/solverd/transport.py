@@ -859,6 +859,13 @@ def _default_engine_factory(shard_devices: int = 0):
         key = tuple(_type_fingerprint(it) for it in catalog)
         engine = cache.get(key)
         if engine is None:
+            # engine (re)build: the device-memory gauges sampled against a
+            # previous engine's allocations are stale now — clear the family
+            # so /metrics never serves evicted-engine values, and resample
+            # once the build lands (the per-batch sampler keeps it fresh)
+            from karpenter_tpu.observability import kernels as kobs
+
+            kobs.reset_device_memory()
             engine = CatalogEngine(
                 catalog, mesh=_build_solver_mesh(shard_devices)
             )
@@ -882,6 +889,12 @@ def _default_engine_factory(shard_devices: int = 0):
                         "falling back to lazy JIT",
                         error=f"{type(e).__name__}: {e}",
                     )
+            # resample against the NEW engine's allocations so the gauges
+            # carry real values between the rebuild and the first batch
+            try:
+                kobs.sample_device_memory()
+            except Exception:  # noqa: BLE001 — telemetry must not fail a rebuild
+                pass
             cache[key] = engine
         return engine
 

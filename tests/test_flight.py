@@ -1,0 +1,465 @@
+"""Flight recorder (observability/flight.py): ring capture/eviction, source
+isolation, volatile scrubbing, bundle dump format + digest, cooldown,
+/debug/slo + /debug/flight serving, flaky-cloud ×2 byte-identical breach
+bundles, the karpenter_flight_* exposition round-trip, and the
+device-memory gauge reset on engine rebuild (satellite fix)."""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from karpenter_tpu.observability import flight
+from karpenter_tpu.observability.flight import (
+    FlightRecorder,
+    VOLATILE_KEYS,
+    canonical,
+    scrub,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+from test_metrics_exposition import parse_exposition
+
+
+def make_recorder(**kw):
+    kw.setdefault("clock", FakeClock())
+    return FlightRecorder(**kw)
+
+
+class TestScrub:
+    def test_volatile_keys_dropped_recursively(self):
+        frame = {
+            "ok": 1,
+            "last_batch_seconds": 0.5,
+            "nested": {"compile_wall_s": 2.0, "keep": [{"device_memory": 1}]},
+            "list": [{"joint_sweeps": 3, "x": "y"}],
+        }
+        assert scrub(frame) == {
+            "ok": 1,
+            "nested": {"keep": [{}]},
+            "list": [{"x": "y"}],
+        }
+
+    def test_wall_clock_families_are_covered(self):
+        assert {"last_batch_seconds", "compile_wall_s", "execute_wall_s",
+                "device_memory", "live_array_bytes"} <= VOLATILE_KEYS
+
+
+class TestRecorderCore:
+    def test_record_snapshots_all_sources(self):
+        rec = make_recorder()
+        rec.register_source("a", lambda: {"n": 1})
+        rec.register_source("b", lambda: {"m": 2})
+        frame = rec.record("pass")
+        assert frame["seq"] == 1
+        assert frame["sources"] == {"a": {"n": 1}, "b": {"m": 2}}
+
+    def test_ring_is_bounded_oldest_first(self):
+        rec = make_recorder(capacity=3)
+        rec.register_source("s", lambda: {})
+        for _ in range(5):
+            rec.record("pass")
+        snap = rec.snapshot()
+        assert snap["ring_depth"] == 3
+        assert snap["frames_recorded"] == 5
+        seqs = [f["seq"] for f in rec._ring]
+        assert seqs == [3, 4, 5]
+
+    def test_source_error_is_recorded_not_raised(self):
+        rec = make_recorder()
+        rec.register_source("bad", lambda: 1 / 0)
+        rec.register_source("good", lambda: {"ok": True})
+        frame = rec.record("pass")
+        assert frame["sources"]["good"] == {"ok": True}
+        assert "ZeroDivisionError" in frame["sources"]["bad"]["error"]
+
+    def test_register_source_is_keyed_replace(self):
+        rec = make_recorder()
+        rec.register_source("s", lambda: {"v": 1})
+        rec.register_source("s", lambda: {"v": 2})
+        assert rec.record("pass")["sources"] == {"s": {"v": 2}}
+
+    def test_reset_keeps_sources_and_config(self):
+        rec = make_recorder(capacity=7, flight_dir="/tmp/nope")
+        rec.register_source("s", lambda: {})
+        rec.record("pass")
+        rec.dump("x", cooldown=0.0)
+        rec.reset()
+        snap = rec.snapshot()
+        assert snap["ring_depth"] == 0 and snap["frames_recorded"] == 0
+        assert snap["bundles"] == []
+        assert snap["capacity"] == 7
+        assert snap["sources"] == ["s"]
+
+
+class TestDump:
+    def test_bundle_file_format_and_digest(self, tmp_path):
+        clock = FakeClock()
+        rec = make_recorder(clock=clock, flight_dir=str(tmp_path))
+        rec.register_source("s", lambda: {"v": 1, "last_batch_seconds": 9.9})
+        rec.record("pass")
+        clock.step(1.0)
+        rec.record("pass")
+        bundle = rec.dump("slo:avail")
+        assert bundle["name"] == "flight-0001-slo-avail"
+        path = bundle["path"]
+        assert os.path.exists(path)
+        lines = open(path).read().splitlines()
+        header = json.loads(lines[0])
+        assert header["bundle"] == bundle["name"]
+        assert header["frames"] == 2
+        # digest in the header matches a recompute over the frame lines
+        h = hashlib.sha256()
+        for line in lines[1:]:
+            h.update(line.encode())
+            h.update(b"\n")
+        assert header["sha256"] == "sha256:" + h.hexdigest()
+        # volatile keys were scrubbed from the written frames
+        for line in lines[1:]:
+            assert "last_batch_seconds" not in line
+            assert json.loads(line)["sources"]["s"] == {"v": 1}
+
+    def test_cooldown_dedupes_per_trigger(self):
+        clock = FakeClock()
+        rec = make_recorder(clock=clock)
+        rec.register_source("s", lambda: {})
+        rec.record("pass")
+        assert rec.dump("slo:x", cooldown=60.0) is not None
+        assert rec.dump("slo:x", cooldown=60.0) is None  # inside the window
+        assert rec.dump("slo:y", cooldown=60.0) is not None  # distinct trigger
+        clock.step(61.0)
+        assert rec.dump("slo:x", cooldown=60.0) is not None
+
+    def test_unwritable_dir_keeps_in_memory_bundle(self, tmp_path):
+        blocked = tmp_path / "blocked"
+        blocked.mkdir()
+        blocked.chmod(0o500)
+        rec = make_recorder(flight_dir=str(blocked / "sub"))
+        rec.register_source("s", lambda: {})
+        rec.record("pass")
+        try:
+            bundle = rec.dump("crash")
+        finally:
+            blocked.chmod(0o700)
+        if os.geteuid() == 0:
+            pytest.skip("running as root: directory modes are advisory")
+        assert bundle is not None
+        assert bundle["path"] is None and "write_error" in bundle
+        assert rec.snapshot(bundle=bundle["name"]) is not None
+
+    def test_snapshot_listing_and_drilldown(self):
+        rec = make_recorder()
+        rec.register_source("s", lambda: {"v": 7})
+        rec.record("pass")
+        bundle = rec.dump("sigquit", cooldown=0.0)
+        snap = rec.snapshot()
+        assert snap["bundles"][0]["name"] == bundle["name"]
+        assert "_frames" not in json.dumps(snap)
+        drill = rec.snapshot(bundle=bundle["name"])
+        assert drill["frame_records"][0]["sources"]["s"] == {"v": 7}
+        assert rec.snapshot(bundle="flight-9999-nope") is None
+
+    def test_dump_lock_timeout_bails_instead_of_deadlocking(self):
+        """The SIGQUIT path: signal handlers run on the main thread, which
+        may be suspended INSIDE record() holding the recorder lock — a
+        blocking dump would deadlock the operator. With lock_timeout the
+        dump gives up and returns None."""
+        import threading
+
+        rec = make_recorder()
+        rec.register_source("s", lambda: {})
+        rec.record("pass")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with rec._lock:
+                held.set()
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        held.wait(timeout=10)
+        try:
+            assert rec.dump("sigquit", lock_timeout=0.05) is None
+        finally:
+            release.set()
+            t.join(timeout=10)
+        # lock free again: the bounded dump succeeds
+        assert rec.dump("sigquit", cooldown=0.0, lock_timeout=0.05) is not None
+
+    def test_operator_shutdown_releases_global_slots(self):
+        """A retired operator must not keep snapshotting into frames (or
+        receiving breaches) after shutdown — keyed replace only covers a
+        successor with the SAME cluster name."""
+        from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+        from karpenter_tpu.observability import slo
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(
+            store, KwokCloudProvider(store, clock), clock=clock,
+            options=Options(cluster_name="retired-cell"),
+        )
+        assert "cell:retired-cell" in flight.recorder().snapshot()["sources"]
+        assert "operator:retired-cell" in slo.engine()._subscribers
+        op.shutdown()
+        assert "cell:retired-cell" not in flight.recorder().snapshot()["sources"]
+        assert "operator:retired-cell" not in slo.engine()._subscribers
+        # the operator-independent process-level sources stay registered
+        assert {"kernels", "spans"} <= set(flight.recorder().snapshot()["sources"])
+
+    def test_report_is_deterministic_and_path_free(self, tmp_path):
+        def replay(d):
+            clock = FakeClock()
+            rec = make_recorder(clock=clock, flight_dir=d)
+            rec.register_source("s", lambda: {"v": 1})
+            for _ in range(3):
+                rec.record("pass")
+                clock.step(1.0)
+            rec.dump("slo:x")
+            return rec.report()
+
+        a = replay(str(tmp_path / "a"))
+        b = replay(str(tmp_path / "b"))  # different dirs, identical report
+        assert a == b
+        assert a["ring_digest"].startswith("sha256:")
+        assert a["bundles"][0]["sha256"].startswith("sha256:")
+        assert "path" not in a["bundles"][0]
+
+
+class TestServingEndpoints:
+    """/debug/slo and /debug/flight: 200 with drill-down, 404 on unknown
+    ids, 404 when unwired (the acceptance-criteria serving surface)."""
+
+    def _server(self, slo_snapshot=None, flight_snapshot=None):
+        from karpenter_tpu.operator.serving import Server, ServingConfig
+
+        cfg = ServingConfig(
+            metrics_text=lambda: "x 1\n",
+            healthy=lambda: True,
+            ready=lambda: True,
+            slo_snapshot=slo_snapshot,
+            flight_snapshot=flight_snapshot,
+        )
+        return Server(0, cfg, host="127.0.0.1").start()
+
+    @staticmethod
+    def _get(server, path):
+        import urllib.error
+        import urllib.request
+
+        url = f"http://127.0.0.1:{server.port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_slo_endpoint_table_drilldown_and_404(self):
+        from karpenter_tpu.observability.slo import SLOEngine, SLOSpec, Window
+
+        eng = SLOEngine(
+            clock=FakeClock(),
+            specs=[SLOSpec("obj", "", 0.99, windows=(Window("w", 60, 2.0),))],
+        )
+        eng.record("obj", good=5, bad=5, tenant="gold")
+        eng.evaluate()
+        server = self._server(slo_snapshot=eng.snapshot)
+        try:
+            code, body = self._get(server, "/debug/slo")
+            assert code == 200
+            table = json.loads(body)
+            assert table["objectives"]["obj"]["events"] == {"good": 5, "bad": 5}
+            assert table["burning"]
+            code, body = self._get(server, "/debug/slo?objective=obj")
+            assert code == 200
+            assert "gold" in json.loads(body)["tenants"]
+            code, body = self._get(server, "/debug/slo?objective=missing")
+            assert code == 404
+            assert "unknown objective" in body
+        finally:
+            server.stop()
+
+    def test_flight_endpoint_listing_drilldown_and_404(self):
+        rec = make_recorder()
+        rec.register_source("s", lambda: {"v": 1})
+        rec.record("pass")
+        bundle = rec.dump("slo:obj", cooldown=0.0)
+        server = self._server(flight_snapshot=rec.snapshot)
+        try:
+            code, body = self._get(server, "/debug/flight")
+            assert code == 200
+            listing = json.loads(body)
+            assert listing["ring_depth"] == 1
+            assert listing["bundles"][0]["name"] == bundle["name"]
+            code, body = self._get(
+                server, f"/debug/flight?bundle={bundle['name']}"
+            )
+            assert code == 200
+            assert json.loads(body)["frame_records"]
+            code, body = self._get(server, "/debug/flight?bundle=nope")
+            assert code == 404
+            assert "unknown bundle" in body
+        finally:
+            server.stop()
+
+    def test_unwired_endpoints_404(self):
+        server = self._server()
+        try:
+            assert self._get(server, "/debug/slo")[0] == 404
+            assert self._get(server, "/debug/flight")[0] == 404
+        finally:
+            server.stop()
+
+
+class TestFlightExposition:
+    def test_flight_families_round_trip(self):
+        from karpenter_tpu.metrics import global_registry
+
+        rec = make_recorder()
+        rec.register_source("s", lambda: {})
+        rec.record("expo-pass")
+        rec.dump("expo-trigger", cooldown=0.0)
+        fam = parse_exposition(global_registry.expose())
+        frames = fam["karpenter_flight_frames_total"]
+        assert frames["type"] == "counter"
+        assert frames["samples"][
+            ("karpenter_flight_frames_total", (("trigger", "expo-pass"),))
+        ] >= 1.0
+        dumps = fam["karpenter_flight_dumps_total"]
+        assert dumps["samples"][
+            ("karpenter_flight_dumps_total", (("trigger", "expo-trigger"),))
+        ] >= 1.0
+        assert fam["karpenter_flight_ring_depth"]["type"] == "gauge"
+        hist = fam["karpenter_flight_bundle_bytes"]
+        assert hist["type"] == "histogram"
+        inf = hist["samples"][
+            ("karpenter_flight_bundle_bytes_bucket", (("le", "+Inf"),))
+        ]
+        count = hist["samples"][("karpenter_flight_bundle_bytes_count", ())]
+        total = hist["samples"][("karpenter_flight_bundle_bytes_sum", ())]
+        assert inf == count >= 1.0
+        assert total > 0.0
+
+
+class TestFlakyCloudDeterminism:
+    """The acceptance criterion: a same-seed flaky-cloud run breaches a
+    configured objective, emits SLOBreach, and dumps a flight bundle whose
+    sha256 is byte-identical across two runs."""
+
+    @pytest.fixture(scope="class")
+    def two_runs(self, tmp_path_factory):
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.sim import scenarios
+        from karpenter_tpu.sim.harness import run_scenario
+
+        results = []
+        for i in range(2):
+            d = str(tmp_path_factory.mktemp(f"flight{i}"))
+            result = run_scenario(
+                scenarios.resolve("flaky-cloud", 3), 3,
+                options=Options(flight_dir=d),
+            )
+            results.append((result, d))
+        return results
+
+    def test_breach_and_bundle(self, two_runs):
+        (a, _), _ = two_runs
+        assert a.report["slo"]["breaches_total"] > 0
+        assert a.report["slo"]["breaches"][0]["objective"]
+        # the event log carries the breach stream
+        assert a.log.entries("slo-breach")
+        # a bundle was dumped for the breaching objective
+        bundles = a.report["flight"]["bundles"]
+        assert bundles and bundles[0]["trigger"].startswith("slo:")
+
+    def test_reports_and_digests_identical(self, two_runs):
+        (a, _), (b, _) = two_runs
+        assert a.digest == b.digest
+        assert a.report == b.report
+        assert a.report["slo"]["digest"] == b.report["slo"]["digest"]
+        assert (
+            a.report["flight"]["ring_digest"]
+            == b.report["flight"]["ring_digest"]
+        )
+
+    def test_bundle_files_byte_identical(self, two_runs):
+        (_, da), (_, db) = two_runs
+        names_a = sorted(os.listdir(da))
+        names_b = sorted(os.listdir(db))
+        assert names_a == names_b and names_a
+        for name in names_a:
+            with open(os.path.join(da, name), "rb") as f:
+                bytes_a = f.read()
+            with open(os.path.join(db, name), "rb") as f:
+                bytes_b = f.read()
+            assert bytes_a == bytes_b, f"bundle {name} differs between runs"
+
+
+class TestDeviceMemoryReset:
+    """Satellite fix: per-device memory gauges cleared on engine rebuild
+    instead of serving stale values from an evicted engine."""
+
+    def test_reset_device_memory_clears_family(self):
+        from karpenter_tpu.metrics import global_registry
+        from karpenter_tpu.observability import kernels as kobs
+
+        gauge = global_registry.get("karpenter_device_memory_bytes")
+        gauge.set(123.0, {"device": "STALE:0", "stat": "bytes_in_use"})
+        live = global_registry.get("karpenter_device_live_array_bytes")
+        live.set(999.0)
+        kobs.registry()._last_memory = {"stale": True}
+        kobs.reset_device_memory()
+        assert gauge.series() == {}
+        assert live.value() == 0.0
+        assert kobs.registry()._last_memory is None
+
+    def test_daemon_engine_rebuild_clears_stale_series(self):
+        """The PR 6 regression: a rebuilt daemon engine must not leave the
+        previous engine's per-device series standing."""
+        from karpenter_tpu.cloudprovider.kwok.instance_types import (
+            construct_instance_types,
+        )
+        from karpenter_tpu.metrics import global_registry
+        from karpenter_tpu.solverd.transport import _default_engine_factory
+
+        gauge = global_registry.get("karpenter_device_memory_bytes")
+        gauge.set(777.0, {"device": "EVICTED:0", "stat": "bytes_in_use"})
+        factory = _default_engine_factory()
+        catalog = construct_instance_types()[:4]
+        engine = factory(catalog)
+        assert engine is not None
+        stale = {
+            k: v for k, v in gauge.series().items()
+            if ("device", "EVICTED:0") in k
+        }
+        assert stale == {}, "stale per-device series survived the rebuild"
+        # the cached engine path must NOT clear fresh samples
+        gauge.set(42.0, {"device": "FRESH:0", "stat": "bytes_in_use"})
+        factory(catalog)  # cache hit
+        assert gauge.value({"device": "FRESH:0", "stat": "bytes_in_use"}) == 42.0
+
+    def test_provisioner_engine_rebuild_clears_stale_series(self):
+        from karpenter_tpu.cloudprovider.kwok.instance_types import (
+            construct_instance_types,
+        )
+        from karpenter_tpu.controllers.provisioning.provisioner import (
+            _ENGINE_CONTENT_CACHE,
+            default_engine_factory,
+        )
+        from karpenter_tpu.metrics import global_registry
+
+        gauge = global_registry.get("karpenter_device_memory_bytes")
+        gauge.set(555.0, {"device": "EVICTED:1", "stat": "bytes_in_use"})
+        _ENGINE_CONTENT_CACHE.clear()
+        factory = default_engine_factory()
+        engine = factory({"pool": construct_instance_types()[:4]})
+        assert engine is not None
+        assert gauge.value(
+            {"device": "EVICTED:1", "stat": "bytes_in_use"}
+        ) == 0.0

@@ -87,6 +87,40 @@ class TestReplicaKillScenario:
     def test_surviving_replica_zero_steady_recompiles(self, result):
         assert result.report["kernels"]["steady_recompiles"] == 0
 
+    def test_per_tenant_slo_sections_present(self, result):
+        """Every tenant's report carries its SLO-engine section (the shape
+        the ~100-cell macrobench scales to): burn-rate windows, budget
+        remaining, and per-objective events attributed by tenant tag."""
+        for name, report in result.report["tenants"].items():
+            objectives = report["slo"]["objectives"]
+            assert "solverd-failover" in objectives, name
+            assert "pod-bind-latency" in objectives, name
+            for entry in objectives.values():
+                assert {"events", "compliance", "error_budget_remaining",
+                        "windows"} <= set(entry)
+        # the pool-level section carries the same tenants
+        pool = result.report["slo"]["objectives"]
+        assert set(pool["solverd-failover"]["tenants"]) == set(
+            result.report["tenants"]
+        )
+        assert result.report["slo"]["digest"]
+
+    def test_failovers_recorded_per_tenant(self, result):
+        """The kill forces failovers: at least one tenant's failover
+        objective saw bad events, and the aggregate series folds them."""
+        agg = result.report["slo"]["objectives"]["solverd-failover"]
+        assert agg["events"]["bad"] >= 1
+        by_tenant = sum(
+            entry["events"]["bad"]
+            for entry in agg["tenants"].values()
+        )
+        assert by_tenant == agg["events"]["bad"]
+
+    def test_flight_section_digest_stable(self, result):
+        flight = result.report["flight"]
+        assert flight["frames_recorded"] > 0
+        assert flight["ring_digest"].startswith("sha256:")
+
     def test_kill_event_in_merged_log(self, result):
         kills = result.log.entries("replica-kill")
         assert len(kills) == 1

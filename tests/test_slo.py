@@ -1,0 +1,473 @@
+"""SLO burn-rate engine (observability/slo.py): window math, multi-window
+edge-triggered breaches (fast trips before slow), per-tenant attribution,
+the forced-breach spec via synthetic latency injection, /healthz hard-breach
+degrade-and-recover, spec loading, and Prometheus exposition round-trips
+for the karpenter_slo_* families."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.observability import slo
+from karpenter_tpu.observability.slo import (
+    BURN_CAP,
+    SLOEngine,
+    SLOSpec,
+    Window,
+    _budget_remaining,
+    _burn_rate,
+    default_specs,
+    load_specs,
+    spec_to_dict,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+from test_metrics_exposition import parse_exposition
+
+
+def make_engine(*specs, clock=None):
+    return SLOEngine(clock=clock or FakeClock(), specs=list(specs))
+
+
+FAST = Window("fast", 60.0, 14.4)
+SLOW = Window("slow", 300.0, 6.0)
+
+
+def ratio_spec(name="avail", objective=0.99, availability=False):
+    return SLOSpec(
+        name, "test objective", objective=objective,
+        windows=(FAST, SLOW), availability=availability,
+    )
+
+
+class TestBurnMath:
+    def test_burn_rate_is_error_rate_over_budget(self):
+        # 5% errors against a 1% budget burns 5x
+        assert _burn_rate(95, 5, 0.99) == pytest.approx(5.0)
+        assert _burn_rate(100, 0, 0.99) == 0.0
+        assert _burn_rate(0, 0, 0.99) == 0.0
+
+    def test_zero_tolerance_burn_is_capped_infinite(self):
+        assert _burn_rate(1000, 1, 1.0) == BURN_CAP
+        assert _burn_rate(0, 0, 1.0) == 0.0
+
+    def test_budget_remaining(self):
+        # 100 events, 1% budget => 1 allowed bad; none spent => 1.0
+        assert _budget_remaining(100, 0, 0.99) == pytest.approx(1.0)
+        # exactly the allowance spent => 0.0
+        assert _budget_remaining(99, 1, 0.99) == pytest.approx(0.0)
+        # overspent goes negative
+        assert _budget_remaining(90, 10, 0.99) < 0.0
+        # zero tolerance: binary
+        assert _budget_remaining(10, 0, 1.0) == 1.0
+        assert _budget_remaining(10, 1, 1.0) == 0.0
+        assert _budget_remaining(0, 0, 0.99) == 1.0
+
+
+class TestEngineCore:
+    def test_observe_classifies_by_threshold(self):
+        clock = FakeClock()
+        spec = SLOSpec("lat", "", 0.99, windows=(FAST,), threshold_s=10.0)
+        eng = make_engine(spec, clock=clock)
+        eng.observe("lat", 5.0)
+        eng.observe("lat", 10.0)  # inclusive: at threshold is good
+        eng.observe("lat", 10.1)
+        series = eng._series[("lat", "")]
+        assert (series.cum_good, series.cum_bad) == (2, 1)
+
+    def test_unknown_objective_is_ignored(self):
+        eng = make_engine(ratio_spec())
+        eng.record("nope", good=1)
+        eng.observe("nope", 1.0)
+        assert ("nope", "") not in eng._series
+
+    def test_per_tenant_attribution_feeds_aggregate_too(self):
+        eng = make_engine(ratio_spec())
+        eng.record("avail", good=3, tenant="gold")
+        eng.record("avail", bad=1, tenant="free")
+        agg = eng._series[("avail", "")]
+        assert (agg.cum_good, agg.cum_bad) == (3, 1)
+        assert eng._series[("avail", "gold")].cum_good == 3
+        assert eng._series[("avail", "free")].cum_bad == 1
+        section = eng.tenant_section("gold")
+        assert section["avail"]["events"] == {"good": 3, "bad": 0}
+        assert eng.tenant_section("nobody") == {}
+
+    def test_series_prunes_to_longest_window(self):
+        clock = FakeClock()
+        eng = make_engine(ratio_spec(), clock=clock)
+        eng.record("avail", good=1)
+        clock.step(400.0)  # past the 300s slow window
+        eng.record("avail", good=1)
+        eng.evaluate()
+        series = eng._series[("avail", "")]
+        assert len(series.events) == 1  # old record pruned
+        assert series.cum_good == 2  # cumulative totals survive pruning
+
+
+class TestBreachEdgeTrigger:
+    def test_fast_window_trips_before_slow(self):
+        """The forced-breach spec: good traffic fills both windows, then a
+        synthetic latency injection turns everything bad — the fast window
+        saturates while the slow window is still diluted by history."""
+        clock = FakeClock()
+        spec = SLOSpec("lat", "", 0.99, windows=(FAST, SLOW), threshold_s=1.0)
+        eng = make_engine(spec, clock=clock)
+        breaches = []
+        eng.subscribe(breaches.append, key="t")
+        # 240s of healthy traffic at 1 observation/s
+        for _ in range(240):
+            eng.observe("lat", 0.1)
+            eng.evaluate()
+            clock.step(1.0)
+        assert breaches == []
+        # inject latency: every observation now blows the threshold
+        fast_tripped_at = slow_tripped_at = None
+        for i in range(120):
+            eng.observe("lat", 30.0)
+            for b in eng.evaluate():
+                if b.window == "fast" and fast_tripped_at is None:
+                    fast_tripped_at = i
+                if b.window == "slow" and slow_tripped_at is None:
+                    slow_tripped_at = i
+            clock.step(1.0)
+        assert fast_tripped_at is not None and slow_tripped_at is not None
+        assert fast_tripped_at < slow_tripped_at, (
+            "the fast-burn window must trip before the slow one"
+        )
+
+    def test_breach_fires_once_per_edge_and_again_after_recovery(self):
+        clock = FakeClock()
+        eng = make_engine(ratio_spec(), clock=clock)
+        breaches = []
+        eng.subscribe(breaches.append, key="t")
+        eng.record("avail", bad=10)
+        eng.evaluate()
+        eng.evaluate()  # still burning: no second breach
+        fast = [b for b in breaches if b.window == "fast"]
+        assert len(fast) == 1
+        # recovery: the bad burst ages out of the fast window
+        clock.step(120.0)
+        eng.record("avail", good=100)
+        eng.evaluate()
+        assert ("avail", "", "fast") not in eng._burning
+        # a fresh burst is a fresh edge
+        eng.record("avail", bad=50)
+        eng.evaluate()
+        fast = [b for b in breaches if b.window == "fast"]
+        assert len(fast) == 2
+
+    def test_breach_carries_burn_and_budget(self):
+        eng = make_engine(ratio_spec())
+        breaches = []
+        eng.subscribe(breaches.append, key="t")
+        eng.record("avail", good=50, bad=50)
+        eng.evaluate()
+        b = breaches[0]
+        assert b.objective == "avail"
+        assert b.burn_rate == pytest.approx(50.0)
+        assert b.budget_remaining < 0.0
+        d = b.to_dict()
+        assert set(d) == {
+            "objective", "tenant", "window", "burn_rate",
+            "budget_remaining", "t",
+        }
+
+    def test_subscriber_exceptions_are_isolated(self):
+        eng = make_engine(ratio_spec())
+        seen = []
+        eng.subscribe(lambda b: 1 / 0, key="a")
+        eng.subscribe(seen.append, key="b")
+        eng.record("avail", bad=5)
+        eng.evaluate()  # must not raise
+        assert len(seen) >= 1
+
+    def test_subscribe_is_keyed_replace(self):
+        eng = make_engine(ratio_spec())
+        first, second = [], []
+        eng.subscribe(first.append, key="sim")
+        eng.subscribe(second.append, key="sim")
+        eng.record("avail", bad=5)
+        eng.evaluate()
+        # both windows breach (all-bad series); only the live key sees them
+        assert first == [] and len(second) == 2
+
+    def test_zero_tolerance_objective_breaches_on_one_bad(self):
+        spec = SLOSpec(
+            "recompiles", "", 1.0, windows=(Window("steady", 300.0, 1.0),)
+        )
+        eng = make_engine(spec)
+        breaches = []
+        eng.subscribe(breaches.append, key="t")
+        eng.record("recompiles", bad=1)
+        eng.evaluate()
+        assert len(breaches) == 1
+        assert breaches[0].burn_rate == BURN_CAP
+        assert breaches[0].budget_remaining == 0.0
+
+
+class TestHardBreach:
+    def test_availability_objective_burning_all_windows(self):
+        clock = FakeClock()
+        eng = make_engine(ratio_spec(availability=True), clock=clock)
+        assert eng.hard_breached() == []
+        # saturate both windows at once
+        eng.record("avail", bad=100)
+        eng.evaluate()
+        assert eng.hard_breached() == ["avail"]
+        worst = eng.worst_burning()
+        assert worst["objective"] == "avail"
+        assert worst["burn_rate"] == pytest.approx(100.0)  # all-bad / 1% budget
+        # recover the fast window: good traffic dilutes it while the slow
+        # window (longer memory) keeps burning — no longer a HARD breach
+        clock.step(90.0)
+        eng.record("avail", good=300)
+        eng.evaluate()
+        # fast window sees only the goods; slow still holds the bad burst
+        # (100 bad / 400 total = 25x burn >= 6) — burning, but not hard
+        assert ("avail", "", "fast") not in eng._burning
+        assert ("avail", "", "slow") in eng._burning
+        assert eng.hard_breached() == []
+
+    def test_non_availability_objectives_never_hard_breach(self):
+        eng = make_engine(ratio_spec(availability=False))
+        eng.record("avail", bad=100)
+        eng.evaluate()
+        assert eng.hard_breached() == []
+
+
+class TestSnapshotAndReport:
+    def test_snapshot_table_and_drilldown(self):
+        eng = make_engine(ratio_spec())
+        eng.record("avail", good=9, bad=1, tenant="gold")
+        eng.evaluate()
+        snap = eng.snapshot()
+        assert "avail" in snap["objectives"]
+        entry = snap["objectives"]["avail"]
+        assert entry["events"] == {"good": 9, "bad": 1}
+        assert "fast" in entry["windows"] and "slow" in entry["windows"]
+        drill = eng.snapshot(objective="avail")
+        assert drill["spec"]["name"] == "avail"
+        assert "gold" in drill["tenants"]
+        assert eng.snapshot(objective="nope") is None
+
+    def test_snapshot_covers_specs_with_no_events(self):
+        snap = make_engine(ratio_spec()).snapshot()
+        entry = snap["objectives"]["avail"]
+        assert entry["compliance"] == 1.0
+        assert entry["error_budget_remaining"] == 1.0
+
+    def test_report_digest_is_replay_stable(self):
+        def replay():
+            clock = FakeClock()
+            eng = make_engine(ratio_spec(), clock=clock)
+            for _ in range(10):
+                eng.record("avail", good=3, bad=1, tenant="gold")
+                eng.evaluate()
+                clock.step(5.0)
+            return eng.report()
+
+        a, b = replay(), replay()
+        assert a == b
+        assert a["digest"] == b["digest"]
+        assert a["objectives"]["avail"]["tenants"]["gold"]["events"] == {
+            "good": 30, "bad": 10,
+        }
+
+    def test_reset_keeps_specs_and_subscribers(self):
+        eng = make_engine(ratio_spec())
+        seen = []
+        eng.subscribe(seen.append, key="t")
+        eng.record("avail", bad=5)
+        eng.evaluate()
+        eng.reset()
+        assert eng._series == {} and eng._burning == {}
+        assert [s.name for s in eng.specs()] == ["avail"]
+        eng.record("avail", bad=5)
+        eng.evaluate()
+        assert len(seen) >= 2  # the subscriber survived the reset
+
+
+class TestSpecLoading:
+    def test_default_and_off(self):
+        assert load_specs("") == default_specs()
+        assert load_specs("default") == default_specs()
+        assert load_specs("off") == []
+        names = {s.name for s in default_specs()}
+        assert {"pod-bind-latency", "solverd-availability",
+                "steady-recompiles"} <= names
+        # exactly one availability objective in the default set
+        assert sum(s.availability for s in default_specs()) == 1
+
+    def test_json_file_round_trip(self, tmp_path):
+        specs = [ratio_spec("a", availability=True),
+                 SLOSpec("b", "zero", 1.0, windows=(Window("w", 10.0, 1.0),),
+                         threshold_s=2.0)]
+        path = tmp_path / "specs.json"
+        path.write_text(json.dumps([spec_to_dict(s) for s in specs]))
+        loaded = load_specs(str(path))
+        assert loaded == specs
+
+
+class TestExposition:
+    def test_slo_families_round_trip(self):
+        """karpenter_slo_* on the REAL global registry: gauges per
+        objective×tenant(×window), the events/breach counters, and the
+        breach-duration histogram's _bucket/+Inf/_sum/_count."""
+        from karpenter_tpu.metrics import global_registry
+
+        clock = FakeClock()
+        eng = slo.engine().configure(clock=clock, specs=[ratio_spec("expo-obj")])
+        try:
+            eng.record("expo-obj", good=19, bad=1, tenant='ten"ant\\x')
+            eng.evaluate()
+            # drive a recovery so the breach-duration histogram observes
+            eng.record("expo-obj", bad=100)
+            eng.evaluate()
+            clock.step(120.0)
+            eng.record("expo-obj", good=100000)
+            eng.evaluate()
+            fam = parse_exposition(global_registry.expose())
+
+            comp = fam["karpenter_slo_compliance_ratio"]
+            assert comp["type"] == "gauge"
+            agg = comp["samples"][
+                ("karpenter_slo_compliance_ratio",
+                 tuple(sorted((("objective", "expo-obj"), ("tenant", "")))))
+            ]
+            assert 0.0 <= agg <= 1.0
+            # the escaped tenant label round-trips intact
+            nasty = tuple(sorted(
+                (("objective", "expo-obj"), ("tenant", 'ten"ant\\x'))
+            ))
+            assert ("karpenter_slo_compliance_ratio", nasty) in comp["samples"]
+
+            burn = fam["karpenter_slo_burn_rate"]
+            key = tuple(sorted(
+                (("objective", "expo-obj"), ("tenant", ""), ("window", "fast"))
+            ))
+            assert ("karpenter_slo_burn_rate", key) in burn["samples"]
+
+            events = fam["karpenter_slo_events_total"]
+            assert events["type"] == "counter"
+            good_key = tuple(sorted(
+                (("objective", "expo-obj"), ("outcome", "good"))
+            ))
+            assert events["samples"][
+                ("karpenter_slo_events_total", good_key)
+            ] >= 19.0
+
+            breaches = fam["karpenter_slo_breaches_total"]
+            bkey = tuple(sorted((("objective", "expo-obj"), ("window", "fast"))))
+            assert breaches["samples"][
+                ("karpenter_slo_breaches_total", bkey)
+            ] >= 1.0
+
+            hist = fam["karpenter_slo_breach_duration_seconds"]
+            assert hist["type"] == "histogram"
+            hkey = tuple(sorted((("objective", "expo-obj"), ("window", "fast"))))
+            inf = hist["samples"][
+                ("karpenter_slo_breach_duration_seconds_bucket",
+                 tuple(sorted(hkey + (("le", "+Inf"),))))
+            ]
+            count = hist["samples"][
+                ("karpenter_slo_breach_duration_seconds_count", hkey)
+            ]
+            total = hist["samples"][
+                ("karpenter_slo_breach_duration_seconds_sum", hkey)
+            ]
+            assert inf == count >= 1.0
+            assert total > 0.0
+        finally:
+            slo.engine().configure(specs=default_specs())
+
+
+class TestOperatorHealthzFold:
+    """Satellite: /healthz folds SLO state and 503s on a hard breach of a
+    configured availability objective — and recovers."""
+
+    def _operator(self):
+        from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        provider = KwokCloudProvider(store, clock)
+        op = Operator(store, provider, clock=clock, options=Options())
+        return clock, op
+
+    def test_healthz_degrades_on_hard_breach_and_recovers(self):
+        clock, op = self._operator()
+        try:
+            op.run_once()
+            snap = op.health_snapshot()
+            assert snap["healthy"] is True
+            assert snap["slo"] == {"worst_burning": None, "hard_breached": []}
+            # drive the configured availability objective into hard breach
+            op.slo.record("solverd-availability", bad=100)
+            op.run_once()  # the pass evaluates the engine
+            snap = op.health_snapshot()
+            assert snap["healthy"] is False
+            assert snap["slo"]["hard_breached"] == ["solverd-availability"]
+            assert snap["slo"]["worst_burning"]["objective"] == (
+                "solverd-availability"
+            )
+            assert any("hard breach" in r for r in snap["degraded_reasons"])
+            assert op.healthy() is False
+            # an SLOBreach warning event was published
+            assert op.recorder.calls("SLOBreach") >= 1
+            # recover: good traffic ages the burst out of the fast window
+            clock.step(90.0)
+            op.slo.record("solverd-availability", good=100000)
+            op.run_once()
+            snap = op.health_snapshot()
+            assert snap["slo"]["hard_breached"] == []
+            assert snap["healthy"] is True
+        finally:
+            op.shutdown()
+
+    def test_healthz_http_503_and_recovery(self):
+        import urllib.error
+        import urllib.request
+
+        from karpenter_tpu.operator.serving import Server, ServingConfig
+
+        clock, op = self._operator()
+        server = Server(
+            0,
+            ServingConfig(
+                metrics_text=op.metrics_text,
+                healthy=op.healthy,
+                ready=op.ready,
+                health_snapshot=op.health_snapshot,
+                slo_snapshot=op.slo_snapshot,
+            ),
+            host="127.0.0.1",
+        ).start()
+
+        def get(path):
+            url = f"http://127.0.0.1:{server.port}{path}"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    return resp.status, resp.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        try:
+            op.run_once()
+            assert get("/healthz")[0] == 200
+            op.slo.record("solverd-availability", bad=100)
+            op.run_once()
+            code, body = get("/healthz")
+            assert code == 503
+            payload = json.loads(body)
+            assert payload["slo"]["hard_breached"] == ["solverd-availability"]
+            clock.step(90.0)
+            op.slo.record("solverd-availability", good=100000)
+            op.run_once()
+            assert get("/healthz")[0] == 200
+        finally:
+            server.stop()
+            op.shutdown()
