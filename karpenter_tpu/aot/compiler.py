@@ -269,6 +269,44 @@ def _sharded_solve_block_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]
     return plans
 
 
+class _X64Lower:
+    """Lower-wrapper running the trace under packer.scan_x64(): the fused
+    scan's float64/int64 avals only exist in 64-bit mode, and the serve
+    path traces under the same scope, so warm-start must too or the
+    executable universe would split."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def lower(self, *args):
+        from karpenter_tpu.ops import packer
+
+        with packer.scan_x64():
+            return self._fn.lower(*args)
+
+
+def _solve_scan_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
+    """Fused-scan rungs: one executable per (pods, groups, claims, nodes,
+    fams, templates, limited-pools) bucket, through the SAME jitted
+    callable the serving path dispatches (packer.solve_scan_fn). Only
+    built when the fused path is enabled — a fused-off boot never pays
+    the while_loop compiles."""
+    from karpenter_tpu.ops import fused as fused_mod
+    from karpenter_tpu.ops import packer
+
+    plans = []
+    for bucket in ladder.buckets("packer.solve_scan"):
+        if len(bucket) != 7:
+            continue
+        _P, _G, _C, N, _F, T, L = bucket
+        fn = packer.solve_scan_fn(int(T), N > 0, L > 0)
+        args = fused_mod.solve_scan_abstract_args(engine, bucket)
+        plans.append(
+            ("packer.solve_scan", _X64Lower(fn), args, _sig(args))
+        )
+    return plans
+
+
 def _solve_block_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
     """Packer buckets. The catalog-side row axis is the engine's CURRENT
     interned row count (taken after warmup, when the probe rows exist) —
@@ -441,6 +479,15 @@ def warm_start(
         )
         for plan in packer_plans:
             _ensure_executable(plan, chash, ladder, cache, registry, summary)
+        # fused-scan rungs: compiled only when the fused path can actually
+        # dispatch them (mode on / non-CPU auto) — a fused-off boot pays
+        # nothing. Mesh engines compile the scan lazily at first dispatch
+        # (pre-seal): the replicated twin is mesh-shape-scoped and cheap.
+        from karpenter_tpu.ops import fused as fused_mod
+
+        if fused_mod.fused_enabled() and engine.mesh is None:
+            for plan in _solve_scan_plans(engine, ladder):
+                _ensure_executable(plan, chash, ladder, cache, registry, summary)
     aotrt.note_warm_start(summary["fresh_compiles"])
     engine._aot_warmed = True
     engine._aot_summary = summary

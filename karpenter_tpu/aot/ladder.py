@@ -46,6 +46,11 @@ LADDER_KERNELS = {
     "packer.solve_block": 1,
     "feasibility.cube_sharded": 2,
     "packer.solve_block_sharded": 1,
+    # the fused FFD scan: (pods, groups, claims, nodes, fams, templates,
+    # limited-pools). Its first dispatch arg is the pod axis alone, so
+    # from_observatory's first-shape heuristic skips it by arity — fused
+    # rungs are authored (here or in a ladder file), never derived.
+    "packer.solve_scan": 7,
 }
 
 # Sharded dispatches align their entity axis to a multiple of lcm(mesh size,
@@ -96,7 +101,11 @@ class Ladder:
             if all(bd >= d for bd, d in zip(b, dims)):
                 cells = 1
                 for bd in b:
-                    cells *= bd
+                    # zero axes (a variant selector like the fused scan's
+                    # node/pool dims) must not zero the product, or every
+                    # zero-bearing rung would tie at 0 cells and selection
+                    # would silently degrade to authoring order
+                    cells *= max(bd, 1)
                 if best_cells is None or cells < best_cells:
                     best, best_cells = b, cells
         return best
@@ -163,6 +172,17 @@ DEFAULT = make(
             (p, r) for p in (8, 64, 128, 256, 512, 1024) for r in (4, 16, 64)
         ],
         "packer.solve_block_sharded": [(8,), (64,), (512,), (4096,)],
+        # fused one-dispatch scan rungs (pods, groups, claims, nodes, fams,
+        # templates, limited-pools): the small rungs cover coalesced
+        # serving batches and consolidation probe sims (with and without
+        # existing nodes), the large one the bulk cold-batch shape. These
+        # are padding targets for every fused dispatch; the AOT walk only
+        # compiles them when the fused path is enabled (aot/compiler).
+        "packer.solve_scan": [
+            (512, 64, 256, 0, 64, 1, 0),
+            (512, 64, 256, 64, 64, 1, 0),
+            (8192, 256, 1024, 0, 128, 1, 0),
+        ],
     }
 )
 

@@ -39,6 +39,7 @@ from karpenter_tpu.controllers.disruption.validation import (
 )
 from karpenter_tpu.events.recorder import Event
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.ops import fused as fused_mod
 from karpenter_tpu.scheduling.requirements import Requirements
 
 MULTI_NODE_CONSOLIDATION_TIMEOUT = 60.0  # multinodeconsolidation.go:36
@@ -319,9 +320,14 @@ class MultiNodeConsolidation:
                 lo=lo_n,
                 hi=hi_n,
                 probes=len(probes),
-            ):
+            ) as span:
+                fused0 = fused_mod.FUSED_SOLVES
                 plans = {mid: sim.plan(candidates[: mid + 1]) for mid in probes}
                 sim.solve_batch(list(plans.values()))
+                # probe levels riding the one-dispatch scan: with the fused
+                # path on, each prefix sim is ONE device dispatch instead of
+                # a host-paced sweep conversation (process-history attr)
+                span.set_volatile(fused_probes=fused_mod.FUSED_SOLVES - fused0)
             _FRONTIER_PROBES.inc(
                 {"consolidation_type": "multi"}, float(len(probes))
             )
@@ -518,11 +524,13 @@ class SingleNodeConsolidation:
                 consolidation_type="single",
                 round=rounds,
                 probes=len(batch),
-            ):
+            ) as span:
+                fused0 = fused_mod.FUSED_SOLVES
                 plans = {j: sim.plan([candidates[j]]) for j in batch}
                 # disjoint candidates, not nested prefixes: every member's
                 # row-sets must be collected for the shared prime
                 sim.solve_batch(list(plans.values()), nested=False)
+                span.set_volatile(fused_probes=fused_mod.FUSED_SOLVES - fused0)
             _FRONTIER_PROBES.inc(
                 {"consolidation_type": "single"}, float(len(batch))
             )

@@ -93,6 +93,19 @@ _PHASE_OVERRIDE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "karpenter_kernel_phase_override", default=None
 )
 
+# per-batch dispatch accumulator (the one-dispatch-solve proof surface):
+# opened by batch_scope() around each solverd batch / provisioner solve;
+# contextvar-scoped so concurrent daemon threads never mix batches
+_BATCH: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "karpenter_kernel_batch", default=None
+)
+_BATCH_RING_CAP = 64
+_BATCH_DISPATCHES = global_registry.histogram(
+    "karpenter_kernel_batch_dispatches",
+    "device dispatches per solve batch (steady-state contract: <=1)",
+    buckets=(0.0, 1.0, 2.0, 3.0, 5.0, 10.0, 25.0, 100.0),
+)
+
 
 class _Shape:
     """Per-(kernel, padded-shape-bucket) accounting."""
@@ -152,6 +165,8 @@ class KernelRegistry:
         self._recompile_cbs: dict[str, Callable[[str, str], None]] = {}
         self._recompile_events: list[dict] = []
         self._last_memory: Optional[dict] = None
+        self._batches: list[dict] = []  # recent per-batch dispatch counts
+        self._batch_seq = 0
 
     # -- phase / seal --------------------------------------------------------
 
@@ -199,6 +214,38 @@ class KernelRegistry:
         finally:
             _PHASE_OVERRIDE.reset(token)
 
+    @contextmanager
+    def batch_scope(self, label: str = "") -> Iterator[dict]:
+        """Count DEVICE dispatches (every non-host record() in the current
+        thread of control) for one solve batch, and file the result into a
+        bounded recent-batches ring surfaced on /debug/kernels. This is the
+        runtime proof surface for the one-dispatch-solve contract: a steady
+        fused batch must show dispatches == 1. The yielded dict accumulates
+        live, so callers can also read it after the scope closes."""
+        acc: dict = {"label": label, "dispatches": 0, "kernels": {}}
+        token = _BATCH.set(acc)
+        try:
+            yield acc
+        finally:
+            _BATCH.reset(token)
+            phase = "steady" if self._sealed else "warmup"
+            with self._lock:
+                self._batch_seq += 1
+                entry = {
+                    "seq": self._batch_seq,
+                    "label": label,
+                    "phase": phase,
+                    "dispatches": acc["dispatches"],
+                    "kernels": dict(acc["kernels"]),
+                }
+                self._batches.append(entry)
+                del self._batches[:-_BATCH_RING_CAP]
+            _BATCH_DISPATCHES.observe(float(acc["dispatches"]))
+
+    def last_batches(self, n: int = _BATCH_RING_CAP) -> list[dict]:
+        with self._lock:
+            return [dict(b) for b in self._batches[-n:]]
+
     def on_recompile(self, cb: Callable[[str, str], None], key: str = "default") -> None:
         """Register a (kernel, shape) callback fired on post-seal compiles.
         Keyed replace semantics: re-registration (a new Operator in the same
@@ -215,6 +262,10 @@ class KernelRegistry:
         cbs: tuple = ()
         recompiled = False
         override = _PHASE_OVERRIDE.get()
+        batch = _BATCH.get()
+        if batch is not None:
+            batch["dispatches"] += 1
+            batch["kernels"][kernel] = batch["kernels"].get(kernel, 0) + 1
         with self._lock:
             k = self._kernels.get(kernel)
             if k is None:
@@ -417,6 +468,7 @@ class KernelRegistry:
                 for k in self._kernels.values()
             ]
             table.sort(key=lambda d: (-d["execute_wall_s"], d["kernel"]))
+            recent = [dict(b) for b in self._batches[-16:]]
             out = {
                 "sealed": self._sealed,
                 "phase": self.phase,
@@ -425,6 +477,13 @@ class KernelRegistry:
                 ),
                 "recompile_events": list(self._recompile_events),
                 "device_memory": self._last_memory,
+                # per-batch device dispatch counts (one-dispatch-solve
+                # contract surface): cumulative per-kernel totals above
+                # can't show whether ONE batch stayed at <=1 dispatch
+                "batches": {
+                    "last": recent[-1] if recent else None,
+                    "recent": recent,
+                },
                 "kernels": table,
             }
         # AOT compile-service state (cache traffic, loaded executables,

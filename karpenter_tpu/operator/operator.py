@@ -86,6 +86,12 @@ class Operator:
         from karpenter_tpu import aot
 
         aot.configure_from_options(self.options)
+        # fused one-dispatch solve mode (ops/fused.py): the option wins
+        # over the KARPENTER_TPU_FUSED env default when set
+        if getattr(self.options, "fused_solve", ""):
+            from karpenter_tpu.ops import fused as fused_mod
+
+            fused_mod.FUSED_MODE = self.options.fused_solve
         # SLO engine + flight recorder (observability/slo.py, flight.py):
         # the process-global burn-rate evaluator follows this operator's
         # clock and objective set; the blackbox follows its clock and

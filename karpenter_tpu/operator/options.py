@@ -92,6 +92,10 @@ class Options:
     # seconds before a half-open probe re-admits it
     solverd_replica_breaker_threshold: int = 3
     solverd_replica_breaker_cooldown: float = 5.0
+    # fused one-dispatch solve (ops/fused.py): "off" never fuses, "on"
+    # fuses every eligible batch, "auto" (default) fuses only on non-CPU
+    # backends where dispatch round-trips dominate. env: KARPENTER_TPU_FUSED
+    fused_solve: str = ""
     # consolidation frontier search (controllers/disruption + ops/frontier):
     # how many levels of the binary-search decision tree one coalesced
     # simulate batch evaluates speculatively. 1 = the sequential probe
@@ -181,6 +185,11 @@ class Options:
         parser.add_argument("--solverd-replica-breaker-threshold", type=int)
         parser.add_argument("--solverd-replica-breaker-cooldown", type=float)
         parser.add_argument("--consolidation-frontier-depth", type=int)
+        parser.add_argument(
+            "--fused-solve", choices=["off", "auto", "on"],
+            help="one-dispatch fused FFD scan (default auto: fuse on "
+            "non-CPU backends; env KARPENTER_TPU_FUSED)",
+        )
         parser.add_argument("--compile-cache-dir")
         parser.add_argument("--aot-ladder")
         parser.add_argument("--slo-specs")

@@ -329,6 +329,36 @@ def sharded_cube(mesh):
     return fn
 
 
+def uid_project(uid_onehot, type_mask):
+    """surviving-unique-alloc projection: does ANY instance type in
+    `type_mask` map onto unique-allocatable row u? The float matmul counts
+    surviving types per row (small integers, exact) — the same MXU-shaped
+    trick as membership_all. Used inside the fused FFD scan
+    (packer._solve_scan_core) for claim-narrowing keep masks and
+    limits-narrowed opens; traceable (jnp) and host (np) alike.
+
+    uid_onehot: [U, I] bool — uid_of_type scattered one-hot
+    type_mask:  [..., I] bool
+    returns     [..., U] bool
+    """
+    if isinstance(type_mask, np.ndarray):
+        return (
+            type_mask.astype(np.float32) @ uid_onehot.astype(np.float32).T
+        ) > 0.5
+    return (
+        type_mask.astype(jnp.float32) @ uid_onehot.astype(jnp.float32).T
+    ) > 0.5
+
+
+def uid_onehot_matrix(uid_of_type: np.ndarray, num_uniq: int) -> np.ndarray:
+    """[U, I] bool one-hot of uid_of_type — the projection operand
+    uid_project consumes (built once per engine catalog)."""
+    I = uid_of_type.shape[0]
+    out = np.zeros((num_uniq, I), dtype=bool)
+    out[uid_of_type, np.arange(I)] = True
+    return out
+
+
 @jax.jit
 def offering_reduce(
     membership: jnp.ndarray,  # [P, R] bool

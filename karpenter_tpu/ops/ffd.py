@@ -127,6 +127,11 @@ def solver_cache_counters() -> dict:
         "device_fallbacks": DEVICE_FALLBACKS,
     }
     out.update(topo_counts.gate_counters())
+    # fused one-dispatch scan accounting (solves + decline taxonomy); lazy
+    # import keeps the ffd<->fused module cycle one-directional at import
+    from karpenter_tpu.ops import fused as _fused
+
+    out.update(_fused.fused_counters())
     return out
 
 
@@ -1591,6 +1596,54 @@ class _DeviceSolve:
 
     # -- new claims (addToNewNodeClaim, scheduler.go:478-556) ----------------
 
+    def _ensure_open_entry(self, ti: int, gi: int) -> tuple:
+        """Memoized LIMITLESS opening per (ti, gi): candidate set, fitting
+        unique-alloc rows, headroom matrix, and the no-limits minValues
+        outcome. Limits are applied per open as a cheap type-mask AND —
+        narrowing types never changes a surviving row's headroom, so the
+        limited open is a row-subset of the limitless one. Entries with
+        fam < 0 are permanent failures (error stashed in _open_errs).
+        Callers must have checked `_tg(ti, gi) is not None`. Shared by the
+        host walk's _new_claim and the fused builder's opening tables."""
+        okey = (ti, gi)
+        entry = self.open_cache.get(okey)
+        if entry is not None:
+            return entry
+        g = self.groups[gi]
+        joint_tg, rows = self._tg(ti, gi)
+        compat_v, offer_v = self._joint_masks(rows, joint_tg)
+        base = self.tmpl_mask[ti]
+        candidate0 = base & compat_v & offer_v
+        cand_u = np.unique(self.uid_of_type[candidate0])
+        rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
+        fitrows = (rem0 >= -_EPS).all(axis=1)
+        if not fitrows.any():
+            # no limits will ever fix an empty limitless set
+            err = self._filter_error(base, compat_v, offer_v, ti, g)
+            self.open_cache[okey] = entry = (-1, None, None, None, None, False)
+            self._open_errs[okey] = err
+            return entry
+        min_specs0, min_relaxed0, msg = self.tmpl_min[ti], False, None
+        if self.min_active and self.tmpl_min[ti]:
+            surv_u = np.zeros(self.U, dtype=bool)
+            surv_u[cand_u[fitrows]] = True
+            min_specs0, min_relaxed0, msg = self._min_open(
+                ti, candidate0 & surv_u[self.uid_of_type]
+            )
+        if msg is not None:
+            # strict-policy failure on the FULL set is permanent
+            err = self._filter_error(base, compat_v, offer_v, ti, g)
+            err.min_values_incompatible = msg
+            self.open_cache[okey] = entry = (-1, None, None, None, None, False)
+            self._open_errs[okey] = err
+            return entry
+        fam = self._intern_fam(rows, joint_tg)
+        self.open_cache[okey] = entry = (
+            fam, candidate0, cand_u[fitrows], rem0[fitrows],
+            min_specs0, min_relaxed0,
+        )
+        return entry
+
     def _new_claim(self, pod: Pod, g: _Group, gi: int) -> Optional[Exception]:
         cached = self.gnewclaim_err.get(gi)
         if cached is not None and cached[0] == self.limits_version:
@@ -1641,49 +1694,9 @@ class _DeviceSolve:
                     )
                 )
                 continue
-            # Memoized LIMITLESS opening per (ti, gi): candidate set, fitting
-            # unique-alloc rows, headroom matrix, and the no-limits minValues
-            # outcome. Limits are applied per open as a cheap type-mask AND —
-            # narrowing types never changes a surviving row's headroom, so
-            # the limited open is a row-subset of the limitless one.
-            okey = (ti, gi)
-            entry = self.open_cache.get(okey)
-            if entry is None:
-                joint_tg, rows = tg
-                compat_v, offer_v = self._joint_masks(rows, joint_tg)
-                base = self.tmpl_mask[ti]
-                candidate0 = base & compat_v & offer_v
-                cand_u = np.unique(self.uid_of_type[candidate0])
-                rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
-                fitrows = (rem0 >= -_EPS).all(axis=1)
-                if not fitrows.any():
-                    # no limits will ever fix an empty limitless set
-                    err = self._filter_error(base, compat_v, offer_v, ti, g)
-                    self.open_cache[okey] = entry = (-1, None, None, None, None, False)
-                    self._open_errs[okey] = err
-                else:
-                    min_specs0, min_relaxed0, msg = self.tmpl_min[ti], False, None
-                    if self.min_active and self.tmpl_min[ti]:
-                        surv_u = np.zeros(self.U, dtype=bool)
-                        surv_u[cand_u[fitrows]] = True
-                        min_specs0, min_relaxed0, msg = self._min_open(
-                            ti, candidate0 & surv_u[self.uid_of_type]
-                        )
-                    if msg is not None:
-                        # strict-policy failure on the FULL set is permanent
-                        err = self._filter_error(base, compat_v, offer_v, ti, g)
-                        err.min_values_incompatible = msg
-                        self.open_cache[okey] = entry = (
-                            -1, None, None, None, None, False,
-                        )
-                        self._open_errs[okey] = err
-                    else:
-                        fam = self._intern_fam(rows, joint_tg)
-                        self.open_cache[okey] = entry = (
-                            fam, candidate0, cand_u[fitrows], rem0[fitrows],
-                            min_specs0, min_relaxed0,
-                        )
+            entry = self._ensure_open_entry(ti, gi)
             fam, candidate0, u_ids0, rem0_fit0, min_specs, min_relaxed = entry
+            okey = (ti, gi)
             if fam < 0:
                 if limits_mask is None:
                     errs.append(self._open_errs[okey])
@@ -2164,6 +2177,8 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         DEVICE_FALLBACKS += 1
         _FALLBACKS_CTR.inc()
         return None
+    from karpenter_tpu.ops import fused as fused_mod
+
     topo = scheduler.topology
     strict_reserved = _strict_reserved(scheduler)
     if (
@@ -2177,13 +2192,21 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
         or strict_reserved
     ):
         attempts = [ffd_topo._TopoSolve]
+        if fused_mod.fused_enabled():
+            # the fused scan never drives the relax ladder / volatile paths
+            fused_mod.note_decline("topo")
     else:
-        # plain driver first (native kernel); shapes it declines that only
-        # need the relax ladder (preferred/multi-term node affinity) retry
-        # on the topo driver, which relaxes exactly like the host
-        attempts = [_DeviceSolve, ffd_topo._TopoSolve]
+        # fused one-dispatch scan first (when enabled), then the plain
+        # driver (native kernel); shapes it declines that only need the
+        # relax ladder (preferred/multi-term node affinity) retry on the
+        # topo driver, which relaxes exactly like the host
+        attempts = list(fused_mod.maybe_attempts(scheduler)) + [
+            _DeviceSolve,
+            ffd_topo._TopoSolve,
+        ]
     done = False
-    for cls in attempts:
+    for idx, cls in enumerate(attempts):
+        last = idx == len(attempts) - 1
         solve = None
         try:
             solve = cls(scheduler, pods)
@@ -2191,9 +2214,16 @@ def solve_device(scheduler, pods: Sequence[Pod], timeout: Optional[float] = 60.0
             solve.emit()
             done = True
             break
+        except fused_mod._FusedDecline:
+            # not scan-shaped — the host-walk drivers are the designed slow
+            # path (the decline is already metered by taxonomy reason)
+            solve.abort()
+            if not last:
+                continue
+            break
         except _IneligibleShape:
             solve.abort()
-            if cls is _DeviceSolve:
+            if not last:
                 continue
             break
         except _Fallback:

@@ -23,12 +23,14 @@ host oracle, never looser (ops/feasibility.quantize_resources).
 from __future__ import annotations
 
 import functools
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 try:
     from jax import shard_map
 
@@ -338,6 +340,416 @@ class GroupSolver:
             out[:G, 2],
             out[:G, 3],
         )
+
+
+# -- the fused FFD scan (the one-dispatch solve) ------------------------------
+#
+# `_solve_scan` is the monotone FFD scan itself — the host walk's queue,
+# emptiest-first claim heap, existing-node scan pointers, claim opening and
+# nodepool-limit tracking — reformulated as ONE `lax.while_loop` over the
+# count tensors, requirement-family transition tables, and per-claim
+# headroom matrices the host builders precompute (ops/fused.py). A steady
+# admitted batch therefore executes as ONE device dispatch; the host walk
+# remains the semantics oracle and the slow-path fallback.
+#
+# Decision parity is bit-for-bit: every float comparison runs in float64
+# (dispatches are wrapped in `scan_x64()`), subtractions happen per join in
+# the host's exact order, and the comparison forms are chosen so they are
+# EQUAL to the host's (e.g. the node-capacity gate `int((have+eps)//v) >= 1`
+# is equivalent, over the reals the exact Python floordiv computes, to
+# `have+eps >= v`). Claim selection reproduces the host heap's
+# (count, rank, claim-index) order as an argmin over a packed int64 key.
+
+SCAN_OK = 0
+SCAN_CLAIM_OVERFLOW = 1
+SCAN_QUEUE_OVERFLOW = 2
+
+_KIND_REJECT, _KIND_SAME, _KIND_NARROW = 0, 1, 2
+_SCAN_EPS = 1e-9
+
+
+@contextmanager
+def scan_x64():
+    """Scope the fused scan's trace/dispatch under 64-bit mode: the host
+    oracle packs/compares float64 and the parity bar is bit-for-bit, so the
+    scan must run real f64 on device. Scoped (never global) so every other
+    kernel keeps its existing f32/int32 avals, executables, and digests."""
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        yield
+
+
+def _scan_key(count, rank, ci):
+    """The host heap key (count, rank, ci) packed into one int64: count and
+    rank are bounded by the queue length (< 2**20), ci by the claim bucket
+    (< 2**18), so the packing is order-isomorphic to the tuple."""
+    return (
+        count.astype(jnp.int64) * jnp.int64(1 << 39)
+        + (rank.astype(jnp.int64) + jnp.int64(1 << 20)) * jnp.int64(1 << 18)
+        + ci.astype(jnp.int64)
+    )
+
+
+# python int (NOT a jnp scalar): int64 avals only exist inside scan_x64(),
+# so the constant must stay weakly typed until trace time
+_SCAN_KEY_MAX = 1 << 62
+
+
+def _solve_scan_core(cfg: tuple, args: tuple):
+    """The while_loop program. `cfg` is the static trace config
+    (T, has_nodes, has_limits); `args` the array operands (see
+    fused.py's builder for the full layout contract)."""
+    T, has_nodes, has_limits = cfg
+    (
+        pod_gi,      # [P] i32 — group per pod, host queue order (pad -1)
+        claim_pad,   # [C] i32 — shape-only: the claim-axis bucket (content
+                     # ignored; an explicit arg so the AOT/observatory shape
+                     # signature distinguishes claim capacities)
+        g_req,       # [G, D] f64
+        g_floor,     # [G, D] f64 — req - 1e-9 (the host fit threshold)
+        uniq_alloc,  # [U, D] f64
+        usage0,      # [T, D] f64 — daemonset overhead per template
+        tol,         # [T, G] bool
+        open_ok,     # [T, G] bool — compat ∧ limitless-fit ∧ opening allowed
+        open_fam,    # [T, G] i32
+        open_uok,    # [T, G, U] bool — limitless fitting unique-alloc rows
+        trans_kind,  # [F, G] i8
+        trans_fam,   # [F, G] i32 (REJECT rows pinned to 0)
+        famu_ok,     # [T, F, U] bool — uid survives tmpl ∧ fam masks
+        n_pods,      # () i32
+        n_nodes,     # () i32
+        node_ok,     # [N, G] bool   (has_nodes)
+        node_rem0,   # [N, D] f64    (has_nodes)
+        fam_mask,    # [F, I] bool   (has_limits)
+        tmpl_mask,   # [T, I] bool   (has_limits)
+        open_cand,   # [T, G, I] bool (has_limits)
+        uid_onehot,  # [U, I] bool   (has_limits)
+        uid_of_type, # [I] i32       (has_limits)
+        cap_f,       # [I, D] f64    (has_limits)
+        pool_of_t,   # [T] i32       (has_limits; -1 = unlimited)
+        pool_rem0,   # [L, D] f64    (has_limits)
+        pool_has,    # [L, D] bool   (has_limits)
+        pool_bad,    # [L] bool      (has_limits)
+    ) = args
+    P = pod_gi.shape[0]
+    G, D = g_req.shape
+    U = uniq_alloc.shape[0]
+    i32 = jnp.int32
+
+    def fresh_cfit_row(ti, fam, uv, rem_row, tm_row):
+        """cfit[c, :] — 'some valid headroom row of claim c fits group g and
+        the requirement transition admits g' — recomputed whenever claim c
+        changes. Must equal exactly the per-join keep∧fit evaluation."""
+        kindg = trans_kind[fam]            # [G]
+        f2g = trans_fam[fam]               # [G]
+        if has_limits:
+            new_tm = fam_mask[f2g] & tm_row[None, :]          # [G, I]
+            keep = feas.uid_project(uid_onehot, new_tm)       # [G, U]
+        else:
+            keep = famu_ok[ti][f2g]                           # [G, U]
+        keep = keep & uv[None, :]
+        fits = jnp.all(
+            rem_row[None, :, :] >= g_floor[:, None, :], axis=-1
+        )                                                     # [G, U]
+        return (kindg != _KIND_REJECT) & tol[ti] & jnp.any(keep & fits, axis=-1)
+
+    def body(st):
+        (
+            head, tail, stop, abort, seqc, done, nclaims,
+            queue, last_len, pod_claim, pod_node, pod_seq,
+            claim_ti, claim_fam, claim_count, claim_key,
+            u_valid, rem, cfit, nptr, node_rem, tm_st, pool_rem,
+        ) = st
+        pod = queue[head]
+        g = pod_gi[pod]
+        stop_now = last_len[pod] == (tail - head)
+
+        # -- existing-node scan (host _try_nodes) --
+        if has_nodes:
+            N = node_ok.shape[0]
+            live_n = jnp.arange(N, dtype=i32) >= nptr[g]
+            fit_n = jnp.all(
+                jnp.where(
+                    g_req[g][None, :] > 0,
+                    node_rem + _SCAN_EPS >= g_req[g][None, :],
+                    True,
+                ),
+                axis=-1,
+            )
+            cand_n = live_n & (jnp.arange(N, dtype=i32) < n_nodes) & node_ok[:, g] & fit_n
+            any_node = jnp.any(cand_n)
+            jn = jnp.argmax(cand_n).astype(i32)
+        else:
+            any_node = jnp.bool_(False)
+            jn = i32(0)
+
+        # -- in-flight claims, emptiest first (host _try_claims) --
+        live_c = jnp.arange(claim_key.shape[0], dtype=i32) < nclaims
+        cand_c = cfit[:, g] & live_c
+        any_claim = (~any_node) & jnp.any(cand_c)
+        ci = jnp.argmin(jnp.where(cand_c, claim_key, _SCAN_KEY_MAX)).astype(i32)
+        c_ti = claim_ti[ci]
+        f2 = trans_fam[claim_fam[ci], g]
+        if has_limits:
+            new_tm = tm_st[ci] & fam_mask[f2]                 # [I]
+            keep_u = feas.uid_project(uid_onehot, new_tm)
+        else:
+            new_tm = None
+            keep_u = famu_ok[c_ti, f2]
+        keep_u = keep_u & u_valid[ci]
+        fit_u = keep_u & jnp.all(rem[ci] >= g_floor[g][None, :], axis=-1)
+
+        # -- open a new claim (host _new_claim, template order) --
+        want_open = (~any_node) & (~any_claim)
+        sel_ti = i32(-1)
+        sel_uv = jnp.zeros((U,), dtype=bool)
+        sel_tm = jnp.zeros((tm_st.shape[1],), dtype=bool) if has_limits else None
+        sel_lim = jnp.bool_(False)
+        sel_sub = (
+            jnp.zeros((pool_rem.shape[0], D)) if has_limits else None
+        )
+        for ti in range(T):
+            ok_t = open_ok[ti, g] & tol[ti, g]
+            if has_limits:
+                pool = pool_of_t[ti]
+                limited = pool >= 0
+                pl = jnp.maximum(pool, 0)
+                lm = jnp.all(
+                    jnp.where(
+                        pool_has[pl][None, :],
+                        cap_f <= pool_rem[pl][None, :] + _SCAN_EPS,
+                        True,
+                    ),
+                    axis=-1,
+                ) & ~pool_bad[pl]                             # [I]
+                any_left = jnp.any(lm & tmpl_mask[ti])
+                cand_t = open_cand[ti, g] & lm
+                live_u = feas.uid_project(uid_onehot, cand_t)
+                uv_t = open_uok[ti, g] & jnp.where(limited, live_u, True)
+                ok_t = ok_t & jnp.where(
+                    limited, any_left & jnp.any(uv_t), True
+                )
+                tm_t = jnp.where(limited, cand_t, open_cand[ti, g])
+                # host _subtract_max: max capacity over the claim's narrowed
+                # option set, subtracted from the pool's tracked dims
+                surv_types = uv_t[uid_of_type]
+                sub_mask = tm_t & surv_types
+                maxes = jnp.max(
+                    jnp.where(sub_mask[:, None], cap_f, -jnp.inf), axis=0
+                )
+                maxes = jnp.where(jnp.any(sub_mask), maxes, 0.0)
+                sub = (
+                    jnp.zeros_like(pool_rem)
+                    .at[pl]
+                    .add(jnp.where(pool_has[pl] & limited, maxes, 0.0))
+                )
+            else:
+                uv_t = open_uok[ti, g]
+                tm_t = None
+                limited = jnp.bool_(False)
+                sub = None
+            take = want_open & ok_t & (sel_ti < 0)
+            sel_ti = jnp.where(take, i32(ti), sel_ti)
+            sel_uv = jnp.where(take, uv_t, sel_uv)
+            if has_limits:
+                sel_tm = jnp.where(take, tm_t, sel_tm)
+                sel_lim = jnp.where(take, limited, sel_lim)
+                sel_sub = jnp.where(take, sub, sel_sub)
+        do_open = want_open & (sel_ti >= 0)
+        overflow_c = do_open & (nclaims >= jnp.int32(claim_key.shape[0]))
+        do_open = do_open & ~overflow_c
+
+        placed = any_node | any_claim | do_open
+        failed = (~placed) & (~stop_now)
+
+        # -- commit (all branches merge via row-targeted writes) --
+        frozen = stop_now
+        adv = ~frozen
+
+        # node commit: the host scan pointer lands on the joined node, or
+        # past the end when the scan exhausts (both permanent — monotone)
+        if has_nodes:
+            nrow = jnp.where(
+                any_node & adv, node_rem[jn] - g_req[g], node_rem[jn]
+            )
+            node_rem = lax.dynamic_update_slice(
+                node_rem, nrow[None, :], (jn, i32(0))
+            )
+            nptr = nptr.at[g].set(
+                jnp.where(adv, jnp.where(any_node, jn, n_nodes), nptr[g])
+            )
+
+        # claim join/open commit: one target row
+        row = jnp.where(any_claim, ci, jnp.where(do_open, nclaims, i32(0)))
+        row = jnp.minimum(row, jnp.int32(claim_key.shape[0] - 1))
+        touch = (any_claim | do_open) & adv
+        seq2 = jnp.where(touch, seqc + 1, seqc)
+        open_rem = uniq_alloc - (usage0[jnp.maximum(sel_ti, 0)] + g_req[g])[None, :]
+        new_rem = jnp.where(
+            any_claim & adv,
+            rem[row] - g_req[g][None, :],
+            jnp.where(do_open & adv, open_rem, rem[row]),
+        )
+        new_uv = jnp.where(
+            any_claim & adv,
+            fit_u,
+            jnp.where(do_open & adv, sel_uv, u_valid[row]),
+        )
+        new_ti = jnp.where(do_open & adv, sel_ti, claim_ti[row])
+        new_fam = jnp.where(
+            any_claim & adv,
+            f2,
+            jnp.where(do_open & adv, open_fam[jnp.maximum(sel_ti, 0), g], claim_fam[row]),
+        )
+        new_count = jnp.where(
+            any_claim & adv,
+            claim_count[row] + 1,
+            jnp.where(do_open & adv, i32(1), claim_count[row]),
+        )
+        new_rank = jnp.where(
+            any_claim & adv,
+            -seq2,
+            jnp.where(do_open & adv, seq2, i32(0)),
+        )
+        new_key = jnp.where(
+            touch,
+            _scan_key(new_count, new_rank, row),
+            claim_key[row],
+        )
+        rem = lax.dynamic_update_slice(rem, new_rem[None], (row, i32(0), i32(0)))
+        u_valid = lax.dynamic_update_slice(u_valid, new_uv[None], (row, i32(0)))
+        claim_ti = claim_ti.at[row].set(new_ti)
+        claim_fam = claim_fam.at[row].set(new_fam)
+        claim_count = claim_count.at[row].set(new_count)
+        claim_key = claim_key.at[row].set(new_key)
+        if has_limits:
+            new_tm_row = jnp.where(
+                any_claim & adv,
+                new_tm,
+                jnp.where(do_open & adv, sel_tm, tm_st[row]),
+            )
+            tm_st = lax.dynamic_update_slice(
+                tm_st, new_tm_row[None], (row, i32(0))
+            )
+            pool_rem = jnp.where(do_open & adv, pool_rem - sel_sub, pool_rem)
+        nclaims = jnp.where(do_open & adv, nclaims + 1, nclaims)
+        # cfit row refresh for the touched claim (a pure function of the
+        # row's state, so refreshing an untouched row 0 is a no-op)
+        cfit_row = fresh_cfit_row(
+            claim_ti[row], claim_fam[row], u_valid[row], rem[row],
+            tm_st[row] if has_limits else None,
+        )
+        cfit = lax.dynamic_update_slice(cfit, cfit_row[None], (row, i32(0)))
+
+        # pod bookkeeping
+        head2 = jnp.where(adv, head + 1, head)
+        done2 = jnp.where(placed & adv, done + 1, done)
+        pod_claim = pod_claim.at[pod].set(
+            jnp.where(any_claim & adv, ci, jnp.where(do_open & adv, row, i32(-1)))
+        )
+        pod_node = pod_node.at[pod].set(
+            jnp.where(any_node & adv, jn, i32(-1)) if has_nodes else i32(-1)
+        )
+        pod_seq = pod_seq.at[pod].set(
+            jnp.where(placed & adv, done, pod_seq[pod])
+        )
+        # failure: requeue + cycle-detection bookkeeping (host: append, then
+        # last_len[pod] = len(queue) - head)
+        overflow_q = failed & (tail >= jnp.int32(queue.shape[0]))
+        queue = queue.at[jnp.minimum(tail, jnp.int32(queue.shape[0] - 1))].set(
+            jnp.where(failed & ~overflow_q, pod, queue[jnp.minimum(tail, jnp.int32(queue.shape[0] - 1))])
+        )
+        tail2 = jnp.where(failed & ~overflow_q, tail + 1, tail)
+        last_len = last_len.at[pod].set(
+            jnp.where(failed & adv, tail2 - head2, last_len[pod])
+        )
+        abort2 = jnp.where(
+            overflow_c, i32(SCAN_CLAIM_OVERFLOW),
+            jnp.where(overflow_q, i32(SCAN_QUEUE_OVERFLOW), abort),
+        )
+        stop2 = stop | stop_now
+        return (
+            head2, tail2, stop2, abort2, seq2, done2, nclaims,
+            queue, last_len, pod_claim, pod_node, pod_seq,
+            claim_ti, claim_fam, claim_count, claim_key,
+            u_valid, rem, cfit, nptr, node_rem, tm_st, pool_rem,
+        )
+
+    def cond(st):
+        head, tail, stop, abort = st[0], st[1], st[2], st[3]
+        return (head < tail) & (~stop) & (abort == SCAN_OK)
+
+    Qcap = 4 * P + 64
+    C = claim_pad.shape[0]
+    i32a = lambda n, v=0: jnp.full((n,), v, dtype=i32)  # noqa: E731
+    I = tmpl_mask.shape[1] if has_limits else 1
+    init_queue = jnp.concatenate(
+        [jnp.arange(P, dtype=i32), i32a(Qcap - P, 0)]
+    )
+    st0 = (
+        i32(0), n_pods.astype(i32), jnp.bool_(False), i32(SCAN_OK),
+        i32(0), i32(0), i32(0),
+        init_queue, i32a(P, -1), i32a(P, -1), i32a(P, -1), i32a(P, -1),
+        i32a(C, 0), i32a(C, 0), i32a(C, 0),
+        jnp.full((C,), _SCAN_KEY_MAX, dtype=jnp.int64),
+        jnp.zeros((C, U), dtype=bool), jnp.zeros((C, U, D)),
+        jnp.zeros((C, G), dtype=bool), i32a(G, 0),
+        node_rem0 if has_nodes else jnp.zeros((1, D)),
+        jnp.zeros((C, I), dtype=bool),
+        pool_rem0 if has_limits else jnp.zeros((1, D)),
+    )
+    out = lax.while_loop(cond, body, st0)
+    (
+        head, tail, stop, abort, seqc, done, nclaims,
+        queue, last_len, pod_claim, pod_node, pod_seq,
+        claim_ti, claim_fam, claim_count, claim_key,
+        u_valid, rem, cfit, nptr, node_rem, tm_st, pool_rem,
+    ) = out
+    return (
+        abort, nclaims, pod_claim, pod_node, pod_seq,
+        claim_ti, claim_fam, u_valid, tm_st, pool_rem,
+    )
+
+
+# One jitted scan per static trace config (template count, node/limits
+# variants) — shared across engines and with the AOT warm-start walk.
+_SOLVE_SCAN_FNS: dict[tuple, object] = {}
+_SHARDED_SCAN_FNS: dict[tuple, object] = {}
+
+
+def solve_scan_fn(T: int, has_nodes: bool, has_limits: bool):
+    cfg = (T, bool(has_nodes), bool(has_limits))
+    fn = _SOLVE_SCAN_FNS.get(cfg)
+    if fn is None:
+        fn = jax.jit(lambda *args: _solve_scan_core(cfg, args))
+        _SOLVE_SCAN_FNS[cfg] = fn
+    return fn
+
+
+def sharded_solve_scan(mesh: Mesh, T: int, has_nodes: bool, has_limits: bool):
+    """Mesh twin of the fused scan. The scan is control-flow bound (a
+    sequential while_loop), so the mesh twin REPLICATES: every chip runs
+    the identical program on replicated operands and the (identical)
+    result is taken at emit — mesh engines keep the one-dispatch contract
+    with zero cross-chip traffic, and the merge-at-emit contract is
+    trivially preserved (all shards already agree)."""
+    cfg = (T, bool(has_nodes), bool(has_limits))
+    key = (mesh,) + cfg
+    fn = _SHARDED_SCAN_FNS.get(key)
+    if fn is None:
+        n_args = 27
+        fn = jax.jit(
+            shard_map(
+                lambda *args: _solve_scan_core(cfg, args),
+                mesh=mesh,
+                in_specs=tuple(P() for _ in range(n_args)),
+                out_specs=tuple(P() for _ in range(10)),
+                **_SHARD_MAP_UNCHECKED,
+            )
+        )
+        _SHARDED_SCAN_FNS[key] = fn
+    return fn
 
 
 def scatter_add_counts(

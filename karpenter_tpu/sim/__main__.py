@@ -64,6 +64,12 @@ def main(argv=None) -> int:
         "CPU dryrun: XLA_FLAGS=--xla_force_host_platform_device_count=8)",
     )
     parser.add_argument(
+        "--fused-solve", choices=["off", "auto", "on"], default="",
+        help="one-dispatch fused FFD scan (ops/fused.py): on = every "
+        "eligible batch is ONE device dispatch; default auto fuses only "
+        "on non-CPU backends (env KARPENTER_TPU_FUSED)",
+    )
+    parser.add_argument(
         "--flight-dir",
         default="",
         help="flight-recorder bundle directory: SLO breaches during the "
@@ -99,6 +105,10 @@ def main(argv=None) -> int:
         with open(args.dump_trace, "w", encoding="utf-8") as f:
             f.write(tracemod.dumps(trace) + "\n")
 
+    if args.fused_solve:
+        from karpenter_tpu.ops import fused as fused_mod
+
+        fused_mod.FUSED_MODE = args.fused_solve
     options = None
     if (
         args.compile_cache_dir

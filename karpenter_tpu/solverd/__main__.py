@@ -79,8 +79,17 @@ def main(argv=None) -> int:
         help="AOT shape-bucket ladder: 'default', a JSON ladder file, or "
         "'off' (a --compile-cache-dir implies 'default')",
     )
+    parser.add_argument(
+        "--fused-solve", choices=["off", "auto", "on"], default="",
+        help="one-dispatch fused FFD scan (default auto: fuse on non-CPU "
+        "backends; env KARPENTER_TPU_FUSED)",
+    )
     parser.add_argument("--log-level", default="info")
     ns = parser.parse_args(argv)
+    if ns.fused_solve:
+        from karpenter_tpu.ops import fused as _fused_mod
+
+        _fused_mod.FUSED_MODE = ns.fused_solve
     klog.configure(ns.log_level)
     log = klog.logger("solverd")
 

@@ -140,6 +140,7 @@ class SolverService:
         self.deduped = 0
         self.max_batch_size = 0
         self.last_batch_seconds = 0.0
+        self.last_batch_dispatches = 0
 
     # -- client surface ------------------------------------------------------
 
@@ -357,8 +358,16 @@ class SolverService:
             self.batches += 1
             self.max_batch_size = max(self.max_batch_size, len(ready))
         started = time.perf_counter()
+        from karpenter_tpu.observability import kernels as kobs
+
         try:
-            self.coalescer.execute(ready)
+            # per-batch device dispatch accounting: the one-dispatch-solve
+            # contract's runtime proof surface (/debug/kernels "batches")
+            with kobs.registry().batch_scope(
+                label=f"solverd:{len(ready)}"
+            ) as batch_acc:
+                self.coalescer.execute(ready)
+            self.last_batch_dispatches = batch_acc["dispatches"]
         finally:
             for entry in ready:
                 if entry.result is None and entry.error is None:
@@ -432,6 +441,7 @@ class SolverService:
                 "deduped": self.deduped,
                 "max_batch_size": self.max_batch_size,
                 "last_batch_seconds": self.last_batch_seconds,
+                "last_batch_dispatches": self.last_batch_dispatches,
             }
         return {
             "transport": "inprocess",

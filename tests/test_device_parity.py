@@ -127,7 +127,8 @@ TIERS = ["gold", "silver", "bronze"]
 
 
 def _random_nodepools(
-    rng: random.Random, topo: bool = False, best_effort: bool = False
+    rng: random.Random, topo: bool = False, best_effort: bool = False,
+    fused: bool = False,
 ):
     pools = []
     for i in range(rng.randint(1, 3)):
@@ -154,7 +155,7 @@ def _random_nodepools(
                     "values": rng.sample(ZONES, rng.randint(1, 2)),
                 }
             )
-        if rng.random() < (0.85 if best_effort else 0.25):
+        if rng.random() < (0.0 if fused else 0.85 if best_effort else 0.25):
             # strict-policy minValues (device-supported since round 4):
             # diversity gates reject joins as claims narrow. BestEffort mode
             # amps both frequency and magnitude so many opens actually
@@ -175,9 +176,10 @@ def _random_nodepools(
         taints = []
         if rng.random() < 0.25:
             taints.append(Taint(key="team", value="infra", effect="NoSchedule"))
-        if rng.random() < 0.12:
+        if rng.random() < 0.12 and not fused:
             # engages the relax ladder's wildcard-toleration rung for the
-            # whole solve (routes to the topo driver)
+            # whole solve (routes to the topo driver; the fused generator
+            # skips it — the one-dispatch scan declines topo-routed solves)
             taints.append(Taint(key="soft", value="lane", effect="PreferNoSchedule"))
         limits = None
         if rng.random() < 0.3:
@@ -316,7 +318,9 @@ def _random_node_affinity(rng: random.Random) -> Affinity:
     return Affinity(node_affinity=na)
 
 
-def _random_shape(rng: random.Random, si: int, topo: bool = False):
+def _random_shape(
+    rng: random.Random, si: int, topo: bool = False, fused: bool = False
+):
     kwargs = {"requests": {"cpu": rng.choice(CPUS), "memory": rng.choice(MEMS)}}
     if topo:
         own_app = rng.choice(APPS)
@@ -355,12 +359,16 @@ def _random_shape(rng: random.Random, si: int, topo: bool = False):
         selector[wk.LABEL_TOPOLOGY_ZONE] = rng.choice(ZONES)
     if roll > 0.9:
         selector[wk.LABEL_OS] = rng.choice(OSES)
-    if roll > 0.97:
+    if roll > 0.97 and not fused:
+        # seeded nodes carry no capacity-type label: a ct-selecting group
+        # would make the node requirement state narrowable, which the fused
+        # scan's static node tables decline — keep the fused generator
+        # inside the scan-shaped class so its fallback assert stays at zero
         selector[wk.CAPACITY_TYPE_LABEL_KEY] = rng.choice(
             [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
         )
     hostname_pin = None
-    if rng.random() < 0.06:
+    if rng.random() < 0.06 and not fused:
         # hostname pins: an existing node's name (joins it if feasible), a
         # bogus name (per-template compat errors embedding the consumed
         # placeholder strings), or a NotIn row (satisfied by any placeholder)
@@ -422,6 +430,7 @@ def build_case(
     reserved: bool = False,
     cluster: bool = False,
     best_effort: bool = False,
+    fused: bool = False,
 ):
     """(node_pools, state_nodes, bound_pods, daemonset_pods, build_pods)."""
     rng = random.Random(
@@ -430,14 +439,18 @@ def build_case(
         else seed + 2_000_000
         if reserved
         else seed + 3_000_000
-        if cluster
+        if cluster and not fused
         else seed + 4_000_000
         if best_effort and not topo
         else seed + 5_000_000
         if best_effort
+        else seed + 6_000_000
+        if fused and not cluster
+        else seed + 7_000_000
+        if fused
         else seed
     )
-    pools = _random_nodepools(rng, topo, best_effort)
+    pools = _random_nodepools(rng, topo, best_effort, fused)
     nodes = []
     bound = []
     # cluster mode: a steady-state fleet — most pods join EXISTING nodes,
@@ -507,7 +520,10 @@ def build_case(
         ds = daemonset(requests={"cpu": "100m", "memory": "64Mi"})
         ds_pods.append(daemonset_pod(ds))
     n_pods = rng.randint(ffd.DEVICE_MIN_PODS, 320)
-    shapes = [_random_shape(rng, si, topo) for si in range(rng.randint(3, 24))]
+    shapes = [
+        _random_shape(rng, si, topo, fused)
+        for si in range(rng.randint(3, 24))
+    ]
     if topo and not any(s[0].get("topology_spread_constraints") for s in shapes):
         shapes[0][0]["topology_spread_constraints"] = [_random_spread(rng)]
     picks = [rng.randrange(len(shapes)) for _ in range(n_pods)]
@@ -622,14 +638,17 @@ def run_case(
     strict: bool = False,
     best_effort: bool = False,
     mesh_devices: int = 0,
+    fused: bool = False,
 ):
     """Returns (host_decisions, device_decisions, device_ran). With
     `mesh_devices` >= 1 the device engine carries an N-device mesh, so the
     sweep runs through the `_sharded` kernels — the host oracle must still
-    match bit-for-bit at every mesh size."""
+    match bit-for-bit at every mesh size. With `fused` the device leg runs
+    with the one-dispatch scan forced ON (ops/fused.py) — the sequential
+    host walk stays the oracle."""
     reserved = reserved or strict
     pools, nodes, bound, ds_pods, build_pods = build_case(
-        seed, topo, reserved, cluster, best_effort
+        seed, topo, reserved, cluster, best_effort, fused
     )
     catalog = reserved_catalog() if reserved else CATALOG
     extra = {"reserved_offering_mode": "Strict"} if strict else {}
@@ -656,6 +675,11 @@ def run_case(
     solves0 = ffd.DEVICE_SOLVES
     old_strict = ffd.STRICT
     ffd.STRICT = True
+    from karpenter_tpu.ops import fused as fused_mod
+
+    old_fused = fused_mod.FUSED_MODE
+    if fused:
+        fused_mod.FUSED_MODE = "on"
     ncmod._hostname_counter = itertools.count(1)
     mesh = None
     if mesh_devices:
@@ -670,6 +694,7 @@ def run_case(
         )
     finally:
         ffd.STRICT = old_strict
+        fused_mod.FUSED_MODE = old_fused
     return host, dev, ffd.DEVICE_SOLVES > solves0
 
 
@@ -802,6 +827,64 @@ class TestDeviceParity:
         assert ran, "mesh+topo device path unexpectedly fell back"
 
 
+class TestFusedParity:
+    """One-dispatch solve (ops/fused.py + packer._solve_scan): sequential
+    host oracle vs the device-resident scan on twin seeded envs. The fused
+    generator keeps cases inside the scan-shaped class (no minValues, no
+    PreferNoSchedule, no hostname pins, no capacity-type selectors against
+    label-less nodes), so the fallback assert is exact: every seed must
+    execute as a fused dispatch — 0 divergences, 0 unexpected fallbacks."""
+
+    def _run(self, seed, **kw):
+        from karpenter_tpu.ops import fused as fused_mod
+
+        f0 = fused_mod.FUSED_SOLVES
+        d0 = dict(fused_mod.FUSED_DECLINES)
+        host, dev, ran = run_case(seed, fused=True, **kw)
+        delta = {
+            k: v - d0.get(k, 0)
+            for k, v in fused_mod.FUSED_DECLINES.items()
+            if v != d0.get(k, 0)
+        }
+        return host, dev, ran, fused_mod.FUSED_SOLVES - f0, delta
+
+    @pytest.mark.parametrize("seed", range(15))
+    def test_fused_decision_parity(self, seed):
+        host, dev, ran, fused_n, declines = self._run(seed)
+        assert host == dev
+        assert ran, "device path fell back to the host loop"
+        assert fused_n == 1, f"fused scan unexpectedly fell back: {declines}"
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_fused_cluster_decision_parity(self, seed):
+        """Steady-state fleet shape: existing nodes with seeded usage —
+        the scan's node pointer phase — still ONE dispatch per batch."""
+        host, dev, ran, fused_n, declines = self._run(seed, cluster=True)
+        assert host == dev
+        assert ran, "device path fell back to the host loop"
+        assert fused_n == 1, f"fused scan unexpectedly fell back: {declines}"
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fusedtopo_declines_with_parity(self, seed):
+        """Topology-engaged solves with the fused path ON: the scan must
+        decline (metered `topo`, never a crash or a wrong answer) and the
+        topo driver must still match the host exactly."""
+        host, dev, ran, fused_n, declines = self._run(seed, topo=True)
+        assert host == dev
+        assert ran
+        assert fused_n == 0
+        assert set(declines) <= {"topo", "min"}, declines
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fusedmesh_decision_parity(self, seed):
+        """The fused scan's mesh twin (replicated shard_map) at mesh size
+        8: one dispatch, decisions bit-identical to the host oracle."""
+        host, dev, ran, fused_n, declines = self._run(seed, mesh_devices=8)
+        assert host == dev
+        assert ran
+        assert fused_n == 1, f"fused mesh scan fell back: {declines}"
+
+
 def main(
     n_cases: int,
     topo: bool = False,
@@ -810,6 +893,7 @@ def main(
     strict: bool = False,
     best_effort: bool = False,
     mesh_devices: int = 0,
+    fused: bool = False,
 ) -> int:
     failures = 0
     fallbacks = 0
@@ -822,7 +906,10 @@ def main(
         if topo and best_effort
         else "besteffort"
         if best_effort
+        else "fusedtopo" if fused and topo
         else "topo" if topo else "reserved" if reserved else
+        "fusedcluster" if fused and cluster else
+        "fused" if fused else
         "cluster" if cluster else "plain"
     )
     if mesh_devices:
@@ -830,7 +917,7 @@ def main(
     for seed in range(n_cases):
         host, dev, ran = run_case(
             seed, topo, reserved, cluster, strict, best_effort,
-            mesh_devices=mesh_devices,
+            mesh_devices=mesh_devices, fused=fused,
         )
         if host != dev:
             failures += 1
@@ -873,4 +960,12 @@ if __name__ == "__main__":
         rc |= main(n, topo=True, mesh_devices=8)
     if mode in ("betopo", "all"):
         rc |= main(n, topo=True, best_effort=True)
+    if mode in ("fused", "all"):
+        rc |= main(n, fused=True)
+    if mode in ("fusedcluster", "all"):
+        rc |= main(n, cluster=True, fused=True)
+    if mode in ("fusedtopo", "all"):
+        rc |= main(n, topo=True, fused=True)
+    if mode in ("fusedmesh", "all"):
+        rc |= main(n, fused=True, mesh_devices=8)
     sys.exit(rc)

@@ -1,0 +1,267 @@
+"""One-dispatch solve unit specs (ops/fused.py + packer._solve_scan).
+
+Decision parity lives in tests/test_device_parity.py's `fused*` classes;
+this file covers the machinery around the scan: the decline taxonomy and
+its metering, the post-dispatch abort → host-walk fallback, the AOT
+fused-scan rungs (warm start → zero-compile serve), the per-batch dispatch
+accounting on /debug/kernels, and the solverd stats surface."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.apis.core import (
+    Condition,
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.observability import kernels as kobs
+from karpenter_tpu.ops import ffd
+from karpenter_tpu.ops import fused
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.utils.resources import parse_resource_list
+
+from helpers import nodepool
+from test_scheduler import Env
+
+CATALOG = construct_instance_types()
+
+
+def plain_pods(n: int = 128, cpus=("250m", "500m", "1", "2")):
+    pods = []
+    for i in range(n):
+        p = Pod(
+            metadata=ObjectMeta(name=f"fu-{i:05d}", uid=f"fu-uid-{i:05d}"),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        requests=parse_resource_list(
+                            {"cpu": cpus[i % len(cpus)], "memory": "512Mi"}
+                        )
+                    )
+                ]
+            ),
+        )
+        p.metadata.creation_timestamp = 0.0
+        p.status.conditions.append(
+            Condition(type="PodScheduled", status="False", reason="Unschedulable")
+        )
+        pods.append(p)
+    return pods
+
+
+@pytest.fixture
+def fused_on():
+    old = fused.FUSED_MODE
+    fused.FUSED_MODE = "on"
+    yield
+    fused.FUSED_MODE = old
+
+
+def decline_delta(before: dict) -> dict:
+    return {
+        k: v - before.get(k, 0)
+        for k, v in fused.FUSED_DECLINES.items()
+        if v != before.get(k, 0)
+    }
+
+
+class TestMode:
+    def test_mode_resolution(self, monkeypatch):
+        monkeypatch.setattr(fused, "FUSED_MODE", "on")
+        assert fused.fused_enabled()
+        monkeypatch.setattr(fused, "FUSED_MODE", "off")
+        assert not fused.fused_enabled()
+        # auto on this CI box = CPU backend = off (the native kernel wins
+        # where there is no dispatch RTT to fuse away)
+        monkeypatch.setattr(fused, "FUSED_MODE", "auto")
+        import jax
+
+        assert fused.fused_enabled() == (jax.default_backend() != "cpu")
+
+    def test_fused_off_never_routes(self, monkeypatch):
+        monkeypatch.setattr(fused, "FUSED_MODE", "off")
+        f0 = fused.FUSED_SOLVES
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        results = env.schedule(plain_pods())
+        assert not results.pod_errors
+        assert fused.FUSED_SOLVES == f0
+
+
+class TestDeclineTaxonomy:
+    def test_minvalues_declines_metered(self, fused_on):
+        d0 = dict(fused.FUSED_DECLINES)
+        pool = nodepool(
+            "minpool",
+            requirements=[
+                {
+                    "key": "node.kubernetes.io/instance-type",
+                    "operator": "Exists",
+                    "minValues": 2,
+                }
+            ],
+        )
+        env = Env(node_pools=[pool], engine=CatalogEngine(CATALOG))
+        results = env.schedule(plain_pods())
+        assert not results.pod_errors
+        assert decline_delta(d0).get("min") == 1
+
+    def test_solver_cache_counters_carry_fused_series(self, fused_on):
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        env.schedule(plain_pods())
+        snap = ffd.solver_cache_counters()
+        assert "fused_solves" in snap
+        assert snap["fused_solves"] == fused.FUSED_SOLVES
+
+    def test_claim_overflow_aborts_to_host_walk(self, fused_on, monkeypatch):
+        """A scan that runs out of claim slots must abort the dispatch,
+        meter `claim-overflow`, and let the host walk re-solve — identical
+        results, never a wrong answer."""
+        orig = fused._pow2
+
+        def tiny_claims(n, floor):
+            if floor == 256:  # only the claim-axis bucket uses this floor
+                return 4
+            return orig(n, floor)
+
+        monkeypatch.setattr(fused, "_pow2", tiny_claims)
+        monkeypatch.setattr(
+            fused._FusedSolve,
+            "_claim_estimate",
+            lambda self, *a: 1,
+        )
+        d0 = dict(fused.FUSED_DECLINES)
+        f0 = fused.FUSED_SOLVES
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        # 4 request tiers -> far more than 4 claims
+        results = env.schedule(plain_pods(192, cpus=("7", "15", "3", "2")))
+        assert not results.pod_errors
+        assert results.new_node_claims, "host-walk fallback produced nothing"
+        assert fused.FUSED_SOLVES == f0
+        assert decline_delta(d0).get("claim-overflow") == 1
+
+    def test_decline_is_not_a_device_fallback(self, fused_on):
+        """A fused decline continues to the host-walk drivers INSIDE the
+        device path — DEVICE_FALLBACKS (host per-pod loop) must not move."""
+        pool = nodepool(
+            "minpool",
+            requirements=[
+                {
+                    "key": "node.kubernetes.io/instance-type",
+                    "operator": "Exists",
+                    "minValues": 2,
+                }
+            ],
+        )
+        fb0 = ffd.DEVICE_FALLBACKS
+        env = Env(node_pools=[pool], engine=CatalogEngine(CATALOG))
+        env.schedule(plain_pods())
+        assert ffd.DEVICE_FALLBACKS == fb0
+
+
+class TestFusedAOT:
+    def test_warm_start_covers_fused_rungs(self, fused_on, tmp_path):
+        """With the fused path on, the AOT walk compiles the scan rungs and
+        a serve-time dispatch is answered from the executable table —
+        zero compiles, aot_served counted."""
+        from karpenter_tpu.aot import compiler, ladder
+        from karpenter_tpu.aot import runtime as aotrt
+        from karpenter_tpu.aot.cache import ExecutableCache
+
+        reg = kobs.registry()
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        aotrt.configure(ladder.DEFAULT, cache)
+        try:
+            engine = CatalogEngine(CATALOG)
+            summary = compiler.warm_start(engine, ladder.DEFAULT, cache)
+            assert summary["errors"] == 0
+            scan_execs = [
+                e
+                for e in aotrt.executables()
+                if e["kernel"] == "packer.solve_scan"
+            ]
+            assert len(scan_execs) == len(
+                ladder.DEFAULT.buckets("packer.solve_scan")
+            )
+            snap0 = reg.debug_snapshot(kernel="packer.solve_scan") or {
+                "aot_served": 0, "compiles": 0,
+            }
+            env = Env(node_pools=[nodepool("default")], engine=engine)
+            results = env.schedule(plain_pods())
+            assert not results.pod_errors
+            snap = reg.debug_snapshot(kernel="packer.solve_scan")
+            assert snap["aot_served"] == snap0["aot_served"] + 1
+            assert snap["compiles"] == snap0["compiles"]
+        finally:
+            aotrt.configure(None, None)
+            aotrt.clear_executables()
+
+    def test_fused_off_walk_skips_scan_rungs(self, monkeypatch, tmp_path):
+        """A fused-off boot must not pay the while_loop compiles: the walk
+        skips the scan rungs entirely."""
+        from karpenter_tpu.aot import compiler, ladder
+        from karpenter_tpu.aot import runtime as aotrt
+        from karpenter_tpu.aot.cache import ExecutableCache
+
+        monkeypatch.setattr(fused, "FUSED_MODE", "off")
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        aotrt.configure(ladder.DEFAULT, cache)
+        try:
+            engine = CatalogEngine(CATALOG)
+            compiler.warm_start(engine, ladder.DEFAULT, cache)
+            assert not [
+                e
+                for e in aotrt.executables()
+                if e["kernel"] == "packer.solve_scan"
+            ]
+        finally:
+            aotrt.configure(None, None)
+            aotrt.clear_executables()
+
+
+class TestBatchDispatchSurface:
+    def test_batch_scope_counts_and_ring(self, fused_on):
+        reg = kobs.registry()
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        env.schedule(plain_pods())  # warm
+        with reg.batch_scope(label="spec") as acc:
+            env.schedule(plain_pods())
+        assert acc["dispatches"] == 1
+        assert acc["kernels"] == {"packer.solve_scan": 1}
+        last = reg.last_batches(1)[-1]
+        assert last["label"] == "spec"
+        assert last["dispatches"] == 1
+        assert last["kernels"] == {"packer.solve_scan": 1}
+
+    def test_debug_kernels_serves_per_batch_counts(self, fused_on):
+        """Satellite fix: /debug/kernels used to show only cumulative
+        per-kernel totals — the ==1 per-batch invariant is now observable
+        at runtime via the `batches` section."""
+        from test_serving_debug import get, make_server
+
+        reg = kobs.registry()
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        env.schedule(plain_pods())  # warm
+        with reg.batch_scope(label="serving-spec"):
+            env.schedule(plain_pods())
+        server = make_server(kernel_snapshot=reg.debug_snapshot)
+        try:
+            code, body = get(server, "/debug/kernels")
+            assert code == 200
+            table = json.loads(body)
+            assert table["batches"]["last"] is not None
+            recent = table["batches"]["recent"]
+            entry = [b for b in recent if b["label"] == "serving-spec"][-1]
+            assert entry["dispatches"] == 1
+            assert entry["kernels"] == {"packer.solve_scan": 1}
+        finally:
+            server.stop()
+
+    def test_solverd_stats_surface_last_batch_dispatches(self):
+        from karpenter_tpu.solverd.service import SolverService
+
+        svc = SolverService()
+        assert svc.stats()["last_batch_dispatches"] == 0

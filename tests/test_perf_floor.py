@@ -311,3 +311,100 @@ class TestAdmissionPipelineFloor:
         # the control leg must hide nothing — if it does, the measurement
         # itself is broken and the floor above proves nothing
         assert leg["unpipelined"]["encode_overlap_fraction"] == 0.0
+
+
+class TestOneDispatchFloor:
+    """The one-dispatch-solve contract, enforced as a perf-floor spec.
+
+    Dispatch COUNTS are hardware-independent (unlike the wall-clock floors
+    above, which stay meaningful only on comparable machines), so this
+    floor runs unconditionally: a steady-state admitted batch on the fused
+    path must execute as EXACTLY ONE device dispatch — observatory
+    measured — with zero fused declines on the scan-shaped workload."""
+
+    def _plain_pods(self, n: int = 256) -> list:
+        from karpenter_tpu.apis.core import ObjectMeta, Pod, PodSpec
+
+        cpus = ["250m", "500m", "1", "2"]
+        mems = ["256Mi", "512Mi", "1Gi"]
+        pods = []
+        for i in range(n):
+            p = Pod(
+                metadata=ObjectMeta(name=f"od-{i:05d}", uid=f"od-uid-{i:05d}"),
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            requests=parse_resource_list(
+                                {"cpu": cpus[i % 4], "memory": mems[i % 3]}
+                            )
+                        )
+                    ]
+                ),
+            )
+            p.metadata.creation_timestamp = 0.0
+            p.status.conditions.append(
+                Condition(
+                    type="PodScheduled", status="False", reason="Unschedulable"
+                )
+            )
+            pods.append(p)
+        return pods
+
+    def test_steady_batch_is_one_device_dispatch(self):
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.ops import fused as fused_mod
+
+        pods = self._plain_pods()
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        old_mode = fused_mod.FUSED_MODE
+        fused_mod.FUSED_MODE = "on"
+        reg = kobs.registry()
+        try:
+            f0 = fused_mod.FUSED_SOLVES
+            d0 = dict(fused_mod.FUSED_DECLINES)
+            results = env.schedule(pods)  # warmup: compiles + joint sweep
+            assert not results.pod_errors
+            assert fused_mod.FUSED_SOLVES == f0 + 1, "fused path fell back"
+            sealed_before = reg.sealed
+            reg.seal()
+            try:
+                with reg.batch_scope(label="perf-floor") as acc:
+                    results = env.schedule(pods)
+            finally:
+                if not sealed_before:
+                    reg.unseal()
+            assert not results.pod_errors
+            assert fused_mod.FUSED_SOLVES == f0 + 2, "fused path fell back"
+            assert dict(fused_mod.FUSED_DECLINES) == d0, (
+                "unexpected fused declines on the scan-shaped workload"
+            )
+            # THE floor: one admitted steady batch == one device dispatch
+            assert acc["dispatches"] == 1, acc
+            assert acc["kernels"] == {"packer.solve_scan": 1}, acc
+            # and the ring surfaced it for /debug/kernels
+            last = reg.last_batches(1)[-1]
+            assert last["label"] == "perf-floor"
+            assert last["dispatches"] == 1
+        finally:
+            fused_mod.FUSED_MODE = old_mode
+
+    def test_fused_off_leaves_dispatch_accounting_silent(self):
+        """Regression guard for the metering itself: with the fused path
+        off, the same steady workload's batch scope must count the host
+        walk's device dispatches (0 here — warm joint cache, native/host
+        scan) without ever seeing the scan kernel."""
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.ops import fused as fused_mod
+
+        pods = self._plain_pods()
+        env = Env(node_pools=[nodepool("default")], engine=CatalogEngine(CATALOG))
+        old_mode = fused_mod.FUSED_MODE
+        fused_mod.FUSED_MODE = "off"
+        try:
+            env.schedule(pods)
+            with kobs.registry().batch_scope(label="unfused") as acc:
+                results = env.schedule(pods)
+            assert not results.pod_errors
+            assert "packer.solve_scan" not in acc["kernels"]
+        finally:
+            fused_mod.FUSED_MODE = old_mode
