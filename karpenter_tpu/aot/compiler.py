@@ -346,17 +346,24 @@ def _ensure_executable(
     registry,
     summary: dict,
 ) -> None:
-    """Load-or-compile one bucket; installs into the runtime table and
-    records the bucket into the observatory (phase aot-warm)."""
+    """Load-or-compile one bucket; installs into the runtime table,
+    records the bucket into the observatory (phase aot-warm), and notes
+    its HLO cost model into the efficiency tables (once per bucket — the
+    perf floor asserts zero per-pass cost_analysis calls; failures
+    degrade to absent entries, never a failed boot)."""
+    from karpenter_tpu.observability import efficiency
+
     kernel, fn, abstract_args, sig = plan[:4]
     scope = plan[4] if len(plan) > 4 else ""
     summary["buckets"] += 1
-    if aotrt.lookup(kernel, sig, scope) is not None:
+    loaded = aotrt.lookup(kernel, sig, scope)
+    if loaded is not None:
         # another engine with identical content already warmed this bucket
         # this process — record it like a cache hit so warm-start telemetry
         # is a pure function of the walk, not of process history
         summary["already_loaded"] += 1
         registry.record(kernel, sig, 0.0, compiled=False, fenced=False, aot=True)
+        efficiency.note_executable(kernel, sig, loaded, scope=scope)
         return
     from jax.experimental import serialize_executable as se
 
@@ -375,6 +382,12 @@ def _ensure_executable(
                     kernel, sig, time.perf_counter() - t0,
                     compiled=False, fenced=False, aot=True,
                 )
+                # cost tables ride the warm start: one cost_analysis per
+                # bucket, answered from the sidecar JSON when the cache
+                # already holds it (deserialized executables cost the same)
+                efficiency.note_executable(
+                    kernel, sig, exe, scope=scope, cache=cache, key=key
+                )
                 return
             except Exception as e:  # noqa: BLE001 — bad entry: evict, recompile
                 cache.evict(key, f"deserialize: {e}")
@@ -391,6 +404,9 @@ def _ensure_executable(
     aotrt.install(kernel, sig, exe, scope=scope)
     summary["fresh_compiles"] += 1
     registry.record(kernel, sig, seconds, compiled=True, fenced=True, aot=False)
+    efficiency.note_executable(
+        kernel, sig, exe, scope=scope, cache=cache, key=key
+    )
     if cache is not None:
         try:
             body = pickle.dumps(se.serialize(exe))

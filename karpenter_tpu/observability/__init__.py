@@ -1,5 +1,6 @@
 """Observability: the kernel observatory (kernels.py), the SLO burn-rate
-engine (slo.py), and the flight recorder (flight.py).
+engine (slo.py), the flight recorder (flight.py), and the efficiency
+observatory (efficiency.py).
 
 Where tracing/ answers "where did this request's time go", this package
 answers the other operational questions: kernels.py — "what is the device
@@ -9,5 +10,9 @@ slo.py — "are we meeting our objectives, and how fast is the error budget
 burning" (declarative specs, multiwindow burn rates, per-tenant
 attribution, typed breaches); flight.py — "what did the system look like
 when it broke" (a bounded ring of per-pass snapshots, dumped as a
-digest-stamped postmortem bundle on breach/crash/SIGQUIT).
+digest-stamped postmortem bundle on breach/crash/SIGQUIT); efficiency.py —
+"how fast SHOULD this have been, and where did the wall go" (HLO cost
+models and roofline utilization per AOT rung, per-batch host-stall
+attribution, and jax.profiler trace capture triggered on demand or by an
+SLO breach).
 """

@@ -33,6 +33,7 @@ import hashlib
 import json
 import sys
 import threading
+import time
 from contextlib import contextmanager
 from typing import Callable, Iterator, Optional, Sequence
 
@@ -100,10 +101,21 @@ _BATCH: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
     "karpenter_kernel_batch", default=None
 )
 _BATCH_RING_CAP = 64
+# per-batch dispatch timeline entries kept on a ring entry: enough to read
+# the shape of a solve (the fused path is 1; the host walk is a handful of
+# sweeps), bounded so a pathological batch can't grow the ring entry
+_TIMELINE_CAP = 64
 _BATCH_DISPATCHES = global_registry.histogram(
     "karpenter_kernel_batch_dispatches",
     "device dispatches per solve batch (steady-state contract: <=1)",
     buckets=(0.0, 1.0, 2.0, 3.0, 5.0, 10.0, 25.0, 100.0),
+)
+_HOST_STALL = global_registry.histogram(
+    "karpenter_kernel_host_stall_fraction",
+    "fraction of each steady solve batch's wall the device sat idle for "
+    "(1.0 = fully host-paced; the efficiency observatory's per-batch "
+    "attribution)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0),
 )
 
 
@@ -111,7 +123,7 @@ class _Shape:
     """Per-(kernel, padded-shape-bucket) accounting."""
 
     __slots__ = ("dispatches", "compiles", "fenced", "execute_s", "max_s",
-                 "phases", "aot_served")
+                 "phases", "aot_served", "enqueue_s", "block_s")
 
     def __init__(self):
         self.dispatches = 0
@@ -121,6 +133,10 @@ class _Shape:
         self.max_s = 0.0
         self.phases = {"warmup": 0, "steady": 0, "aot-warm": 0, "host": 0}
         self.aot_served = 0  # dispatches served by an AOT executable
+        # the execute wall split (efficiency observatory): host-side call
+        # vs block_until_ready wait, fenced dispatches only
+        self.enqueue_s = 0.0
+        self.block_s = 0.0
 
 
 class _Kernel:
@@ -167,6 +183,19 @@ class KernelRegistry:
         self._last_memory: Optional[dict] = None
         self._batches: list[dict] = []  # recent per-batch dispatch counts
         self._batch_seq = 0
+        # cumulative steady-batch efficiency counters (the sim's
+        # report["kernels"]["efficiency"] reads deltas): batch counts and
+        # dispatch counts are deterministic facts; the wall sums are
+        # machine facts that never enter a digest
+        self._eff = {
+            "steady_batches": 0,
+            "device_batches": 0,
+            "host_only_batches": 0,
+            "device_dispatches": 0,
+            "busy_s": 0.0,
+            "gap_s": 0.0,
+            "wall_s": 0.0,
+        }
 
     # -- phase / seal --------------------------------------------------------
 
@@ -198,6 +227,10 @@ class KernelRegistry:
             self._recompile_cbs.clear()
             self._recompile_events.clear()
             self._last_memory = None
+            self._batches.clear()
+            self._batch_seq = 0
+            for key in self._eff:
+                self._eff[key] = 0.0 if key.endswith("_s") else 0
 
     @contextmanager
     def phase_scope(self, phase: str) -> Iterator[None]:
@@ -221,14 +254,44 @@ class KernelRegistry:
         bounded recent-batches ring surfaced on /debug/kernels. This is the
         runtime proof surface for the one-dispatch-solve contract: a steady
         fused batch must show dispatches == 1. The yielded dict accumulates
-        live, so callers can also read it after the scope closes."""
-        acc: dict = {"label": label, "dispatches": 0, "kernels": {}}
+        live, so callers can also read it after the scope closes.
+
+        The scope also reconstructs the batch's dispatch TIMELINE (the
+        efficiency observatory): device-busy wall (fenced execute walls),
+        host gap (batch wall minus busy), and a per-batch
+        ``host_stall_fraction``. Host twins (record_host) and unfenced
+        dispatches never contribute to device-busy time — a batch with no
+        awaited device work is fully host-paced, fraction exactly 1.0."""
+        acc: dict = {
+            "label": label,
+            "dispatches": 0,
+            "kernels": {},
+            "fenced": 0,
+            "host_records": 0,
+            "device_busy_s": 0.0,
+            "enqueue_s": 0.0,
+            "block_s": 0.0,
+            "timeline": [],
+        }
         token = _BATCH.set(acc)
+        t0 = time.perf_counter()
         try:
             yield acc
         finally:
+            wall = time.perf_counter() - t0
             _BATCH.reset(token)
             phase = "steady" if self._sealed else "warmup"
+            busy = acc["device_busy_s"]
+            gap = max(0.0, wall - busy)
+            # division is exact at the edges: busy == 0 gives exactly 1.0
+            fraction = (
+                min(1.0, max(0.0, gap / wall)) if wall > 0 else None
+            )
+            acc["wall_s"] = round(wall, 6)
+            acc["host_gap_s"] = round(gap, 6)
+            acc["host_stall_fraction"] = (
+                round(fraction, 6) if fraction is not None else None
+            )
             with self._lock:
                 self._batch_seq += 1
                 entry = {
@@ -237,10 +300,30 @@ class KernelRegistry:
                     "phase": phase,
                     "dispatches": acc["dispatches"],
                     "kernels": dict(acc["kernels"]),
+                    "fenced": acc["fenced"],
+                    "host_records": acc["host_records"],
+                    "wall_s": acc["wall_s"],
+                    "device_busy_s": round(busy, 6),
+                    "host_gap_s": acc["host_gap_s"],
+                    "host_stall_fraction": acc["host_stall_fraction"],
+                    "timeline": list(acc["timeline"]),
                 }
                 self._batches.append(entry)
                 del self._batches[:-_BATCH_RING_CAP]
+                if phase == "steady":
+                    eff = self._eff
+                    eff["steady_batches"] += 1
+                    if acc["dispatches"]:
+                        eff["device_batches"] += 1
+                    else:
+                        eff["host_only_batches"] += 1
+                    eff["device_dispatches"] += acc["dispatches"]
+                    eff["busy_s"] += busy
+                    eff["gap_s"] += gap
+                    eff["wall_s"] += wall
             _BATCH_DISPATCHES.observe(float(acc["dispatches"]))
+            if phase == "steady" and fraction is not None:
+                _HOST_STALL.observe(fraction)
 
     def last_batches(self, n: int = _BATCH_RING_CAP) -> list[dict]:
         with self._lock:
@@ -258,6 +341,7 @@ class KernelRegistry:
     def record(
         self, kernel: str, shape: str, seconds: float, compiled: bool,
         fenced: bool, aot: bool = False,
+        enqueue_s: float = 0.0, block_s: float = 0.0,
     ) -> None:
         cbs: tuple = ()
         recompiled = False
@@ -266,6 +350,29 @@ class KernelRegistry:
         if batch is not None:
             batch["dispatches"] += 1
             batch["kernels"][kernel] = batch["kernels"].get(kernel, 0) + 1
+            # device-busy attribution: only FENCED, non-compiling dispatches
+            # contribute measured device wall (a compile's wall is host-side
+            # XLA work; an unfenced dispatch's device work was never awaited
+            # here, so claiming it as busy would undercount the host gap)
+            if fenced and not compiled:
+                batch["fenced"] += 1
+                batch["device_busy_s"] += seconds
+                batch["enqueue_s"] += enqueue_s
+                batch["block_s"] += block_s
+            if len(batch["timeline"]) < _TIMELINE_CAP:
+                event = {
+                    "kernel": kernel,
+                    "shape": shape,
+                    "enqueue_s": round(enqueue_s, 6),
+                    "block_s": round(block_s, 6),
+                    "self_s": round(seconds, 6),
+                    "fenced": fenced,
+                }
+                if compiled:
+                    event["compiled"] = True
+                if aot:
+                    event["aot"] = True
+                batch["timeline"].append(event)
         with self._lock:
             k = self._kernels.get(kernel)
             if k is None:
@@ -300,6 +407,8 @@ class KernelRegistry:
                 s.fenced += 1
                 s.execute_s += seconds
                 s.max_s = max(s.max_s, seconds)
+                s.enqueue_s += enqueue_s
+                s.block_s += block_s
         # metrics + callbacks outside the registry lock (they take their own)
         _DISPATCHES.inc({"kernel": kernel, "phase": phase})
         if compiled:
@@ -318,7 +427,13 @@ class KernelRegistry:
     def record_host(self, kernel: str, shape: str) -> None:
         """A host-twin run of a device-parity kernel (small cube under the
         RTT threshold): counted so shape-bucket telemetry covers BOTH sides
-        of the routing decision; host twins never compile."""
+        of the routing decision; host twins never compile. A host twin
+        inside a batch scope marks the batch (host_records) but NEVER
+        counts as a device dispatch or device-busy time — the efficiency
+        timeline's regression contract."""
+        batch = _BATCH.get()
+        if batch is not None:
+            batch["host_records"] += 1
         with self._lock:
             k = self._kernels.get(kernel)
             if k is None:
@@ -333,6 +448,31 @@ class KernelRegistry:
     def steady_recompiles(self) -> int:
         with self._lock:
             return sum(k.recompiles for k in self._kernels.values())
+
+    def efficiency_counters(self) -> dict:
+        """Cumulative steady-batch efficiency counters (batch/dispatch
+        counts + wall sums); the sim snapshots these at run start and
+        reports the delta (observability/efficiency.report_section)."""
+        with self._lock:
+            return dict(self._eff)
+
+    def execute_stats(self) -> dict:
+        """Per-(kernel, shape bucket) fenced execute measurements — the
+        measured side of the utilization ratio (cost-model floor ÷ mean
+        execute wall)."""
+        with self._lock:
+            return {
+                name: {
+                    shape: {
+                        "fenced": s.fenced,
+                        "execute_s": s.execute_s,
+                        "max_s": s.max_s,
+                        "dispatches": s.dispatches,
+                    }
+                    for shape, s in k.shapes.items()
+                }
+                for name, k in self._kernels.items()
+            }
 
     # -- snapshots -----------------------------------------------------------
 
@@ -410,12 +550,38 @@ class KernelRegistry:
         self, kernel: Optional[str] = None, view: Optional[str] = None
     ) -> Optional[dict]:
         """/debug/kernels: the per-kernel table, a single kernel's
-        per-shape drill-down (None for an unknown kernel → 404), or — with
-        view="ladder" — the AOT ladder vs observed-buckets comparison."""
+        per-shape drill-down (None for an unknown kernel → 404), or one of
+        the views — "ladder" (AOT ladder vs observed buckets), "cost"
+        (cost-model tables joined with measured walls + utilization,
+        ?kernel= drill-down), "timeline" (recent per-batch dispatch
+        timelines with host-stall attribution)."""
         if view == "ladder":
             from karpenter_tpu.aot import runtime as aotrt
 
             return aotrt.ladder_view()
+        if view == "cost":
+            from karpenter_tpu.observability import efficiency
+
+            return efficiency.cost_view(kernel=kernel)
+        if view == "timeline":
+            with self._lock:
+                recent = [dict(b) for b in self._batches[-16:]]
+                eff = dict(self._eff)
+            steady = {
+                "steady_batches": eff["steady_batches"],
+                "device_batches": eff["device_batches"],
+                "host_only_batches": eff["host_only_batches"],
+                "device_dispatches": eff["device_dispatches"],
+                "device_busy_s": round(eff["busy_s"], 6),
+                "host_gap_s": round(eff["gap_s"], 6),
+                "wall_s": round(eff["wall_s"], 6),
+                "host_stall_fraction": (
+                    round(min(1.0, max(0.0, eff["gap_s"] / eff["wall_s"])), 6)
+                    if eff["wall_s"] > 0
+                    else None
+                ),
+            }
+            return {"steady": steady, "batches": recent}
         with self._lock:
             if kernel is not None:
                 k = self._kernels.get(kernel)
@@ -433,6 +599,8 @@ class KernelRegistry:
                         if s.fenced
                         else None,
                         "max_execute_s": round(s.max_s, 6),
+                        "enqueue_wall_s": round(s.enqueue_s, 6),
+                        "block_wall_s": round(s.block_s, 6),
                     }
                     for shape, s in k.shapes.items()
                 ]
@@ -468,7 +636,12 @@ class KernelRegistry:
                 for k in self._kernels.values()
             ]
             table.sort(key=lambda d: (-d["execute_wall_s"], d["kernel"]))
-            recent = [dict(b) for b in self._batches[-16:]]
+            # the per-dispatch timelines live on view=timeline; the plain
+            # table's batch ring stays the lean one-dispatch proof surface
+            recent = [
+                {k: v for k, v in b.items() if k != "timeline"}
+                for b in self._batches[-16:]
+            ]
             out = {
                 "sealed": self._sealed,
                 "phase": self.phase,

@@ -114,6 +114,15 @@ class Operator:
         self.slo.subscribe(
             self._on_slo_breach, key=f"operator:{self.options.cluster_name}"
         )
+        # triggered device profiling (observability/efficiency.py): the
+        # process-global jax.profiler capture service follows this
+        # operator's clock and --profile-dir. Disabled (no dir) it answers
+        # None everywhere; the breach path below arms captures through it.
+        from karpenter_tpu.observability import efficiency as effmod
+
+        self.profiler = effmod.configure_profiler(
+            clock=self.clock, profile_dir=self.options.profile_dir
+        )
         # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
         # here it bounds the solver's interning/memo caches. The caps are
         # process-global, so only an EXPLICIT setting mutates them: -1 (the
@@ -570,9 +579,15 @@ class Operator:
                 ),
             )
         )
-        self.flight.dump(
-            f"slo:{breach.objective}", context=breach.to_dict()
-        )
+        # arm a device profile capture for the breach (no-op unless
+        # --profile-dir is set; per-trigger cooldown; the capture itself
+        # finishes on a timer thread) and record its path in the flight
+        # bundle's context — the postmortem names its own evidence
+        context = breach.to_dict()
+        capture = self.profiler.arm(f"slo:{breach.objective}")
+        if capture is not None:
+            context["device_profile"] = capture
+        self.flight.dump(f"slo:{breach.objective}", context=context)
 
     def _flight_source(self) -> dict:
         """This cell's per-pass flight frame: harness health ledger,
@@ -623,6 +638,15 @@ class Operator:
         """/debug/flight (operator/serving.py): ring summary + bundle
         listing, or one bundle's frames. None => unknown bundle (404)."""
         return self.flight.snapshot(bundle=bundle)
+
+    def device_profile_snapshot(self, seconds: float) -> Optional[dict]:
+        """/debug/profile/device (operator/serving.py): a synchronous
+        jax.profiler capture of the next `seconds` of device activity into
+        --profile-dir. None => profiling disabled (404); the serving layer
+        validates `seconds` (400 on garbage) before calling."""
+        if not self.profiler.enabled:
+            return None
+        return self.profiler.capture(seconds, trigger="debug")
 
     def healthy(self) -> bool:
         """Real liveness: degraded when any controller is failing

@@ -128,6 +128,13 @@ class Options:
     flight_dir: str = ""
     flight_capacity: int = 64
 
+    # triggered device profiling (observability/efficiency.py): profile_dir
+    # arms jax.profiler trace capture — on demand via
+    # /debug/profile/device?seconds= and automatically on SLO breach (the
+    # breach's flight bundle records the capture path). Empty = disabled
+    # (the endpoint 404s, breaches dump bundles without captures).
+    profile_dir: str = ""
+
     # reconciler harness (operator/harness.py): per-item exponential
     # backoff bounds for failing reconciles, and the cloud-provider circuit
     # breaker (consecutive retryable create/delete failures before opening;
@@ -195,6 +202,7 @@ class Options:
         parser.add_argument("--slo-specs")
         parser.add_argument("--flight-dir")
         parser.add_argument("--flight-capacity", type=int)
+        parser.add_argument("--profile-dir")
         parser.add_argument("--tracing-sample-rate", type=float)
         parser.add_argument("--trace-buffer-size", type=int)
         parser.add_argument("--requeue-base-delay", type=float)
@@ -224,6 +232,7 @@ class Options:
             "aot_ladder": "AOT_LADDER",
             "slo_specs": "SLO_SPECS",
             "flight_dir": "FLIGHT_DIR",
+            "profile_dir": "PROFILE_DIR",
         }
         for f in fields(cls):
             if f.name == "feature_gates":

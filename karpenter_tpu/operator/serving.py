@@ -33,6 +33,7 @@ class ServingConfig:
         kernel_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         slo_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         flight_snapshot: Optional[Callable[..., Optional[dict]]] = None,
+        device_profile: Optional[Callable[[float], Optional[dict]]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -65,6 +66,11 @@ class ServingConfig:
         # the ring summary + bundle listing, ?bundle= drill-down into one
         # bundle's frames (404 when unknown); unwired => 404
         self.flight_snapshot = flight_snapshot
+        # triggered device profiling (operator.device_profile_snapshot):
+        # /debug/profile/device?seconds=N runs a synchronous jax.profiler
+        # capture into --profile-dir. Returns None when profiling is off
+        # (404); bad/out-of-range seconds are rejected here (400)
+        self.device_profile = device_profile
 
 
 def _profile_sample(seconds: float, interval: float = 0.01) -> str:
@@ -279,6 +285,43 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 else:
                     self._respond(200, json.dumps(snap), "application/json")
+            elif (
+                url.path == "/debug/profile/device"
+                and cfg.device_profile is not None
+            ):
+                import json
+
+                raw = parse_qs(url.query).get("seconds", ["1.0"])[0]
+                try:
+                    seconds = float(raw)
+                except ValueError:
+                    seconds = None
+                if seconds is None or not (0.0 <= seconds <= 30.0):
+                    self._respond(
+                        400,
+                        json.dumps(
+                            {"error": "seconds must be a number in [0, 30]"}
+                        ),
+                        "application/json",
+                    )
+                else:
+                    snap = cfg.device_profile(seconds)
+                    if snap is None:
+                        self._respond(
+                            404,
+                            json.dumps(
+                                {
+                                    "error": "device profiling disabled "
+                                    "(--profile-dir not set or jax.profiler "
+                                    "unavailable)"
+                                }
+                            ),
+                            "application/json",
+                        )
+                    else:
+                        self._respond(
+                            200, json.dumps(snap), "application/json"
+                        )
             elif url.path == "/debug/solverd" and cfg.solverd_stats is not None:
                 import json
 

@@ -77,6 +77,13 @@ def main(argv=None) -> int:
         "runs dump byte-identical bundles",
     )
     parser.add_argument(
+        "--profile-dir",
+        default="",
+        help="device profile capture directory: arms jax.profiler trace "
+        "capture — SLO breaches during the run arm a capture whose path "
+        "is recorded in the breach's flight bundle (empty = disabled)",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     parser.add_argument(
@@ -115,6 +122,7 @@ def main(argv=None) -> int:
         or args.aot_ladder
         or args.shard_devices
         or args.flight_dir
+        or args.profile_dir
     ):
         from karpenter_tpu.operator.options import Options
 
@@ -123,6 +131,7 @@ def main(argv=None) -> int:
             aot_ladder=args.aot_ladder,
             solver_pod_shard_axis=args.shard_devices,
             flight_dir=args.flight_dir,
+            profile_dir=args.profile_dir,
         )
 
     if trace.get("fleet"):

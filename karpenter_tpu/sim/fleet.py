@@ -355,6 +355,15 @@ class FleetSimulation:
             "slo": engine.report(),
             "flight": flightmod.recorder().report(),
         }
+        # the efficiency observatory folds once at pool level too (its
+        # steady-batch counters are process-global, like the kernel
+        # counts); outside the kernels digest, deterministic for
+        # host-only scenarios exactly like the single-cell report
+        from karpenter_tpu.observability import efficiency as effmod
+
+        report["kernels"]["efficiency"] = effmod.report_section(
+            self.cells[0]._eff_base if self.cells else None
+        )
         return report
 
 

@@ -135,6 +135,8 @@ def sim_globals(seed: int, clock: FakeClock):
     from karpenter_tpu.observability import slo as slomod
     from karpenter_tpu.ops import catalog as catmod
 
+    from karpenter_tpu.observability import efficiency as effmod
+
     apicore.set_uid_source(Random(f"{seed}:uids"))
     clock.enable_blocking_sleep()
     kobs.registry().unseal()
@@ -144,6 +146,10 @@ def sim_globals(seed: int, clock: FakeClock):
     # report["slo"]/report["flight"] are pure functions of (scenario, seed)
     slomod.engine().reset()
     flightmod.recorder().reset()
+    # device-profiler sequence + cooldowns restart so breach-armed capture
+    # names (recorded in flight bundle contexts) are a pure function of
+    # the run, not of process history
+    effmod.profiler().reset()
     provmod._ENGINE_CONTENT_CACHE.clear()
     pinned_prev = catmod.PINNED_RTT
     catmod.PINNED_RTT = PINNED_RTT_S
@@ -278,6 +284,12 @@ class Simulation:
         from karpenter_tpu.aot import runtime as aotrt
 
         self._aot_base = aotrt.stats()
+        # efficiency observatory (host-stall attribution + cost tables):
+        # steady-batch counters are process-cumulative, so the report
+        # section is a delta from run start, like the kernels section
+        from karpenter_tpu.observability import efficiency as effmod
+
+        self._eff_base = effmod.snapshot_base()
         self._victim_rng = Random(f"{seed}:victims")
         self._groups: dict[str, _Group] = {}
         self._known_nodes: set[str] = set()
@@ -393,6 +405,15 @@ class Simulation:
         from karpenter_tpu.aot import runtime as aotrt
 
         report["kernels"]["aot"] = aotrt.stats_delta(self._aot_base)
+        # efficiency observatory, also OUTSIDE the digest (cost models and
+        # measured walls are machine facts). Its deterministic half —
+        # steady batch counts, dispatch counts, and the exact-1.0 fraction
+        # of fully host-paced runs — still reproduces per seed, so
+        # full-report equality holds on scenarios that never
+        # device-dispatch under the pinned RTT.
+        from karpenter_tpu.observability import efficiency as effmod
+
+        report["kernels"]["efficiency"] = effmod.report_section(self._eff_base)
         # consolidation frontier search: this run's rounds/probes per
         # consolidation type plus the solverd frontier groups that
         # coalesced — deterministic (decision-path) facts
@@ -439,6 +460,7 @@ class Simulation:
         # wall-clock measurements stay on /debug/solverd but OUT of the
         # report: the report must be a pure function of (scenario, seed)
         stats.pop("last_batch_seconds", None)
+        stats.pop("last_batch_host_stall", None)
         return stats
 
     # -- trace events --------------------------------------------------------

@@ -27,6 +27,8 @@ as volatile attrs since they are process-history, not scenario, facts.
 
 from __future__ import annotations
 
+import time
+
 from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry, measure
 from karpenter_tpu.observability import kernels as kobs
@@ -81,10 +83,12 @@ class Coalescer:
                 base = ffd.solver_cache_counters()
                 reg = kobs.registry()
                 recompiles_base = reg.steady_recompiles()
+                t0 = time.perf_counter()
                 with ktime.measure() as kernels:
                     err = self._solve_one(entry)
                     if err is not None:
                         span.fail(err)
+                solve_wall = time.perf_counter() - t0
                 delta = {
                     name: value - base[name]
                     for name, value in ffd.solver_cache_counters().items()
@@ -93,9 +97,24 @@ class Coalescer:
                     mem_live.append(
                         kobs.sample_device_memory()["live_array_bytes"]
                     )
+                # host-stall attribution per solve (efficiency observatory):
+                # the fenced device wall vs this solve's total wall — the
+                # per-request twin of the batch scope's timeline, with the
+                # same attribution rule (a compile's wall is host-side XLA
+                # work, never device-busy). Volatile: wall measurements
+                # never enter the deterministic export.
+                device_busy = kernels["execute_s"]
+                host_stall = (
+                    round(min(1.0, max(0.0, 1.0 - device_busy / solve_wall)), 6)
+                    if solve_wall > 0
+                    else None
+                )
                 span.set_volatile(
                     wall_compile_s=round(kernels["compile_s"], 6),
                     wall_execute_s=round(kernels["execute_s"], 6),
+                    wall_enqueue_s=round(kernels["enqueue_s"], 6),
+                    wall_block_s=round(kernels["block_s"], 6),
+                    host_stall_fraction=host_stall,
                     kernel_dispatches=kernels["dispatches"],
                     kernel_compiles=kernels["compiles"],
                     kernel_recompiles=reg.steady_recompiles() - recompiles_base,

@@ -141,6 +141,7 @@ class SolverService:
         self.max_batch_size = 0
         self.last_batch_seconds = 0.0
         self.last_batch_dispatches = 0
+        self.last_batch_host_stall: Optional[float] = None
 
     # -- client surface ------------------------------------------------------
 
@@ -368,6 +369,10 @@ class SolverService:
             ) as batch_acc:
                 self.coalescer.execute(ready)
             self.last_batch_dispatches = batch_acc["dispatches"]
+            # the batch scope's timeline verdict: where this batch's wall
+            # went (1.0 = fully host-paced). Wall-clock — /debug only,
+            # never the sim report (same split as last_batch_seconds).
+            self.last_batch_host_stall = batch_acc.get("host_stall_fraction")
         finally:
             for entry in ready:
                 if entry.result is None and entry.error is None:
@@ -387,11 +392,16 @@ class SolverService:
         # per-device allocator stats) and the solver cache counters mirrored
         # onto /metrics — both best-effort, never failing the batch
         try:
+            from karpenter_tpu.observability import efficiency
             from karpenter_tpu.observability import kernels as kobs
             from karpenter_tpu.ops import ffd
 
             kobs.sample_device_memory()
             ffd.publish_cache_counters()
+            # utilization gauges (cost-model floor / measured execute wall)
+            # refresh from the batch's fenced measurements; a no-op until
+            # an AOT warm start built cost tables
+            efficiency.publish_utilization()
         except Exception:  # noqa: BLE001 — telemetry must not fail solves
             pass
         return len(ready)
@@ -442,6 +452,7 @@ class SolverService:
                 "max_batch_size": self.max_batch_size,
                 "last_batch_seconds": self.last_batch_seconds,
                 "last_batch_dispatches": self.last_batch_dispatches,
+                "last_batch_host_stall": self.last_batch_host_stall,
             }
         return {
             "transport": "inprocess",

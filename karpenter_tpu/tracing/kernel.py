@@ -16,6 +16,13 @@ concurrent daemon threads never mix accounts. All numbers here are
 wall-clock — span code must record them as VOLATILE attrs, never in the
 deterministic digest.
 
+Each dispatch's wall is additionally split into enqueue (the host-side
+call: tracing, argument staging, nested dispatches, any compile) vs block
+(the ``block_until_ready`` wait — device work the host demonstrably
+waited on). The split feeds the efficiency observatory's per-batch
+host-stall timeline (observability/efficiency.py); unfenced dispatches
+report zero block wall because their device work was never awaited here.
+
 Nesting: a fenced dispatch whose callable itself dispatches (a host driver
 wrapping an inner kernel) attributes wall time to the INNERMOST dispatch
 only — each frame subtracts its children's elapsed time before recording,
@@ -51,7 +58,19 @@ _NEST: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
 
 
 def _fresh() -> dict:
-    return {"compile_s": 0.0, "execute_s": 0.0, "dispatches": 0, "compiles": 0}
+    return {
+        "compile_s": 0.0,
+        "execute_s": 0.0,
+        "dispatches": 0,
+        "compiles": 0,
+        # the execute wall split (efficiency observatory): enqueue_s is the
+        # host-side call (tracing, arg staging, dispatch), block_s the
+        # block_until_ready wait — device work the host genuinely waited on.
+        # Both sum into compile_s/execute_s above; they are the same wall,
+        # attributed twice at different grain.
+        "enqueue_s": 0.0,
+        "block_s": 0.0,
+    }
 
 
 @contextmanager
@@ -99,8 +118,10 @@ def dispatch(fn, *args, kernel: Optional[str] = None, aot_scope: str = ""):
     cell = [0.0]  # children's elapsed accumulates here
     stack.append(cell)
     t0 = time.perf_counter()
+    t_enqueued = None  # set once the call returns, before any fence
     compiled = False
     served_aot = False
+    fenced = False
     try:
         if aexe is not None:
             try:
@@ -118,6 +139,11 @@ def dispatch(fn, *args, kernel: Optional[str] = None, aot_scope: str = ""):
             compiled = (
                 before is not None and after is not None and after > before
             )
+        # the dispatch-timeline split (efficiency observatory): everything
+        # up to here is ENQUEUE wall (host-side tracing/staging + any
+        # compile + the children's nested dispatches); the fence below is
+        # BLOCK wall — time the host demonstrably spent waiting on device
+        t_enqueued = time.perf_counter()
         # fence when a measurement context wants exact execute wall, or when
         # a compile happened (compile wall must be exact for the registry's
         # recompile accounting; compiles are rare so the fence is free)
@@ -133,12 +159,18 @@ def dispatch(fn, *args, kernel: Optional[str] = None, aot_scope: str = ""):
         elapsed = time.perf_counter() - t0
         stack.pop()
     # innermost-only attribution: subtract the children's wall, credit the
-    # parent frame with our FULL elapsed so it subtracts us in turn
+    # parent frame with our FULL elapsed so it subtracts us in turn. The
+    # children ran inside the CALL, so they subtract from the enqueue
+    # segment only; block wall is always this frame's own.
     self_s = max(0.0, elapsed - cell[0])
+    block_s = elapsed - (t_enqueued - t0) if t_enqueued is not None else 0.0
+    enqueue_s = max(0.0, self_s - block_s)
     if stack:
         stack[-1][0] += elapsed
     if acc is not None:
         acc["dispatches"] += 1
+        acc["enqueue_s"] += enqueue_s
+        acc["block_s"] += block_s
         if compiled:
             acc["compiles"] += 1
             acc["compile_s"] += self_s
@@ -146,6 +178,7 @@ def dispatch(fn, *args, kernel: Optional[str] = None, aot_scope: str = ""):
             acc["execute_s"] += self_s
     if kernel is not None:
         kobs.registry().record(
-            kernel, sig, self_s, compiled, fenced, aot=served_aot
+            kernel, sig, self_s, compiled, fenced, aot=served_aot,
+            enqueue_s=enqueue_s, block_s=block_s,
         )
     return out

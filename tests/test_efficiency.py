@@ -1,0 +1,569 @@
+"""Efficiency observatory (observability/efficiency.py + the dispatch
+split in tracing/kernel.py): HLO cost tables built once per executable,
+sidecar persistence alongside the AOT cache, roofline utilization,
+per-batch host-stall attribution (host twins NEVER count as device-busy
+time), triggered device profiling, the breach→capture→flight-bundle
+pipeline, and the graceful-degradation specs (no cost_analysis / no
+jax.profiler / unwritable dirs — warn once, never affect boot or seal)."""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu.observability import efficiency as eff
+from karpenter_tpu.observability import kernels as kobs
+from karpenter_tpu.tracing import kernel as ktime
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def clean_eff():
+    """Isolate the efficiency observatory's process-global state."""
+    reg = kobs.registry()
+    reg.reset()
+    eff.tables().reset()
+    prof = eff.profiler()
+    prof.configure(profile_dir="")
+    prof.reset()
+    yield
+    # wait out any armed background capture before resetting (a non-daemon
+    # worker from a spec must not leak a live trace into the next one)
+    deadline = time.monotonic() + 10.0
+    while prof.snapshot()["active"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    prof.configure(profile_dir="")
+    prof.reset()
+    eff.tables().reset()
+    reg.reset()
+
+
+def compiled_executable(n: int = 16):
+    """A real compiled executable (cost_analysis works on CPU jaxlib)."""
+    fn = jax.jit(lambda x: x @ x)
+    return fn.lower(
+        jax.ShapeDtypeStruct((n, n), np.float32)
+    ).compile()
+
+
+class _BrokenExe:
+    def cost_analysis(self):
+        raise RuntimeError("backend without cost models")
+
+
+class _PartialExe:
+    """cost_analysis yields bytes only, memory_analysis missing."""
+
+    def cost_analysis(self):
+        return [{"bytes accessed": 4096.0}]
+
+    def memory_analysis(self):
+        raise NotImplementedError
+
+
+class TestCostTables:
+    def test_note_executable_builds_entry(self, clean_eff):
+        exe = compiled_executable()
+        entry = eff.note_executable("spec.mm", "16x16", exe)
+        assert entry is not None
+        assert entry["flops"] > 0
+        assert entry["bytes_accessed"] > 0
+        assert entry["floor_s"] > 0
+        stats = eff.tables().stats()
+        assert stats == {"entries": 1, "analysis_calls": 1, "errors": 0}
+
+    def test_idempotent_per_key(self, clean_eff):
+        exe = compiled_executable()
+        eff.note_executable("spec.mm", "16x16", exe)
+        again = eff.note_executable("spec.mm", "16x16", exe)
+        assert again is not None
+        # the second note answered from the table: NO second analysis
+        assert eff.tables().stats()["analysis_calls"] == 1
+
+    def test_scope_blind_lookup(self, clean_eff):
+        """The observatory's shape telemetry is scope-free by design, so
+        utilization joins on (kernel, sig) regardless of the mesh scope
+        the executable compiled under."""
+        exe = compiled_executable()
+        eff.note_executable("spec.mm", "16x16", exe, scope="mesh=8:pods")
+        assert eff.tables().lookup("spec.mm", "16x16") is not None
+        assert eff.tables().lookup("spec.mm", "32x32") is None
+
+    def test_broken_backend_degrades_to_absent_entry(self, clean_eff):
+        """Graceful-degradation spec: a backend whose executables raise
+        from cost_analysis yields NO entry and NO exception — and warns
+        once per boot, not once per bucket."""
+        assert eff.note_executable("spec.a", "1", _BrokenExe()) is None
+        assert eff.note_executable("spec.b", "2", _BrokenExe()) is None
+        stats = eff.tables().stats()
+        assert stats["entries"] == 0
+        assert stats["errors"] == 2
+        # re-noting a failed key never retries the analysis
+        calls = stats["analysis_calls"]
+        assert eff.note_executable("spec.a", "1", _BrokenExe()) is None
+        assert eff.tables().stats()["analysis_calls"] == calls
+
+    def test_partial_cost_dict_keeps_what_it_got(self, clean_eff):
+        entry = eff.note_executable("spec.part", "4", _PartialExe())
+        assert entry is not None
+        assert "flops" not in entry
+        assert entry["bytes_accessed"] == 4096.0
+        # the roofline floor binds on the only term available
+        assert entry["floor_s"] > 0
+
+    def test_sidecar_rides_the_executable_cache(self, clean_eff, tmp_path):
+        """Cost entries persist as sidecar JSON alongside the executable
+        cache, keyed the same way: a second boot loads the sidecar and
+        pays zero cost_analysis calls."""
+        from karpenter_tpu.aot.cache import ExecutableCache
+
+        cache = ExecutableCache(str(tmp_path))
+        exe = compiled_executable()
+        eff.note_executable("spec.mm", "16x16", exe, cache=cache, key="k" * 64)
+        sidecar = tmp_path / ("k" * 64 + ".cost.json")
+        assert sidecar.exists()
+        fresh = eff.CostTables()
+        entry = fresh.note_executable(
+            "spec.mm", "16x16", _BrokenExe(), cache=cache, key="k" * 64
+        )
+        # the broken exe was never consulted: the sidecar answered
+        assert entry is not None and entry["flops"] > 0
+        assert fresh.stats()["analysis_calls"] == 0
+
+    def test_sidecar_write_failure_degrades(self, clean_eff, tmp_path):
+        """An unwritable cache dir loses the sidecar, not the entry."""
+        from karpenter_tpu.aot.cache import ExecutableCache
+
+        cache = ExecutableCache(str(tmp_path))
+        os.chmod(tmp_path, 0o500)
+        try:
+            entry = eff.note_executable(
+                "spec.mm", "16x16", compiled_executable(),
+                cache=cache, key="r" * 64,
+            )
+            assert entry is not None
+        finally:
+            os.chmod(tmp_path, 0o700)
+
+    def test_peak_env_overrides(self, clean_eff, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_PEAK_FLOPS", "1e12")
+        monkeypatch.setenv("KARPENTER_TPU_PEAK_BYTES", "1e11")
+        assert eff._device_peaks() == (1e12, 1e11)
+        floor = eff._floor_seconds({"flops": 1e12, "bytes_accessed": 1e10})
+        assert floor == pytest.approx(1.0)  # compute-bound term wins
+
+    def test_malformed_peak_env_never_crashes_a_boot(
+        self, clean_eff, monkeypatch
+    ):
+        """Regression: a garbage/negative peak override falls back to the
+        device defaults instead of raising out of the warm start."""
+        monkeypatch.setenv("KARPENTER_TPU_PEAK_FLOPS", "400T")
+        monkeypatch.setenv("KARPENTER_TPU_PEAK_BYTES", "-5")
+        pf, pb = eff._device_peaks()
+        assert pf > 0 and pb > 0
+        entry = eff.note_executable(
+            "spec.badenv", "16x16", compiled_executable()
+        )
+        assert entry is not None and entry["floor_s"] > 0
+
+
+class TestDispatchSplit:
+    def test_measure_carries_enqueue_and_block(self, clean_eff):
+        f = jax.jit(lambda x: x + 1)
+        x = np.ones((8,), np.float32)
+        with ktime.measure() as m:
+            ktime.dispatch(f, x, kernel="spec.split")
+            ktime.dispatch(f, x, kernel="spec.split")
+        assert m["dispatches"] == 2
+        assert m["enqueue_s"] > 0
+        assert m["block_s"] >= 0
+        # the split re-attributes the same wall: it can never exceed the
+        # compile+execute total
+        assert m["enqueue_s"] + m["block_s"] <= (
+            m["compile_s"] + m["execute_s"] + 1e-6
+        )
+
+    def test_host_twin_never_counts_device_busy(self, clean_eff):
+        """THE regression contract: record_host (host twins, topo count
+        resyncs) marks the batch but contributes neither dispatches nor
+        device-busy wall — a host-paced batch reads as exactly 1.0."""
+        reg = kobs.registry()
+        with reg.batch_scope(label="host-twin") as acc:
+            reg.record_host("spec.twin", "8x4")
+            reg.record_host("spec.twin", "8x4")
+        assert acc["dispatches"] == 0
+        assert acc["fenced"] == 0
+        assert acc["host_records"] == 2
+        assert acc["device_busy_s"] == 0.0
+        assert acc["host_stall_fraction"] == 1.0
+        assert acc["timeline"] == []
+
+    def test_unfenced_dispatch_counts_but_not_busy(self, clean_eff):
+        """A named dispatch OUTSIDE a measurement context is unfenced: it
+        counts as a device dispatch (the one-dispatch contract) but its
+        device wall was never awaited, so it adds no busy time."""
+        reg = kobs.registry()
+        f = jax.jit(lambda x: x * 2)
+        x = np.ones((4,), np.float32)
+        ktime.dispatch(f, x, kernel="spec.unfenced")  # warm the jit cache
+        with reg.batch_scope(label="unfenced") as acc:
+            ktime.dispatch(f, x, kernel="spec.unfenced")
+        assert acc["dispatches"] == 1
+        assert acc["fenced"] == 0
+        assert acc["device_busy_s"] == 0.0
+        assert acc["host_stall_fraction"] == 1.0
+
+    def test_nested_innermost_only_split_intact(self, clean_eff):
+        """The nested-fence guard survives the split: a driver wrapping an
+        inner dispatch attributes each second once — the measured totals
+        never exceed the outer wall."""
+        inner = jax.jit(lambda x: x @ x)
+        x = np.ones((32, 32), np.float32)
+
+        def driver(y):
+            return ktime.dispatch(inner, y, kernel="spec.inner")
+
+        t0 = time.perf_counter()
+        with ktime.measure() as m:
+            ktime.dispatch(driver, x, kernel="spec.outer")
+        wall = time.perf_counter() - t0
+        assert m["dispatches"] == 2
+        assert m["compile_s"] + m["execute_s"] <= wall + 1e-6
+        assert m["enqueue_s"] + m["block_s"] <= wall + 1e-6
+
+
+class TestBatchTimeline:
+    def test_device_batch_reconstruction(self, clean_eff):
+        reg = kobs.registry()
+        f = jax.jit(lambda x: x @ x)
+        x = np.ones((16, 16), np.float32)
+        ktime.dispatch(f, x, kernel="spec.tl")  # pay the compile outside
+        with reg.batch_scope(label="timeline") as acc:
+            with ktime.measure():
+                ktime.dispatch(f, x, kernel="spec.tl")
+        assert acc["dispatches"] == 1
+        assert acc["fenced"] == 1
+        assert acc["device_busy_s"] > 0
+        assert acc["wall_s"] >= acc["device_busy_s"]
+        assert 0.0 <= acc["host_stall_fraction"] <= 1.0
+        (event,) = acc["timeline"]
+        assert event["kernel"] == "spec.tl"
+        assert event["fenced"] is True
+        assert event["enqueue_s"] >= 0 and event["block_s"] >= 0
+
+    def test_timeline_view_and_steady_counters(self, clean_eff):
+        reg = kobs.registry()
+        f = jax.jit(lambda x: x + 1)
+        x = np.ones((8,), np.float32)
+        ktime.dispatch(f, x, kernel="spec.view")
+        reg.seal()
+        with reg.batch_scope(label="steady-a"):
+            with ktime.measure():
+                ktime.dispatch(f, x, kernel="spec.view")
+        with reg.batch_scope(label="steady-b"):
+            pass  # host-only
+        reg.unseal()
+        view = reg.debug_snapshot(view="timeline")
+        assert view["steady"]["steady_batches"] == 2
+        assert view["steady"]["device_batches"] == 1
+        assert view["steady"]["host_only_batches"] == 1
+        assert 0.0 <= view["steady"]["host_stall_fraction"] <= 1.0
+        labels = [b["label"] for b in view["batches"]]
+        assert labels == ["steady-a", "steady-b"]
+        assert all("timeline" in b for b in view["batches"])
+
+    def test_warmup_batches_stay_out_of_steady_counters(self, clean_eff):
+        reg = kobs.registry()
+        with reg.batch_scope(label="warmup"):
+            pass
+        assert reg.efficiency_counters()["steady_batches"] == 0
+
+    def test_report_section_delta_and_exact_one(self, clean_eff):
+        reg = kobs.registry()
+        base = eff.snapshot_base()
+        reg.seal()
+        with reg.batch_scope(label="host-only"):
+            reg.record_host("spec.sect", "4")
+        reg.unseal()
+        section = eff.report_section(base)
+        assert section["steady_batches"] == 1
+        assert section["host_only_batches"] == 1
+        assert section["device_batches"] == 0
+        assert section["steady_device_dispatches"] == 0
+        # fully host-paced: the fraction is EXACTLY 1.0 (deterministic —
+        # no wall-clock division involved), which is what keeps same-seed
+        # sim reports byte-equal on host-routed scenarios
+        assert section["host_stall_fraction"] == 1.0
+
+    def test_report_section_without_steady_batches(self, clean_eff):
+        section = eff.report_section(eff.snapshot_base())
+        assert section["steady_batches"] == 0
+        assert section["host_stall_fraction"] is None
+
+
+class TestUtilization:
+    def test_ratio_joins_cost_and_measured(self, clean_eff):
+        f = jax.jit(lambda x: x @ x)
+        x = np.ones((16, 16), np.float32)
+        exe = compiled_executable(16)
+        ktime.dispatch(f, x, kernel="spec.util")  # compile
+        with ktime.measure():
+            ktime.dispatch(f, x, kernel="spec.util")  # fenced execute
+        eff.note_executable("spec.util", "16x16", exe)
+        view = eff.utilization_view()
+        row = view["spec.util"]["16x16"]
+        assert row["floor_s"] > 0
+        assert row["mean_execute_s"] > 0
+        # the view rounds the ratio to 6 decimals
+        assert row["utilization"] == pytest.approx(
+            row["floor_s"] / row["mean_execute_s"], abs=1e-5
+        )
+
+    def test_publish_sets_gauge(self, clean_eff):
+        from karpenter_tpu.metrics import global_registry
+
+        f = jax.jit(lambda x: x @ x)
+        x = np.ones((16, 16), np.float32)
+        ktime.dispatch(f, x, kernel="spec.pub")
+        with ktime.measure():
+            ktime.dispatch(f, x, kernel="spec.pub")
+        eff.note_executable("spec.pub", "16x16", compiled_executable(16))
+        view = eff.publish_utilization()
+        gauge = global_registry.get("karpenter_kernel_utilization")
+        assert gauge.value(
+            {"kernel": "spec.pub", "bucket": "16x16"}
+        ) == pytest.approx(view["spec.pub"]["16x16"]["utilization"])
+
+    def test_absent_without_cost_tables(self, clean_eff):
+        f = jax.jit(lambda x: x + 1)
+        with ktime.measure():
+            ktime.dispatch(f, np.ones((4,), np.float32), kernel="spec.none")
+        assert eff.utilization_view() == {}
+
+
+class TestCostView:
+    def test_view_and_drilldown_and_404(self, clean_eff):
+        eff.note_executable("spec.cv", "8x8", compiled_executable(8))
+        view = eff.cost_view()
+        assert view["cost_tables"]["entries"] == 1
+        assert view["rows"][0]["kernel"] == "spec.cv"
+        drill = eff.cost_view(kernel="spec.cv")
+        assert len(drill["rows"]) == 1
+        assert eff.cost_view(kernel="missing") is None
+        # the registry's kernels count as known even without cost entries
+        kobs.registry().record_host("spec.hostonly", "2")
+        assert eff.cost_view(kernel="spec.hostonly") is not None
+
+    def test_registry_view_routing(self, clean_eff):
+        eff.note_executable("spec.route", "4x4", compiled_executable(4))
+        snap = kobs.registry().debug_snapshot(view="cost")
+        assert snap["rows"][0]["kernel"] == "spec.route"
+        assert kobs.registry().debug_snapshot(
+            kernel="missing", view="cost"
+        ) is None
+
+
+class TestDeviceProfiler:
+    def test_disabled_returns_none(self, clean_eff):
+        prof = eff.profiler()
+        assert prof.capture(0.1) is None
+        assert prof.arm("slo:x") is None
+        assert prof.snapshot()["enabled"] is False
+
+    def test_capture_writes_files_and_counts(self, clean_eff, tmp_path):
+        from karpenter_tpu.metrics import global_registry
+
+        prof = eff.profiler().configure(profile_dir=str(tmp_path))
+        base = global_registry.get(
+            "karpenter_profiler_captures_total"
+        ).value({"trigger": "debug"})
+        record = prof.capture(0.0, trigger="debug")
+        assert record["name"] == "device-0001-debug"
+        assert "error" not in record
+        files = [
+            os.path.join(r, fn)
+            for r, _, fs in os.walk(record["path"])
+            for fn in fs
+        ]
+        assert files, "capture produced no trace files"
+        assert global_registry.get(
+            "karpenter_profiler_captures_total"
+        ).value({"trigger": "debug"}) == base + 1
+
+    def test_arm_cooldown_and_busy_slot(self, clean_eff, tmp_path):
+        clock = FakeClock()
+        prof = eff.profiler().configure(
+            clock=clock, profile_dir=str(tmp_path)
+        )
+        record = prof.arm("slo:obj", seconds=0.0)
+        assert record is not None and record["name"].startswith("device-0001")
+        # same trigger inside the cooldown window: no second capture
+        clock.step(10.0)
+        assert prof.arm("slo:obj", seconds=0.0) is None
+        # past the cooldown (and once the worker released the slot): armed
+        clock.step(eff.CAPTURE_COOLDOWN)
+        deadline = time.monotonic() + 10.0
+        while prof.snapshot()["active"] and time.monotonic() < deadline:
+            time.sleep(0.02)
+        second = prof.arm("slo:obj", seconds=0.0)
+        assert second is not None and second["name"].startswith("device-0002")
+
+    def test_unwritable_dir_degrades(self, clean_eff, tmp_path):
+        blocker = tmp_path / "file"
+        blocker.write_text("not a dir")
+        prof = eff.profiler().configure(profile_dir=str(blocker / "nested"))
+        assert prof.arm("slo:x") is None  # warned, never raised
+        result = prof.capture(0.0)
+        assert result == {
+            "error": "capture already in progress or dir unwritable"
+        }
+        assert prof.snapshot()["active"] is False
+
+    def test_unavailable_profiler_disables(self, clean_eff, tmp_path):
+        prof = eff.profiler().configure(profile_dir=str(tmp_path))
+        prof._available = False  # simulate a jaxlib without jax.profiler
+        try:
+            assert prof.enabled is False
+            assert prof.capture(0.1) is None
+            assert prof.arm("slo:x") is None
+        finally:
+            prof._available = None
+
+    def test_reset_restarts_sequence_and_cooldowns(self, clean_eff, tmp_path):
+        clock = FakeClock()
+        prof = eff.profiler().configure(
+            clock=clock, profile_dir=str(tmp_path)
+        )
+        assert prof.arm("slo:r", seconds=0.0)["name"] == "device-0001-slo-r"
+        deadline = time.monotonic() + 10.0
+        while prof.snapshot()["active"] and time.monotonic() < deadline:
+            time.sleep(0.02)
+        prof.reset()
+        assert prof.arm("slo:r", seconds=0.0)["name"] == "device-0001-slo-r"
+
+
+class TestBreachCapturePipeline:
+    """Acceptance: an SLO-breach-triggered capture lands in the flight
+    bundle — and absent --profile-dir, the breach path is untouched."""
+
+    def _operator(self, tmp_path, profile: bool):
+        from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        options = Options(
+            flight_dir=str(tmp_path / "flight"),
+            profile_dir=str(tmp_path / "profiles") if profile else "",
+        )
+        op = Operator(
+            store, KwokCloudProvider(store, clock), clock=clock,
+            options=options,
+        )
+        # the flight recorder and SLO engine are process-global: drop the
+        # previous spec's bundles/series so each test reads its own breach
+        from karpenter_tpu.observability import flight as flightmod
+        from karpenter_tpu.observability import slo as slomod
+
+        slomod.engine().reset()
+        flightmod.recorder().reset()
+        return clock, op
+
+    def test_breach_bundle_records_capture(self, clean_eff, tmp_path):
+        clock, op = self._operator(tmp_path, profile=True)
+        try:
+            op.run_once()
+            op.slo.record("solverd-availability", bad=100)
+            op.run_once()  # evaluates → breach → arm + dump
+            snap = op.flight.snapshot()
+            assert snap["bundles"], "breach dumped no bundle"
+            bundle = snap["bundles"][0]
+            assert bundle["trigger"] == "slo:solverd-availability"
+            assert bundle["path"], "bundle not written to --flight-dir"
+            header = json.loads(
+                open(bundle["path"], encoding="utf-8").readline()
+            )
+            capture = header["context"]["device_profile"]
+            assert capture["name"] == (
+                "device-0001-slo-solverd-availability"
+            )
+            assert capture["path"].startswith(str(tmp_path / "profiles"))
+            # the capture completes on its worker and leaves real files
+            deadline = time.monotonic() + 15.0
+            while (
+                op.profiler.snapshot()["active"]
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            files = [
+                os.path.join(r, fn)
+                for r, _, fs in os.walk(capture["path"])
+                for fn in fs
+            ]
+            assert files, "armed capture produced no trace files"
+        finally:
+            op.shutdown()
+
+    def test_breach_without_profile_dir_unchanged(self, clean_eff, tmp_path):
+        clock, op = self._operator(tmp_path, profile=False)
+        try:
+            op.run_once()
+            op.slo.record("solverd-availability", bad=100)
+            op.run_once()
+            snap = op.flight.snapshot()
+            assert snap["bundles"]
+            header = json.loads(
+                open(snap["bundles"][0]["path"], encoding="utf-8").readline()
+            )
+            assert "device_profile" not in header["context"]
+        finally:
+            op.shutdown()
+
+
+class TestGracefulWarmStart:
+    """Graceful-degradation spec: a backend whose cost_analysis raises
+    leaves warm start, the executable table, and the seal untouched —
+    only the cost tables stay empty."""
+
+    def test_warm_start_survives_cost_analysis_failure(
+        self, clean_eff, monkeypatch, tmp_path
+    ):
+        from karpenter_tpu.aot import compiler as aotc
+        from karpenter_tpu.aot import ladder as lmod
+        from karpenter_tpu.aot import runtime as aotrt
+        from karpenter_tpu.cloudprovider.kwok.instance_types import (
+            construct_instance_types,
+        )
+        from karpenter_tpu.ops.catalog import CatalogEngine
+
+        def boom(exe):
+            raise RuntimeError("no cost models on this backend")
+
+        monkeypatch.setattr(eff, "_extract_cost", boom)
+        ladder = lmod.make(
+            {
+                "feasibility.cube": [(1, 4)],
+                "catalog.row_compat": [(32,)],
+                "packer.solve_block": [(8,)],
+            }
+        )
+        aotrt.clear_executables()
+        try:
+            engine = CatalogEngine(construct_instance_types())
+            summary = aotc.warm_start(engine, ladder=ladder)
+            assert summary is not None
+            # cost failures are NOT warm-start errors: the boot is clean
+            assert summary["errors"] == 0
+            assert summary["buckets"] > 0
+            assert aotrt.executables(), "executables still installed"
+            stats = eff.tables().stats()
+            assert stats["entries"] == 0
+            assert stats["errors"] >= 1
+            assert kobs.registry().steady_recompiles() == 0
+        finally:
+            aotrt.clear_executables()

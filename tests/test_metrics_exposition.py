@@ -220,6 +220,59 @@ class TestRoundTrip:
         finally:
             reg.reset()
 
+    def test_efficiency_families_round_trip(self):
+        """ISSUE 15 conformance: karpenter_kernel_utilization (gauge with
+        kernel+bucket labels — bucket values carry x's/commas),
+        karpenter_profiler_captures_total (counter by trigger, trigger
+        values carry colons), and the karpenter_kernel_host_stall_fraction
+        histogram all survive the exposition round trip."""
+        from karpenter_tpu.metrics import global_registry
+
+        global_registry.get("karpenter_kernel_utilization").set(
+            0.42, {"kernel": "expo.util", "bucket": "128x64,64"}
+        )
+        global_registry.get("karpenter_profiler_captures_total").inc(
+            {"trigger": "slo:solve-latency"}
+        )
+        global_registry.get("karpenter_kernel_host_stall_fraction").observe(
+            0.97
+        )
+        fam = parse_exposition(global_registry.expose())
+
+        util = fam["karpenter_kernel_utilization"]
+        assert util["type"] == "gauge"
+        key = tuple(
+            sorted((("kernel", "expo.util"), ("bucket", "128x64,64")))
+        )
+        assert util["samples"][("karpenter_kernel_utilization", key)] == 0.42
+
+        caps = fam["karpenter_profiler_captures_total"]
+        assert caps["type"] == "counter"
+        assert caps["samples"][
+            (
+                "karpenter_profiler_captures_total",
+                (("trigger", "slo:solve-latency"),),
+            )
+        ] >= 1.0
+
+        stall = fam["karpenter_kernel_host_stall_fraction"]
+        assert stall["type"] == "histogram"
+        inf = stall["samples"][
+            ("karpenter_kernel_host_stall_fraction_bucket", (("le", "+Inf"),))
+        ]
+        count = stall["samples"][
+            ("karpenter_kernel_host_stall_fraction_count", ())
+        ]
+        assert inf == count >= 1.0
+        # 0.97 lands in the 0.99 bucket but not 0.9
+        in_99 = stall["samples"][
+            ("karpenter_kernel_host_stall_fraction_bucket", (("le", "0.99"),))
+        ]
+        in_90 = stall["samples"][
+            ("karpenter_kernel_host_stall_fraction_bucket", (("le", "0.9"),))
+        ]
+        assert in_99 - in_90 >= 1.0
+
     def test_every_emitted_line_is_parseable(self):
         """Feed the REAL global registry (whatever tests before us
         registered) through the parser: conformance must hold for the

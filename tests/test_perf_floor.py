@@ -200,6 +200,69 @@ class TestPerfFloor:
             aotrt.clear_executables()
             reg.reset()
 
+    def test_cost_tables_built_exactly_once_at_warm_start(self, tmp_path):
+        """The efficiency-observatory floor (ISSUE 15 acceptance): the HLO
+        cost tables are built exactly once per ladder bucket at AOT warm
+        start — ZERO cost_analysis calls during sealed steady-state solves
+        — and the observatory seal holds with the efficiency layer on. A
+        regression that re-runs cost_analysis per pass (an accidental
+        per-dispatch hook) fails this spec like a recompile fails the
+        zero-recompile contract."""
+        import numpy as np
+
+        from karpenter_tpu import aot
+        from karpenter_tpu.aot import ladder as lmod
+        from karpenter_tpu.aot import runtime as aotrt
+        from karpenter_tpu.aot.cache import ExecutableCache
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.observability import efficiency as eff
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.scheduling.requirements import (
+            Operator,
+            Requirement,
+            Requirements,
+        )
+
+        ladder = lmod.make(
+            {"feasibility.cube": [(1, 4), (4, 8)],
+             "catalog.row_compat": [(32,)]}
+        )
+        reg = kobs.registry()
+        reg.reset()
+        eff.tables().reset()
+        aotrt.configure(ladder, ExecutableCache(str(tmp_path)))
+        try:
+            engine = CatalogEngine(CATALOG)
+            summary = aot.warm_start(engine)
+            stats = eff.tables().stats()
+            # one table entry per warm-started bucket, one analysis each
+            assert stats["entries"] == summary["buckets"] > 0, (stats, summary)
+            assert stats["analysis_calls"] == stats["entries"]
+            assert stats["errors"] == 0
+            calls_after_warm = stats["analysis_calls"]
+            rows = engine.rows_for(
+                Requirements(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+            )
+            req = np.zeros((1, len(engine.resource_dims)))
+            engine.feasibility([rows], req)
+            reg.seal()
+            recompiles = reg.steady_recompiles()
+            for _ in range(5):
+                with reg.batch_scope(label="cost-floor"):
+                    engine.feasibility([rows], req)
+            # THE floor: steady passes pay zero cost_analysis calls and
+            # the zero-recompile seal holds with the efficiency layer on
+            assert eff.tables().stats()["analysis_calls"] == calls_after_warm
+            assert reg.steady_recompiles() == recompiles
+            # and the tables actually feed the cost view
+            view = eff.cost_view()
+            assert view["cost_tables"]["entries"] == summary["buckets"]
+        finally:
+            aotrt.configure(None, None)
+            aotrt.clear_executables()
+            eff.tables().reset()
+            reg.reset()
+
     def test_deliberate_regression_fails_the_floor(self, monkeypatch):
         """Force the regression the floor exists to catch — topo solves
         pushed back onto the host per-pod loop (ffd_topo.supported False) —

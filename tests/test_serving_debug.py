@@ -12,7 +12,7 @@ from karpenter_tpu.operator.serving import Server, ServingConfig
 
 def make_server(
     enable_profiling=False, solverd_stats=None, heap_stats=None,
-    kernel_snapshot=None,
+    kernel_snapshot=None, device_profile=None,
 ):
     cfg = ServingConfig(
         metrics_text=lambda: "karpenter_test_metric 1\n",
@@ -22,6 +22,7 @@ def make_server(
         solverd_stats=solverd_stats,
         heap_stats=heap_stats,
         kernel_snapshot=kernel_snapshot,
+        device_profile=device_profile,
     )
     return Server(0, cfg, host="127.0.0.1").start()
 
@@ -245,6 +246,178 @@ class TestKernelsEndpoint:
             )
         finally:
             server.stop()
+
+
+class TestKernelsEfficiencyViews:
+    """/debug/kernels?view=cost and ?view=timeline (ISSUE 15): 200s, the
+    cost drill-down, 404 on unknown kernels, and unwired→404."""
+
+    def _wired(self):
+        import jax
+        import numpy as np
+
+        from karpenter_tpu.observability import efficiency as eff
+        from karpenter_tpu.observability import kernels as kobs
+        from karpenter_tpu.tracing import kernel as ktime
+
+        reg = kobs.registry()
+        reg.reset()
+        eff.tables().reset()
+        f = jax.jit(lambda x: x @ x)
+        x = np.ones((8, 8), np.float32)
+        ktime.dispatch(f, x, kernel="spec.eff")
+        with reg.batch_scope(label="spec-batch"):
+            with ktime.measure():
+                ktime.dispatch(f, x, kernel="spec.eff")
+        eff.note_executable(
+            "spec.eff", "8x8",
+            f.lower(jax.ShapeDtypeStruct((8, 8), np.float32)).compile(),
+        )
+        return reg, eff, reg.debug_snapshot
+
+    def _teardown(self, reg, eff):
+        reg.reset()
+        eff.tables().reset()
+
+    def test_cost_view_and_drilldown(self):
+        reg, eff, snapshot = self._wired()
+        server = make_server(kernel_snapshot=snapshot)
+        try:
+            code, body = get(server, "/debug/kernels?view=cost")
+            assert code == 200
+            view = json.loads(body)
+            assert view["cost_tables"]["entries"] == 1
+            row = view["rows"][0]
+            assert row["kernel"] == "spec.eff" and row["bucket"] == "8x8"
+            assert row["floor_s"] > 0
+            assert row["utilization"] > 0  # joined with the measured wall
+            code, body = get(
+                server, "/debug/kernels?view=cost&kernel=spec.eff"
+            )
+            assert code == 200
+            assert len(json.loads(body)["rows"]) == 1
+        finally:
+            server.stop()
+            self._teardown(reg, eff)
+
+    def test_cost_view_unknown_kernel_404(self):
+        reg, eff, snapshot = self._wired()
+        server = make_server(kernel_snapshot=snapshot)
+        try:
+            code, body = get(
+                server, "/debug/kernels?view=cost&kernel=missing"
+            )
+            assert code == 404
+            assert "unknown kernel" in body
+        finally:
+            server.stop()
+            self._teardown(reg, eff)
+
+    def test_timeline_view(self):
+        reg, eff, snapshot = self._wired()
+        server = make_server(kernel_snapshot=snapshot)
+        try:
+            code, body = get(server, "/debug/kernels?view=timeline")
+            assert code == 200
+            view = json.loads(body)
+            assert "steady" in view
+            (batch,) = view["batches"]
+            assert batch["label"] == "spec-batch"
+            assert batch["dispatches"] == 1
+            assert 0.0 <= batch["host_stall_fraction"] <= 1.0
+            assert batch["timeline"][0]["kernel"] == "spec.eff"
+        finally:
+            server.stop()
+            self._teardown(reg, eff)
+
+    def test_views_unwired_404(self, plain_server):
+        for view in ("cost", "timeline"):
+            code, _ = get(plain_server, f"/debug/kernels?view={view}")
+            assert code == 404
+
+
+class TestDeviceProfileEndpoint:
+    """/debug/profile/device: 200 with a capture record, 404 when device
+    profiling is off (callable answers None), 400 on bad seconds, and
+    unwired→404."""
+
+    def test_capture_served(self):
+        calls = []
+
+        def fake(seconds):
+            calls.append(seconds)
+            return {"name": "device-0001-debug", "seconds": seconds}
+
+        server = make_server(device_profile=fake)
+        try:
+            code, body = get(server, "/debug/profile/device?seconds=0.5")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["name"] == "device-0001-debug"
+            assert calls == [0.5]
+        finally:
+            server.stop()
+
+    def test_profiling_off_404(self):
+        server = make_server(device_profile=lambda seconds: None)
+        try:
+            code, body = get(server, "/debug/profile/device")
+            assert code == 404
+            assert "disabled" in body
+        finally:
+            server.stop()
+
+    def test_bad_seconds_400(self):
+        server = make_server(
+            device_profile=lambda seconds: {"name": "never"}
+        )
+        try:
+            for q in ("seconds=nope", "seconds=-1", "seconds=31"):
+                code, body = get(server, f"/debug/profile/device?{q}")
+                assert code == 400, q
+                assert "seconds" in body
+        finally:
+            server.stop()
+
+    def test_unwired_404(self, plain_server):
+        code, body = get(plain_server, "/debug/profile/device")
+        assert code == 404
+        assert "not found" in body
+
+    def test_from_operator_real_capture(self, tmp_path):
+        """End-to-end over real HTTP: the operator's callable runs a real
+        jax.profiler capture into --profile-dir."""
+        import os
+
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.observability import efficiency as eff
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        operator = Operator(
+            Store(clock=clock), FakeCloudProvider(), clock=clock,
+            options=Options(profile_dir=str(tmp_path)),
+        )
+        eff.profiler().reset()
+        server = make_server(device_profile=operator.device_profile_snapshot)
+        try:
+            code, body = get(server, "/debug/profile/device?seconds=0")
+            assert code == 200
+            record = json.loads(body)
+            assert record["path"].startswith(str(tmp_path))
+            files = [
+                os.path.join(r, fn)
+                for r, _, fs in os.walk(record["path"])
+                for fn in fs
+            ]
+            assert files, "no trace files written"
+        finally:
+            server.stop()
+            eff.profiler().configure(profile_dir="")
+            eff.profiler().reset()
 
 
 class TestSolverdEndpoint:

@@ -107,6 +107,36 @@ class TestReport:
     def test_steady_state_injects_no_faults(self, result):
         assert all(v == 0 for v in result.report["faults"].values())
 
+    def test_efficiency_section(self, result):
+        """ISSUE 15 acceptance surface: every steady solve batch reports
+        into report["kernels"]["efficiency"] — host_stall_fraction in
+        [0, 1], batch counts consistent — and the section rides OUTSIDE
+        the kernels digest (cost models are machine facts). On this
+        host-routed scenario the fraction is EXACTLY 1.0: no device
+        dispatch was awaited, a deterministic fact."""
+        kernels = result.report["kernels"]
+        eff = kernels["efficiency"]
+        assert eff["steady_batches"] > 0
+        assert (
+            eff["device_batches"] + eff["host_only_batches"]
+            == eff["steady_batches"]
+        )
+        assert 0.0 <= eff["host_stall_fraction"] <= 1.0
+        assert eff["host_stall_fraction"] == 1.0  # fully host-paced
+        assert eff["profiler_captures_armed"] == 0
+        # outside the digest: the digest reproduces with the section
+        # stripped, exactly like the aot section
+        import hashlib as _hashlib
+        import json as _json
+
+        deterministic = {
+            "kernels": kernels["kernels"],
+            "steady_recompiles": kernels["steady_recompiles"],
+        }
+        assert kernels["digest"] == _hashlib.sha256(
+            _json.dumps(deterministic, sort_keys=True).encode()
+        ).hexdigest()
+
     def test_lifecycle_events_in_order(self, result):
         """claim first, node after registration delay, binds after that."""
         evs = [e["ev"] for e in result.log]
