@@ -48,6 +48,7 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             slo_snapshot=operator.slo_snapshot,
             flight_snapshot=operator.flight_snapshot,
             device_profile=operator.device_profile_snapshot,
+            journal_snapshot=operator.journal_snapshot,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())

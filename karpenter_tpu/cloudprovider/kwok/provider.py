@@ -23,6 +23,7 @@ from karpenter_tpu.cloudprovider.types import (
     NodeClaimNotFoundError,
     order_by_price,
 )
+from karpenter_tpu.runtime.journal import IDEMPOTENCY_ANNOTATION
 from karpenter_tpu.runtime.store import AlreadyExists, Store
 from karpenter_tpu.scheduling.requirements import requirements_from_dicts
 from karpenter_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
@@ -41,6 +42,7 @@ class _Instance:
     instance_type: InstanceType
     node_due_at: float
     node_created: bool = False
+    idempotency_key: str = ""
 
 
 class KwokCloudProvider(CloudProvider):
@@ -55,6 +57,15 @@ class KwokCloudProvider(CloudProvider):
         self.registration_delay = registration_delay
         self._instances: dict[str, _Instance] = {}
         self._counter = 0
+        # launch idempotency: key (claim annotation, runtime/journal.py) ->
+        # provider id, so a retried or crash-replayed create returns the
+        # instance it already acknowledged instead of launching twice
+        self._keys: dict[str, str] = {}
+        # key -> actual materializations, kept across deletes; any key with
+        # more than one launch is a double-launch (the sim's crash sweep
+        # asserts this stays zero)
+        self._key_launches: dict[str, int] = {}
+        self.idempotent_hits = 0
         # NodeOverlay application at launch (the provider-side half: the
         # operator wraps get_instance_types consumers with the same overlays,
         # so launch picks by the SAME adjusted prices the scheduler saw).
@@ -67,6 +78,16 @@ class KwokCloudProvider(CloudProvider):
     # -- CloudProvider boundary ---------------------------------------------
 
     def create(self, node_claim: NodeClaim) -> NodeClaim:
+        # key-idempotent create: the same idempotency key returns the
+        # existing acknowledged instance — an ambiguous failure (ack lost
+        # to a crash or a raised error) retried with the same key cannot
+        # materialize a second node for one NodeClaim
+        key = node_claim.metadata.annotations.get(IDEMPOTENCY_ANNOTATION, "")
+        if key:
+            existing = self._keys.get(key)
+            if existing is not None and existing in self._instances:
+                self.idempotent_hits += 1
+                return copy.deepcopy(self._instances[existing].claim)
         reqs = requirements_from_dicts(node_claim.spec.requirements)
         from karpenter_tpu.utils import resources as res
 
@@ -121,14 +142,25 @@ class KwokCloudProvider(CloudProvider):
             claim=created,
             instance_type=it,
             node_due_at=self.clock.now() + self.registration_delay,
+            idempotency_key=key,
         )
+        if key:
+            self._keys[key] = created.status.provider_id
+            self._key_launches[key] = self._key_launches.get(key, 0) + 1
         return created
+
+    def double_launches(self) -> int:
+        """Keys that materialized more than one instance — the crash-sweep
+        invariant (zero, always)."""
+        return sum(n - 1 for n in self._key_launches.values() if n > 1)
 
     def delete(self, node_claim: NodeClaim) -> None:
         pid = node_claim.status.provider_id
         if pid not in self._instances:
             raise NodeClaimNotFoundError(pid)
-        del self._instances[pid]
+        inst = self._instances.pop(pid)
+        if inst.idempotency_key:
+            self._keys.pop(inst.idempotency_key, None)
 
     def get(self, provider_id: str) -> NodeClaim:
         inst = self._instances.get(provider_id)
@@ -154,7 +186,10 @@ class KwokCloudProvider(CloudProvider):
         call, the way a real cloud takes spot capacity back. Subsequent
         get() raises NodeClaimNotFoundError and the GC controller reaps the
         claim. Returns whether the instance existed."""
-        return self._instances.pop(provider_id, None) is not None
+        inst = self._instances.pop(provider_id, None)
+        if inst is not None and inst.idempotency_key:
+            self._keys.pop(inst.idempotency_key, None)
+        return inst is not None
 
     # -- the fake kubelet (kwok controller) ---------------------------------
 

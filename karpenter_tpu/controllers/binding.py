@@ -47,11 +47,13 @@ class BindingController:
         clock: Clock,
         recorder: Recorder,
         tenant: str = "",
+        journal=None,
     ):
         self.store = store
         self.cluster = cluster
         self.clock = clock
         self.recorder = recorder
+        self.journal = journal
         # SLO attribution: the cluster this operator serves (--cluster-name);
         # bind latencies recorded per tenant in the fleet simulation
         self.tenant = tenant
@@ -205,6 +207,15 @@ class BindingController:
     # -- mutations ----------------------------------------------------------
 
     def _bind(self, pod: Pod, sn: StateNode) -> None:
+        seq = None
+        if self.journal is not None:
+            seq = self.journal.intent(
+                "pod.bind",
+                uid=pod.metadata.uid,
+                key=f"bind/{pod.metadata.uid}",
+                pod=pod.metadata.name,
+                node=sn.node.metadata.name,
+            )
         pod.spec.node_name = sn.node.metadata.name
         pod.status.phase = "Running"
         pod.status.conditions = [
@@ -214,6 +225,8 @@ class BindingController:
             Condition(type=podutil.POD_SCHEDULED, status="True", reason="Bound")
         )
         self.store.update(pod)
+        if seq is not None:
+            self.journal.done(seq)
         # Keep the live mirror current within this pass so subsequent binds
         # in the same sweep see the node's reduced headroom.
         self.cluster.update_pod(pod)

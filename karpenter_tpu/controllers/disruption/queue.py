@@ -62,12 +62,14 @@ class Queue:
         cluster: Cluster,
         clock: Clock,
         provisioner: "Provisioner",
+        journal=None,
     ):
         self.store = store
         self.recorder = recorder
         self.cluster = cluster
         self.clock = clock
         self.provisioner = provisioner
+        self.journal = journal
         self._commands: dict[str, Command] = {}  # provider id -> command
 
     def has_any(self, *provider_ids: str) -> bool:
@@ -89,9 +91,30 @@ class Queue:
         provider_ids = [c.provider_id() for c in cmd.candidates]
         if self.has_any(*provider_ids):
             raise ValueError("candidate is being disrupted")
-        marked = self._mark_disrupted(cmd)
-        if len(marked) != len(cmd.candidates) and (cmd.replacements or not marked):
-            raise ValueError("marking disrupted failed")
+        # intent BEFORE the first effect (taints/conditions): a crash
+        # anywhere in this command leaves a pending journal record carrying
+        # the candidates, and Operator.recover() rolls the marks back so
+        # disruption-budget headroom never leaks
+        seq = None
+        if self.journal is not None:
+            names = sorted(c.name() for c in cmd.candidates)
+            seq = self.journal.intent(
+                "disruption.command",
+                uid=names[0] if names else "",
+                key=f"disrupt/{'+'.join(names)}",
+                candidates=names,
+                provider_ids=sorted(provider_ids),
+                reason=cmd.reason,
+            )
+            cmd.journal_seq = seq
+        try:
+            marked = self._mark_disrupted(cmd)
+            if len(marked) != len(cmd.candidates) and (cmd.replacements or not marked):
+                raise ValueError("marking disrupted failed")
+        except Exception as e:  # noqa: BLE001 — close the intent, then surface
+            if seq is not None:
+                self.journal.failed(seq, error=str(e))
+            raise
         cmd.candidates = marked
         _log.info(
             "disrupting nodeclaim(s)",
@@ -99,7 +122,12 @@ class Queue:
             candidates=[c.name() for c in cmd.candidates],
             replacements=len(cmd.replacements),
         )
-        self._create_replacements(cmd)
+        try:
+            self._create_replacements(cmd)
+        except Exception as e:  # noqa: BLE001 — close the intent, then surface
+            if seq is not None:
+                self.journal.failed(seq, error=str(e))
+            raise
         if cmd.results is not None:
             cmd.results.record(self.recorder, self.cluster)
         for c in cmd.candidates:
@@ -239,5 +267,11 @@ class Queue:
             self.cluster.unmark_for_deletion(
                 *[c.provider_id() for c in cmd.candidates]
             )
+        seq = getattr(cmd, "journal_seq", None)
+        if seq is not None and self.journal is not None:
+            if cmd.succeeded:
+                self.journal.done(seq)
+            else:
+                self.journal.failed(seq, error="rolled back")
         for c in cmd.candidates:
             self._commands.pop(c.provider_id(), None)

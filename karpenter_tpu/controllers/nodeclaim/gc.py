@@ -99,6 +99,13 @@ class GarbageCollectionController:
         self.recorder = recorder
         self._last_run = -GC_PERIOD
 
+    def expedite(self) -> None:
+        """Make the next reconcile sweep immediately instead of waiting out
+        GC_PERIOD — recovery calls this after marking orphans so instances
+        acknowledged by the cloud but owned by no claim are reaped on the
+        first post-recovery pass."""
+        self._last_run = self.clock.now() - GC_PERIOD
+
     def reconcile(self) -> None:
         if self.clock.now() - self._last_run < GC_PERIOD:
             return

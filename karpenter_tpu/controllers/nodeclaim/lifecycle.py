@@ -28,6 +28,7 @@ from karpenter_tpu.cloudprovider.types import (
 )
 from karpenter_tpu.events.recorder import Event, Recorder
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.runtime.journal import IDEMPOTENCY_ANNOTATION, Journal
 from karpenter_tpu.runtime.store import NotFound as StoreNotFound
 from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.scheduling.requirements import requirements_from_dicts
@@ -68,11 +69,13 @@ class LifecycleController:
         cloud_provider: CloudProvider,
         recorder: Recorder,
         clock: Clock,
+        journal: Optional[Journal] = None,
     ):
         self.store = store
         self.cloud_provider = cloud_provider
         self.recorder = recorder
         self.clock = clock
+        self.journal = journal
 
     def reconcile(self, claim: NodeClaim) -> None:
         if claim.metadata.deletion_timestamp is not None:
@@ -99,15 +102,35 @@ class LifecycleController:
         # the launch hop re-joins the claim's scheduling-journey trace (the
         # provisioner linked it at create); the breaker's cloudprovider
         # span nests under this one, so breaker state lands in the journey
+        # Idempotency key: stamped once per claim (derived from its uid, so
+        # retries of the SAME claim reuse it) and carried as an annotation
+        # into cloud_provider.create — an ambiguous failure (ack-then-raise,
+        # breaker timeout, crash between ack and the done record) retried
+        # next pass resolves to the instance already launched instead of
+        # materializing a second node.
+        key = claim.metadata.annotations.get(IDEMPOTENCY_ANNOTATION, "")
+        if not key:
+            key = f"launch/{claim.metadata.uid}"
+            claim.metadata.annotations[IDEMPOTENCY_ANNOTATION] = key
         tracer = tracing.tracer()
         with tracer.span(
             "nodeclaim.launch",
             parent=tracer.linked("nodeclaim", claim.metadata.name),
             nodeclaim=claim.metadata.name,
         ) as span:
+            seq = None
+            if self.journal is not None:
+                seq = self.journal.intent(
+                    "nodeclaim.launch",
+                    uid=claim.metadata.uid,
+                    key=key,
+                    nodeclaim=claim.metadata.name,
+                )
             try:
                 created = self.cloud_provider.create(claim)
             except InsufficientCapacityError as e:
+                if seq is not None:
+                    self.journal.failed(seq, error=str(e))
                 span.fail(e)
                 span.set_attr(outcome="insufficient_capacity")
                 self.recorder.publish(
@@ -116,11 +139,19 @@ class LifecycleController:
                 self._delete_claim(claim, "insufficient_capacity")
                 return
             except NodeClassNotReadyError as e:
+                if seq is not None:
+                    self.journal.failed(seq, error=str(e))
                 span.fail(e)
                 span.set_attr(outcome="nodeclass_not_ready")
                 self._delete_claim(claim, "nodeclass_not_ready")
                 return
             except CreateError as e:
+                # ambiguous: the provider may have acknowledged before
+                # raising — the intent stays journaled as failed, but the
+                # idempotency key makes the retry converge on whatever
+                # actually launched
+                if seq is not None:
+                    self.journal.failed(seq, error=str(e))
                 span.fail(e)
                 span.set_attr(outcome="launch_failed")
                 claim.set_condition(
@@ -131,6 +162,8 @@ class LifecycleController:
                     now=self.clock.now(),
                 )
                 return
+            if seq is not None:
+                self.journal.done(seq, provider_id=created.status.provider_id)
             _populate_node_claim_details(claim, created)
             claim.set_condition(CONDITION_LAUNCHED, "True", now=self.clock.now())
             span.set_attr(
@@ -371,15 +404,32 @@ class LifecycleController:
         ):
             return  # wait for node termination
         if claim.condition_is_true(CONDITION_LAUNCHED):
+            seq = None
+            if self.journal is not None:
+                seq = self.journal.intent(
+                    "nodeclaim.delete",
+                    uid=claim.metadata.uid,
+                    key=f"delete/{claim.metadata.uid}",
+                    nodeclaim=claim.metadata.name,
+                    provider_id=claim.status.provider_id,
+                )
             try:
                 self.cloud_provider.delete(claim)
+                if seq is not None:
+                    self.journal.done(seq)
                 claim.set_condition(
                     CONDITION_INSTANCE_TERMINATING, "True", now=self.clock.now()
                 )
                 self.store.apply(claim)
                 return  # wait for the instance to disappear
             except NodeClaimNotFoundError:
-                pass
+                # already gone: the delete's outcome is satisfied
+                if seq is not None:
+                    self.journal.done(seq, barrier=False, missing=True)
+            except Exception as e:  # noqa: BLE001 — close the intent, then surface
+                if seq is not None:
+                    self.journal.failed(seq, error=str(e))
+                raise
         _NODECLAIMS_TERMINATED.inc(
             {"nodepool": claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
         )

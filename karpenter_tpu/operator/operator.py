@@ -51,6 +51,7 @@ from karpenter_tpu.metrics import global_registry
 from karpenter_tpu.operator.harness import ReconcilerHarness
 from karpenter_tpu.operator.leaderelection import LeaderElector
 from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.journal import Journal
 from karpenter_tpu.runtime.store import DELETED, Store
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.state.informer import StateInformer
@@ -66,10 +67,25 @@ class Operator:
         clock: Optional[Clock] = None,
         options: Optional[Options] = None,
         engine_factory=None,
+        journal: Optional[Journal] = None,
     ):
         self.clock = clock or Clock()
         self.store = store
         self.options = options or Options()
+        # write-ahead intent journal (runtime/journal.py): every externally
+        # visible mutation records intent before the side effect. A caller
+        # may inject a journal (the sim shares one dir across a crash
+        # restart); by default it opens --journal-dir, or degrades to
+        # in-memory when unset/unwritable.
+        self.journal = (
+            journal
+            if journal is not None
+            else Journal(self.options.journal_dir, clock=self.clock)
+        )
+        # recovery runs once, on the first leader pass; the sim's crash
+        # restart hook observes the stats through on_recover
+        self._recovered = False
+        self.on_recover = None
         # the process-global tracer follows the operator's clock and tracing
         # options (same pattern as the metrics registry); the simulator
         # reconfigures it in deterministic mode before running
@@ -171,14 +187,16 @@ class Operator:
             self.options, engine_factory=engine_factory,
         )
         self.disruption_queue = DisruptionQueue(
-            store, self.recorder, self.cluster, self.clock, self.provisioner
+            store, self.recorder, self.cluster, self.clock, self.provisioner,
+            journal=self.journal,
         )
         self.disruption = DisruptionController(
             self.clock, store, self.provisioner, cloud_provider, self.recorder,
             self.cluster, self.disruption_queue,
         )
         self.lifecycle = LifecycleController(
-            store, cloud_provider, self.recorder, self.clock
+            store, cloud_provider, self.recorder, self.clock,
+            journal=self.journal,
         )
         self.nc_disruption = NCDisruption(store, cloud_provider, self.clock)
         self.expiration = ExpirationController(store, self.clock, self.recorder)
@@ -204,7 +222,7 @@ class Operator:
         self.np_validation = ValidationController(store, self.clock)
         self.binding = BindingController(
             store, self.cluster, self.clock, self.recorder,
-            tenant=self.options.cluster_name,
+            tenant=self.options.cluster_name, journal=self.journal,
         )
         self.overlay_validation = None
         if self.options.feature_gates.node_overlay:
@@ -291,6 +309,10 @@ class Operator:
         from karpenter_tpu.observability import kernels as kobs
 
         self.flight.register_source(self._flight_cell, self._flight_source)
+        # journal depth/appends per pass: a growing depth means intents are
+        # opening without closing — the frame that explains a stuck mutation
+        self._flight_journal = f"journal:{self.options.cluster_name or 'operator'}"
+        self.flight.register_source(self._flight_journal, self.journal.frame)
         self.flight.register_source("kernels", _kernel_delta_source())
         self.flight.register_source(
             "spans",
@@ -321,6 +343,7 @@ class Operator:
         nodeclaims provisioned this pass) — the simulator's event log and
         operators' debugging hooks consume it; other callers ignore it."""
         summary = {"bound": 0, "fabricated": 0, "provisioned": 0}
+        self.journal.set_pass(self.harness.passes + 1)
         if not self.elector.try_acquire_or_renew():
             self._was_leader = False
             self.informer.flush()
@@ -345,6 +368,16 @@ class Operator:
             # reconcile everything once, like the reference's informer
             # resync on leader start
             self._was_leader = True
+            if not self._recovered:
+                self._recovered = True
+                # the watch subscription only carries events since THIS
+                # process constructed it: booted onto a populated store
+                # (crash restart), the cluster state must replay what
+                # already exists or the scheduler plans against nothing
+                self.informer.bootstrap()
+                # journal replay next: adoptions/rollbacks must land before
+                # any controller acts on the half-finished state they resolve
+                self.recover()
             self._resync()
         self.informer.flush()
         self._dispatch()
@@ -389,6 +422,146 @@ class Operator:
         self._refresh_solver_health()
         self._observe_pass()
         return summary
+
+    # -- crash recovery ------------------------------------------------------
+
+    def recover(self) -> dict:
+        """Replay the journal against observed cluster/cloud state.
+
+        For every pending intent (written, never closed — the previous
+        incarnation died mid-mutation):
+
+        - ``nodeclaim.launch``: probe the cloud by idempotency key. An
+          acknowledged instance with a surviving claim is ADOPTED (details +
+          Launched stamped from the instance, no second create); an
+          instance with no claim is ORPHANED for gc.py's sweep to reap
+          (expedited); no instance means the effect never happened and
+          lifecycle simply relaunches under the same key.
+        - ``nodeclaim.delete``: instance still present => the delete never
+          landed, finalize retries; gone => the intent's outcome holds.
+        - ``pod.bind``: the store is the effect — bound pod => done,
+          otherwise the binding sweep re-places it.
+        - ``disruption.command``: the in-memory command died with the
+          process; roll the marks back (untaint, clear the Disrupted
+          condition, unmark deletion) so budget headroom never leaks. The
+          already-created replacements are ordinary claims consolidation
+          folds later.
+
+        Same journal => same decisions: the pending list is ordered by
+        sequence number and every probe reads deterministic state."""
+        from karpenter_tpu.apis.nodeclaim import CONDITION_LAUNCHED
+        from karpenter_tpu.controllers.nodeclaim.lifecycle import (
+            _populate_node_claim_details,
+        )
+        from karpenter_tpu.runtime.journal import IDEMPOTENCY_ANNOTATION
+
+        stats = {"replayed": 0, "adoptions": 0, "orphans": 0, "rolled_back": 0}
+        pending = self.journal.pending()
+        if not pending:
+            self.journal.mark_recovered()
+            return stats
+        self.informer.flush()
+        try:
+            instances = self.cloud_provider.list()
+        except Exception:  # noqa: BLE001 — recovery degrades, never crashes boot
+            instances = []
+        pids = set()
+        by_key = {}
+        for inst in instances:
+            pids.add(inst.status.provider_id)
+            key = inst.metadata.annotations.get(IDEMPOTENCY_ANNOTATION, "")
+            if key:
+                by_key[key] = inst
+        for rec in pending:
+            stats["replayed"] += 1
+            self.journal.note_replay()
+            action = rec.get("action", "")
+            seq = rec.get("seq", 0)
+            if action == "nodeclaim.launch":
+                inst = by_key.get(rec.get("key", ""))
+                if inst is None:
+                    # never acknowledged: lifecycle relaunches this claim
+                    # under the same key next pass
+                    self.journal.failed(seq, error="unacknowledged at recovery")
+                    continue
+                claim = self.store.try_get("NodeClaim", rec.get("nodeclaim", ""))
+                if claim is None or (
+                    rec.get("uid") and claim.metadata.uid != rec.get("uid")
+                ):
+                    # acknowledged instance, no surviving claim: orphan —
+                    # gc's two-way sweep reaps it on the next (expedited) run
+                    self.journal.note_orphan()
+                    stats["orphans"] += 1
+                    self.gc.expedite()
+                    self.journal.failed(seq, error="orphaned at recovery")
+                    continue
+                if not claim.condition_is_true(CONDITION_LAUNCHED):
+                    _populate_node_claim_details(claim, inst)
+                    claim.set_condition(
+                        CONDITION_LAUNCHED, "True", now=self.clock.now()
+                    )
+                    self.store.apply(claim)
+                    self.journal.note_adoption()
+                    stats["adoptions"] += 1
+                self.journal.done(seq, barrier=False, recovered=True)
+            elif action == "nodeclaim.delete":
+                if rec.get("provider_id", "") in pids:
+                    self.journal.failed(seq, error="unacknowledged at recovery")
+                else:
+                    self.journal.done(seq, barrier=False, recovered=True)
+            elif action == "pod.bind":
+                uid = rec.get("uid", "")
+                bound = self.store.list(
+                    "Pod",
+                    predicate=lambda p: p.metadata.uid == uid and p.spec.node_name != "",
+                )
+                if bound:
+                    self.journal.done(seq, barrier=False, recovered=True)
+                else:
+                    self.journal.failed(seq, error="unacknowledged at recovery")
+            elif action == "disruption.command":
+                self._rollback_disruption(rec)
+                self.journal.note_rollback()
+                stats["rolled_back"] += 1
+                self.journal.failed(seq, error="rolled back at recovery")
+            else:
+                self.journal.failed(seq, error=f"unknown action {action!r}")
+        self.journal.mark_recovered()
+        self.journal.compact()
+        # the crash bundle: what recovery found and decided, dumped while
+        # the flight ring still shows the boot-time state
+        try:
+            self.flight.dump("recovery", context={"recovery": dict(stats)})
+        except Exception:  # noqa: BLE001 — observability never breaks recovery
+            pass
+        if self.on_recover is not None:
+            self.on_recover(dict(stats))
+        return stats
+
+    def _rollback_disruption(self, rec: dict) -> None:
+        """Undo a crashed disruption command's marks: the queue's own
+        timeout rollback (disruption/queue.py), replayed from the journal
+        because the in-memory command died with the process."""
+        from karpenter_tpu.apis.nodeclaim import CONDITION_DISRUPTION_REASON
+        from karpenter_tpu.state.statenode import require_no_schedule_taint
+
+        candidates = set(rec.get("candidates", []) or [])
+        targets = [
+            sn
+            for sn in self.cluster.nodes.values()
+            if sn.node_claim is not None
+            and sn.node_claim.metadata.name in candidates
+        ]
+        require_no_schedule_taint(self.store, False, *targets)
+        for name in sorted(candidates):
+            claim = self.store.try_get("NodeClaim", name)
+            if (
+                claim is not None
+                and claim.get_condition(CONDITION_DISRUPTION_REASON) is not None
+            ):
+                claim.clear_condition(CONDITION_DISRUPTION_REASON)
+                self.store.update(claim)
+        self.cluster.unmark_for_deletion(*(rec.get("provider_ids", []) or []))
 
     def _observe_pass(self) -> None:
         """Per-pass observability epilogue: evaluate every SLO objective's
@@ -505,7 +678,9 @@ class Operator:
         self.elector.release()
         self.provisioner.solver.close()
         self.flight.unregister_source(self._flight_cell)
+        self.flight.unregister_source(self._flight_journal)
         self.slo.unsubscribe(f"operator:{self.options.cluster_name}")
+        self.journal.close()
 
     # -- observability ------------------------------------------------------
 
@@ -639,6 +814,13 @@ class Operator:
         listing, or one bundle's frames. None => unknown bundle (404)."""
         return self.flight.snapshot(bundle=bundle)
 
+    def journal_snapshot(self) -> Optional[dict]:
+        """/debug/journal (operator/serving.py): journal mode/depth/append
+        counters plus every pending intent — the mutations that have opened
+        but not closed, i.e. what recovery would replay if the operator
+        died right now."""
+        return self.journal.snapshot()
+
     def device_profile_snapshot(self, seconds: float) -> Optional[dict]:
         """/debug/profile/device (operator/serving.py): a synchronous
         jax.profiler capture of the next `seconds` of device activity into
@@ -706,6 +888,8 @@ class Operator:
             reasons.append("solverd unreachable")
         if self.harness.stale():
             reasons.append("no successful reconcile pass recently")
+        if self.journal.recovering():
+            reasons.append("journal recovery in progress")
         for objective in self.slo.hard_breached():
             reasons.append(
                 f"SLO availability objective {objective} in hard breach"

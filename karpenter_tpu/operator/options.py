@@ -135,6 +135,13 @@ class Options:
     # (the endpoint 404s, breaches dump bundles without captures).
     profile_dir: str = ""
 
+    # write-ahead intent journal (runtime/journal.py): journal_dir holds the
+    # fsync'd intent log replayed by Operator.recover() after a crash.
+    # Empty = in-memory journal (no crash durability; recovery still resolves
+    # intents from the same process, which is what the sim's in-process
+    # restart exercises when it shares a dir).
+    journal_dir: str = ""
+
     # reconciler harness (operator/harness.py): per-item exponential
     # backoff bounds for failing reconciles, and the cloud-provider circuit
     # breaker (consecutive retryable create/delete failures before opening;
@@ -203,6 +210,7 @@ class Options:
         parser.add_argument("--flight-dir")
         parser.add_argument("--flight-capacity", type=int)
         parser.add_argument("--profile-dir")
+        parser.add_argument("--journal-dir")
         parser.add_argument("--tracing-sample-rate", type=float)
         parser.add_argument("--trace-buffer-size", type=int)
         parser.add_argument("--requeue-base-delay", type=float)
@@ -233,6 +241,7 @@ class Options:
             "slo_specs": "SLO_SPECS",
             "flight_dir": "FLIGHT_DIR",
             "profile_dir": "PROFILE_DIR",
+            "journal_dir": "JOURNAL_DIR",
         }
         for f in fields(cls):
             if f.name == "feature_gates":

@@ -34,6 +34,7 @@ class ServingConfig:
         slo_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         flight_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         device_profile: Optional[Callable[[float], Optional[dict]]] = None,
+        journal_snapshot: Optional[Callable[[], Optional[dict]]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -66,6 +67,11 @@ class ServingConfig:
         # the ring summary + bundle listing, ?bundle= drill-down into one
         # bundle's frames (404 when unknown); unwired => 404
         self.flight_snapshot = flight_snapshot
+        # write-ahead intent journal (operator.journal_snapshot):
+        # /debug/journal serves mode/depth/append counters plus every
+        # pending intent — what recovery would replay on a crash right now;
+        # unwired => 404
+        self.journal_snapshot = journal_snapshot
         # triggered device profiling (operator.device_profile_snapshot):
         # /debug/profile/device?seconds=N runs a synchronous jax.profiler
         # capture into --profile-dir. Returns None when profiling is off
@@ -281,6 +287,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if snap is None:
                     self._respond(
                         404, json.dumps({"error": "unknown bundle"}),
+                        "application/json",
+                    )
+                else:
+                    self._respond(200, json.dumps(snap), "application/json")
+            elif url.path == "/debug/journal" and cfg.journal_snapshot is not None:
+                import json
+
+                snap = cfg.journal_snapshot()
+                if snap is None:
+                    self._respond(
+                        404, json.dumps({"error": "journal unavailable"}),
                         "application/json",
                     )
                 else:

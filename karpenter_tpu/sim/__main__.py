@@ -84,6 +84,14 @@ def main(argv=None) -> int:
         "is recorded in the breach's flight bundle (empty = disabled)",
     )
     parser.add_argument(
+        "--journal-dir",
+        default="",
+        help="write-ahead intent journal directory: every externally-"
+        "visible mutation is journaled here and injected operator crashes "
+        "recover from it (crash scenarios default to a run-scoped tempdir "
+        "when unset)",
+    )
+    parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
     parser.add_argument(
@@ -123,6 +131,7 @@ def main(argv=None) -> int:
         or args.shard_devices
         or args.flight_dir
         or args.profile_dir
+        or args.journal_dir
     ):
         from karpenter_tpu.operator.options import Options
 
@@ -132,6 +141,7 @@ def main(argv=None) -> int:
             solver_pod_shard_axis=args.shard_devices,
             flight_dir=args.flight_dir,
             profile_dir=args.profile_dir,
+            journal_dir=args.journal_dir,
         )
 
     if trace.get("fleet"):

@@ -50,6 +50,7 @@ class FaultyCloudProvider(CloudProvider):
         clock: Clock,
         launch_failure_rate: float = 0.0,
         insufficient_capacity_rate: float = 0.0,
+        ack_then_raise_rate: float = 0.0,
         api_latency: float = 0.0,
         api_jitter: float = 0.0,
         outages: Optional[list[tuple[float, float]]] = None,
@@ -60,6 +61,7 @@ class FaultyCloudProvider(CloudProvider):
         self.clock = clock
         self.launch_failure_rate = launch_failure_rate
         self.insufficient_capacity_rate = insufficient_capacity_rate
+        self.ack_then_raise_rate = ack_then_raise_rate
         self.api_latency = api_latency
         self.api_jitter = api_jitter
         # absolute virtual-time [start, end) windows where EVERY
@@ -68,6 +70,7 @@ class FaultyCloudProvider(CloudProvider):
         self.on_fault = on_fault or _noop_on_fault
         self.launch_failures = 0
         self.capacity_errors = 0
+        self.ack_then_raise_failures = 0
         self.outage_failures = 0
 
     def _lag(self) -> None:
@@ -99,6 +102,24 @@ class FaultyCloudProvider(CloudProvider):
             self.capacity_errors += 1
             self.on_fault("fault-ice", nodeclaim=node_claim.metadata.name)
             raise InsufficientCapacityError("sim: injected capacity shortage")
+        threshold = (
+            self.launch_failure_rate
+            + self.insufficient_capacity_rate
+            + self.ack_then_raise_rate
+        )
+        if roll < threshold:
+            # the ambiguous failure: the cloud API acknowledges — the
+            # instance MATERIALIZES — but the response is lost. A third
+            # band of the same single roll, so rate 0 keeps existing
+            # scenario digests byte-identical. The retry must converge via
+            # the launch idempotency key, never a second instance.
+            self.inner.create(node_claim)
+            self.ack_then_raise_failures += 1
+            self.on_fault("fault-ack-raise", nodeclaim=node_claim.metadata.name)
+            raise CreateError(
+                "sim: injected ambiguous ack (create landed, response lost)",
+                condition_reason="SimAmbiguousAck",
+            )
         return self.inner.create(node_claim)
 
     def delete(self, node_claim):

@@ -23,6 +23,8 @@ group).
 from __future__ import annotations
 
 import math
+import shutil
+import tempfile
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from random import Random
@@ -43,6 +45,7 @@ from karpenter_tpu.apis.nodepool import NodePool
 from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
 from karpenter_tpu.operator.operator import Operator
 from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.journal import IDEMPOTENCY_ANNOTATION, OperatorCrash
 from karpenter_tpu.runtime.store import Store
 from karpenter_tpu.sim import trace as tracemod
 from karpenter_tpu.sim.accounting import Accountant, node_facts
@@ -190,6 +193,7 @@ class Simulation:
             clock=self.clock,
             launch_failure_rate=faults.get("launch_failure_rate", 0.0),
             insufficient_capacity_rate=faults.get("insufficient_capacity_rate", 0.0),
+            ack_then_raise_rate=faults.get("ack_then_raise_rate", 0.0),
             api_latency=faults.get("api_latency", 0.0),
             api_jitter=faults.get("api_jitter", 0.0),
             outages=[
@@ -198,12 +202,26 @@ class Simulation:
             ],
             on_fault=self._on_fault,
         )
+        self.options = options if options is not None else Options()
+        # crash-injection scenarios need a REAL on-disk journal: the
+        # cold-restarted operator recovers by re-reading the same files the
+        # dead one fsync'd, so an in-memory journal would make the exercise
+        # vacuous. A tempdir is provisioned only when the trace actually
+        # crashes the operator and no --journal-dir was given; finalize()
+        # removes it.
+        self._journal_tmpdir = None
+        if not self.options.journal_dir and any(
+            e.get("kind") == "operator-crash" for e in trace.get("events", [])
+        ):
+            self._journal_tmpdir = tempfile.mkdtemp(prefix="ktpu-journal-")
+            self.options.journal_dir = self._journal_tmpdir
         self.operator = Operator(
-            self.store, self.provider, clock=self.clock, options=options or Options()
+            self.store, self.provider, clock=self.clock, options=self.options
         )
         # a multi-tenant coordinator (sim/fleet.py) swaps the freshly built
         # in-process client for its shared replica pool BEFORE any fault
         # wrapping, so the flaky layer and the scenario see the pool
+        self._solver_factory = solver_factory
         if solver_factory is not None:
             self.operator.provisioner.solver = solver_factory(self)
         # re-install the tracer the Operator just configured, in DETERMINISTIC
@@ -220,7 +238,7 @@ class Simulation:
                 clock=self.clock,
                 sample_rate=1.0,
                 deterministic=True,
-                buffer_size=(options or Options()).trace_buffer_size,
+                buffer_size=self.options.trace_buffer_size,
                 jsonl_path=trace_export,
             )
         else:
@@ -291,6 +309,18 @@ class Simulation:
 
         self._eff_base = effmod.snapshot_base()
         self._victim_rng = Random(f"{seed}:victims")
+        # crash-consistency ledger: counts accumulated across every injected
+        # crash and every Operator.recover() replay, folded into
+        # report["recovery"] for ALL runs (zeros on crash-free scenarios, so
+        # same-seed digest equality is unconditional)
+        self._recovery = {
+            "crashes": 0,
+            "replayed": 0,
+            "adoptions": 0,
+            "orphans": 0,
+            "rolled_back": 0,
+        }
+        self.operator.on_recover = self._on_recover
         self._groups: dict[str, _Group] = {}
         self._known_nodes: set[str] = set()
         self._known_claims: set[str] = set()
@@ -300,6 +330,18 @@ class Simulation:
 
     def _on_fault(self, ev: str, **fields) -> None:
         self.log.append(self._rel(self.clock.now()), ev, **fields)
+
+    def _on_recover(self, stats: dict) -> None:
+        self._recovery["replayed"] += stats.get("replayed", 0)
+        self._recovery["adoptions"] += stats.get("adoptions", 0)
+        self._recovery["orphans"] += stats.get("orphans", 0)
+        self._recovery["rolled_back"] += stats.get("rolled_back", 0)
+        # an all-zero recovery (every boot runs one — empty journal) stays
+        # out of the log so crash-free scenario digests are untouched
+        if any(stats.values()):
+            self.log.append(
+                self._rel(self.clock.now()), "operator-recovered", **stats
+            )
 
     def _on_slo_breach(self, breach) -> None:
         self.log.append(
@@ -343,7 +385,14 @@ class Simulation:
         while self._events and self.t0 + self._events[0]["at"] <= self.clock.now():
             self._apply(self._events.pop(0))
         if self.clock.now() >= self._next_pass:
-            summary = self.operator.run_once()
+            try:
+                summary = self.operator.run_once()
+            except OperatorCrash as crash:
+                # the injected kill: the pass dies mid-flight at a journal
+                # barrier; a cold operator replaces it on the same store +
+                # journal dir and recovers on its first leader pass
+                self._crash_restart(crash)
+                summary = {}
             self._workloads()
             self._observe(summary)
             self._next_pass = self.clock.now() + self._tick
@@ -384,7 +433,38 @@ class Simulation:
             seed=self.seed,
             solver_stats=self._solver_stats(),
         )
+        # crash-consistency verdict — in EVERY report (zeros on crash-free
+        # runs), inside the deterministic surface: counts from the injected
+        # crashes and the recoveries they forced, plus the two invariants
+        # the journal exists to hold. double_launches is kwok's per-key
+        # materialization ledger (kept across deletes). orphans_leaked is
+        # an end-of-run sweep: an acknowledged instance is leaked only if
+        # NO claim owns it by provider id or by idempotency key — a claim
+        # mid-retry (create acked, response lost on the final pass) still
+        # owns its instance by key and will converge, so it doesn't count.
+        claims = self.store.list("NodeClaim")
+        store_pids = {c.status.provider_id for c in claims if c.status.provider_id}
+        store_keys = {
+            c.metadata.annotations.get(IDEMPOTENCY_ANNOTATION, "") for c in claims
+        }
+        report["recovery"] = {
+            "crashes": self._recovery["crashes"],
+            "replayed_intents": self._recovery["replayed"],
+            "adoptions": self._recovery["adoptions"],
+            "orphans_marked": self._recovery["orphans"],
+            "rolled_back": self._recovery["rolled_back"],
+            "double_launches": self.kwok.double_launches(),
+            "orphans_leaked": sum(
+                1
+                for inst in self.kwok.list()
+                if inst.status.provider_id not in store_pids
+                and inst.metadata.annotations.get(IDEMPOTENCY_ANNOTATION, "")
+                not in store_keys
+            ),
+        }
         self.operator.shutdown()
+        if self._journal_tmpdir is not None:
+            shutil.rmtree(self._journal_tmpdir, ignore_errors=True)
         if not process_sections:
             return report
         # fold the scheduling traces into the report: the span-log
@@ -508,6 +588,13 @@ class Simulation:
             )
         elif kind == "solverd-restart":
             self._restart_solverd()
+        elif kind == "operator-crash":
+            # arm a one-shot kill at a named journal barrier; it fires on
+            # the next matching intent/done, possibly several passes later
+            self.operator.journal.arm_crash(
+                ev.get("barrier", "post-intent-pre-effect"),
+                action=ev.get("action"),
+            )
         else:
             raise ValueError(f"unknown trace event kind {kind!r}")
 
@@ -569,6 +656,80 @@ class Simulation:
         prov._prewarm_traced = False
         kobs.registry().unseal()
         self.log.append(self._rel(self.clock.now()), "solverd-restart")
+
+    def _crash_restart(self, crash: OperatorCrash) -> None:
+        """Cold-restart the operator after an injected crash. The dying
+        process gets NO orderly shutdown — only what the OS does for it
+        (file handles drop; every journal frame was already fsync'd at
+        append). The replacement is a fresh Operator on the same durable
+        substrate: it stands by until the dead incumbent's lease goes stale
+        (~15s virtual time), takes over, and runs Operator.recover()
+        against the re-read journal before its first resync — adoption by
+        idempotency key, orphan marking + GC expedite, and disruption
+        rollback all happen there. In-process solver state dies with the
+        operator (same cold-engine discipline as _restart_solverd), so the
+        warm-restart contract — zero steady recompiles when the AOT cache
+        is configured — is honestly exercised by the re-prewarm."""
+        from karpenter_tpu.aot import runtime as aotrt
+        from karpenter_tpu.controllers.provisioning import (
+            provisioner as provmod,
+        )
+        from karpenter_tpu.observability import kernels as kobs
+
+        self._recovery["crashes"] += 1
+        self.log.append(
+            self._rel(self.clock.now()),
+            "operator-crash",
+            barrier=crash.barrier,
+            action=crash.action or "",
+        )
+        old = self.operator
+        old.journal.close()
+        try:
+            old.provisioner.solver.close()
+        except Exception:  # noqa: BLE001 — a dying process can't block the sim
+            pass
+        # flight/SLO sources re-register under the same keys (keyed
+        # replace), so the dead operator's callbacks fall away with it
+        self.operator = Operator(
+            self.store, self.provider, clock=self.clock, options=self.options
+        )
+        if self._solver_factory is not None:
+            self.operator.provisioner.solver = self._solver_factory(self)
+        self.operator.tracer = self.tracer
+        self.operator.on_recover = self._on_recover
+        # the new process's breaker transitions belong in the same
+        # observable record as the old one's
+        self.operator.breaker.subscribe(
+            lambda old_state, new_state: self.log.append(
+                self._rel(self.clock.now()),
+                "breaker",
+                **{"from": old_state, "to": new_state},
+            )
+        )
+        # the scenario's fault profile survives the restart: re-wrap the
+        # fresh client, continuing the established rng stream (byte
+        # determinism depends on continuing it, not reseeding)
+        if self._solver_rejection_rate > 0:
+            self.operator.provisioner.solver = FlakySolverClient(
+                self.operator.provisioner.solver,
+                rng=self._solver_fault_rng,
+                rejection_rate=self._solver_rejection_rate,
+                on_fault=self._on_fault,
+            )
+        # cold-engine discipline, exactly as _restart_solverd: the crashed
+        # process's engines and executables are gone; a configured AOT
+        # cache is what makes the re-prewarm fast instead of a recompile
+        provmod._ENGINE_CONTENT_CACHE.clear()
+        aotrt.clear_executables()
+        if aotrt.enabled():
+            try:
+                import jax
+
+                jax.clear_caches()
+            except Exception:  # noqa: BLE001 — jax never imported: nothing to clear
+                pass
+        kobs.registry().unseal()
 
     def _submit(self, group: _Group, name: str) -> None:
         pod = build_pod(name, group.name, group.pod_spec)

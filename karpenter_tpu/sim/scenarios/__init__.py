@@ -88,6 +88,12 @@ register(
     "scenario (digests match across --shard-devices sizes)",
 )
 register(
+    "crash-churn",
+    tracemod.crash_churn,
+    "operator killed at every journal barrier class mid-churn; cold restarts "
+    "recover from the write-ahead journal with zero double-launches",
+)
+register(
     "consolidation-churn",
     tracemod.consolidation_churn,
     "fan-out waves drain into underutilized fleets; multi-node frontier consolidation folds them",

@@ -15,6 +15,8 @@ artifact. Version 1 shape:
       "faults": {                   # probabilistic per-call fault rates
         "launch_failure_rate": 0.0,        # CreateError (retryable)
         "insufficient_capacity_rate": 0.0, # ICE (claim deleted, re-solved)
+        "ack_then_raise_rate": 0.0,        # create LANDS, response lost —
+                                           #   retry must converge by key
         "api_latency": 0.0,                # virtual s added per cloud call
         "api_jitter": 0.0,                 # + uniform[0, jitter)
         "solver_rejection_rate": 0.0,      # QueueFullError per solve
@@ -36,7 +38,13 @@ artifact. Version 1 shape:
         {"at": 90.0, "kind": "interrupt", "count": 1,
          "mode": "graceful",        # delete NodeClaim (interruption notice)
          "capacity_type": "spot"},  # victim filter
-        {"at": 150.0, "kind": "interrupt", "count": 1, "mode": "reclaim"}
+        {"at": 150.0, "kind": "interrupt", "count": 1, "mode": "reclaim"},
+        {"at": 180.0, "kind": "operator-crash",  # arm a one-shot kill at a
+         "barrier": "post-intent-pre-effect",    #   journal barrier: also
+                                                 #   pre-intent /
+                                                 #   post-effect-pre-done
+         "action": "nodeclaim.launch"}           # optional: fire only on
+                                                 #   this intent type
       ]
     }
 
@@ -551,6 +559,70 @@ def fleet_replica_kill(rng: Random) -> dict:
             ],
         )
     )
+    return trace
+
+
+def crash_churn(rng: Random) -> dict:
+    """The crash-consistency gauntlet: service + wave churn (launches,
+    binds, consolidation, an interruption) with the OPERATOR killed at all
+    three journal barrier classes mid-run, against an ambiguous cloud
+    (creates that land but whose acks are lost). Each kill cold-restarts
+    the operator from the on-disk journal: the replacement waits out the
+    dead incumbent's lease, replays pending intents — adopting
+    acknowledged launches by idempotency key, rolling back in-flight
+    disruption — and the run must end with zero double-launched NodeClaims
+    and zero leaked instances. Each crash is armed shortly BEFORE a demand
+    wave so the kill lands on that wave's intent flow; the last crash
+    lands well over 200s before the end so GC's 2-minute sweep reaps
+    anything recovery orphaned."""
+    duration = 600.0
+    trace = _base("crash-churn", duration=duration)
+    trace["faults"] = {
+        # the ambiguous failure the idempotency key exists for: the create
+        # LANDS but the response is lost; the journaled retry must converge
+        # on the instance already launched, never a second one
+        "ack_then_raise_rate": 0.15,
+        "launch_failure_rate": 0.1,
+    }
+
+    def wave(i: int, at: float, until: float) -> dict:
+        return {
+            "at": at,
+            "kind": "submit",
+            "group": f"wave-{i}",
+            "count": 3 + rng.randrange(2),
+            # big enough that a wave can't bind onto existing headroom:
+            # every wave forces fresh launch intents for the kill to land on
+            "pod": {"cpu": "3", "memory": "4Gi"},
+            "until": until,
+            "replace": True,
+        }
+
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "svc",
+            "count": 3 + rng.randrange(3),
+            "pod": {"cpu": "2", "memory": "2Gi"},
+            "replace": True,
+        },
+        # killed after an intent is durable but before its effect reaches
+        # the cloud: recovery finds no instance, the claim relaunches
+        {"at": 38.0, "kind": "operator-crash",
+         "barrier": "post-intent-pre-effect"},
+        wave(0, 40.0, 160.0),
+        # killed after the cloud acked a launch but before the done record:
+        # the adoption path — recovery finds the instance by idempotency key
+        {"at": 118.0, "kind": "operator-crash",
+         "barrier": "post-effect-pre-done", "action": "nodeclaim.launch"},
+        wave(1, 120.0, 260.0),
+        {"at": 200.0, "kind": "interrupt", "count": 1, "mode": "graceful"},
+        # killed before the intent is even written: nothing journaled for
+        # that action; everything else pending still recovers
+        {"at": 208.0, "kind": "operator-crash", "barrier": "pre-intent"},
+        wave(2, 210.0, 330.0),
+    ]
     return trace
 
 

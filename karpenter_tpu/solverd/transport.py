@@ -254,7 +254,14 @@ def recv_frame(sock: socket.socket) -> Optional[dict]:
     data = _recv_exact(sock, length)
     if data is None:
         raise TransportError("connection closed mid-frame")
-    return json.loads(data)
+    try:
+        return json.loads(data)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        # a corrupt payload (bit flip, desynced framing after a partial
+        # write) must surface as the same typed, retryable error as a torn
+        # frame — the client closes + re-dials + replays, the daemon drops
+        # the connection; neither ever sees a raw JSONDecodeError
+        raise TransportError(f"malformed frame payload: {e}") from e
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

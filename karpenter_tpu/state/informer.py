@@ -29,6 +29,24 @@ class StateInformer:
             self._apply(event)
         return len(events)
 
+    def bootstrap(self) -> int:
+        """Replay every object already in the store into cluster state.
+
+        The watch subscription carries events from construction onward
+        only — an operator booted onto a POPULATED store (crash restart,
+        adoption of an existing cluster) would otherwise plan against an
+        empty Cluster: the scheduler re-provisions capacity that already
+        exists and consolidation sees nothing to fold. Kind order matters:
+        nodes land before the pods bound to them. Idempotent (cluster
+        updates are upserts), so replaying on a warm informer is harmless;
+        returns the number of objects replayed."""
+        count = 0
+        for kind in WATCHED_KINDS:
+            for obj in self.store.list(kind):
+                self._apply(Event(ADDED, kind, obj))
+                count += 1
+        return count
+
     def _apply(self, event: Event) -> None:
         obj = event.obj
         kind = event.kind

@@ -1,0 +1,369 @@
+"""Write-ahead intent journal (runtime/journal.py): frame format, torn-tail
+and mid-file corruption discipline, in-memory degrade, compaction via
+tmp+os.replace, replay determinism, crash barriers, and the Prometheus
+round-trip for the karpenter_journal_* counters."""
+
+import glob
+import hashlib
+import os
+import struct
+
+import pytest
+
+from karpenter_tpu.metrics.registry import global_registry
+from karpenter_tpu.runtime import journal as journal_mod
+from karpenter_tpu.runtime.journal import (
+    BARRIER_POST_EFFECT,
+    BARRIER_POST_INTENT,
+    BARRIER_PRE_INTENT,
+    JOURNAL_FILE,
+    MAGIC,
+    Journal,
+    OperatorCrash,
+    _encode,
+)
+from karpenter_tpu.utils.clock import FakeClock
+
+from test_metrics_exposition import parse_exposition
+
+
+def journal_at(tmp_path):
+    return Journal(str(tmp_path), clock=FakeClock())
+
+
+class TestFrameFormat:
+    def test_roundtrip_and_pending(self, tmp_path):
+        j = journal_at(tmp_path)
+        s1 = j.intent("nodeclaim.launch", uid="u1", key="k1", nodeclaim="c1")
+        s2 = j.intent("nodeclaim.delete", uid="u2", provider_id="kwok://n2")
+        j.done(s1, provider_id="kwok://n1")
+        j.close()
+        reloaded = journal_at(tmp_path)
+        pending = reloaded.pending()
+        assert [r["seq"] for r in pending] == [s2]
+        assert pending[0]["action"] == "nodeclaim.delete"
+        assert pending[0]["provider_id"] == "kwok://n2"
+        # sequence numbers continue past everything already on disk
+        assert reloaded.intent("pod.bind", uid="u3") == s2 + 1
+
+    def test_frame_layout_is_length_digest_payload(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.intent("nodeclaim.launch", uid="u1")
+        j.close()
+        blob = (tmp_path / JOURNAL_FILE).read_bytes()
+        assert blob.startswith(MAGIC)
+        (length,) = struct.unpack_from(">I", blob, len(MAGIC))
+        digest = blob[len(MAGIC) + 4 : len(MAGIC) + 36]
+        payload = blob[len(MAGIC) + 36 : len(MAGIC) + 36 + length]
+        assert hashlib.sha256(payload).digest() == digest
+        assert len(blob) == len(MAGIC) + 36 + length
+
+    def test_fresh_boot_is_not_recovering(self, tmp_path):
+        j = journal_at(tmp_path)
+        assert not j.recovering()
+        # pending intents written by THIS incarnation don't flip it either
+        j.intent("nodeclaim.launch", uid="u1")
+        assert not j.recovering()
+
+    def test_reboot_with_pending_is_recovering(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.intent("nodeclaim.launch", uid="u1")
+        j.close()
+        reloaded = journal_at(tmp_path)
+        assert reloaded.recovering()
+        reloaded.mark_recovered()
+        assert not reloaded.recovering()
+
+
+class TestCorruption:
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        j = journal_at(tmp_path)
+        s1 = j.intent("nodeclaim.launch", uid="u1")
+        j.intent("nodeclaim.launch", uid="u2")
+        j.done(s1)
+        j.close()
+        path = tmp_path / JOURNAL_FILE
+        good = path.read_bytes()
+        # a crash mid-append: half a frame lands
+        torn = _encode({"type": "intent", "seq": 99, "action": "x"})[: 17]
+        path.write_bytes(good + torn)
+        reloaded = journal_at(tmp_path)
+        assert reloaded.frame()["truncated_frames"] == 1
+        assert [r["uid"] for r in reloaded.pending()] == ["u2"]
+        # the truncation is durable: the file shrank back to the good bytes
+        assert path.read_bytes() == good
+        reloaded.close()
+        again = journal_at(tmp_path)
+        assert again.frame()["truncated_frames"] == 0
+
+    def test_checksum_mismatch_stops_replay_at_last_good_frame(self, tmp_path):
+        frames = [
+            _encode({"type": "intent", "seq": n, "action": "nodeclaim.launch",
+                     "uid": f"u{n}", "key": "", "pass": 1, "ts": 0.0})
+            for n in (1, 2, 3)
+        ]
+        corrupt = bytearray(frames[1])
+        corrupt[40] ^= 0xFF  # flip a payload byte; the sha256 no longer matches
+        path = tmp_path / JOURNAL_FILE
+        path.write_bytes(MAGIC + frames[0] + bytes(corrupt) + frames[2])
+        j = journal_at(tmp_path)
+        # replay stops at the last provably-good frame: u1 survives, u2 is
+        # the corrupt frame, u3 (good bytes AFTER the corruption) must NOT
+        # be trusted — the log is only valid up to the first bad frame
+        assert [r["uid"] for r in j.pending()] == ["u1"]
+        assert j.frame()["truncated_frames"] == 1
+        assert path.read_bytes() == MAGIC + frames[0]
+
+    def test_bad_magic_starts_fresh(self, tmp_path):
+        path = tmp_path / JOURNAL_FILE
+        path.write_bytes(b"not a journal at all")
+        j = journal_at(tmp_path)
+        assert j.pending() == []
+        assert j.frame()["truncated_frames"] == 1
+        j.intent("nodeclaim.launch", uid="u1")
+        j.close()
+        assert [r["uid"] for r in journal_at(tmp_path).pending()] == ["u1"]
+
+    def test_oversized_length_treated_as_corrupt(self, tmp_path):
+        path = tmp_path / JOURNAL_FILE
+        path.write_bytes(MAGIC + struct.pack(">I", 1 << 30) + b"\x00" * 40)
+        j = journal_at(tmp_path)
+        assert j.pending() == []
+        assert j.frame()["truncated_frames"] == 1
+
+
+class TestDegrade:
+    def test_unwritable_dir_degrades_to_memory(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("a file where the journal dir should be")
+        j = Journal(str(blocker / "sub"), clock=FakeClock())
+        assert j.frame()["mode"] == "memory"
+        # the journal still works, it just lost crash durability
+        seq = j.intent("nodeclaim.launch", uid="u1")
+        assert [r["seq"] for r in j.pending()] == [seq]
+        j.done(seq)
+        assert j.pending() == []
+
+    def test_append_failure_mid_flight_degrades(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.intent("nodeclaim.launch", uid="u1")
+        assert j.frame()["mode"] == "file"
+
+        class BrokenFh:
+            def write(self, data):
+                raise OSError("disk full")
+
+            def close(self):
+                pass
+
+        j._fh.close()
+        j._fh = BrokenFh()
+        seq = j.intent("nodeclaim.launch", uid="u2")
+        frame = j.frame()
+        assert frame["mode"] == "memory"
+        assert frame["write_errors"] == 1
+        # the in-memory record is intact even though the disk write failed
+        assert seq in [r["seq"] for r in j.pending()]
+        # further appends don't raise and don't re-count
+        j.done(seq)
+        assert j.frame()["write_errors"] == 1
+
+    def test_memory_journal_without_dir(self):
+        j = Journal("", clock=FakeClock())
+        assert j.frame()["mode"] == "memory"
+        assert j.snapshot()["path"] is None
+        seq = j.intent("pod.bind", uid="u1")
+        j.failed(seq, error="x")
+        assert j.pending() == []
+
+
+class TestCompaction:
+    def test_compact_keeps_only_pending(self, tmp_path):
+        j = journal_at(tmp_path)
+        keep = j.intent("nodeclaim.launch", uid="keep")
+        for i in range(20):
+            j.done(j.intent("nodeclaim.launch", uid=f"drop-{i}"))
+        j.compact()
+        assert j.frame()["compactions"] == 1
+        assert not glob.glob(str(tmp_path / "*.tmp.*"))
+        j.close()
+        reloaded = journal_at(tmp_path)
+        assert [r["seq"] for r in reloaded.pending()] == [keep]
+        assert reloaded.snapshot()["records"] == 1
+        # appends after a compaction land in the rewritten file
+        reloaded.intent("nodeclaim.launch", uid="after")
+        reloaded.close()
+        assert [r["uid"] for r in journal_at(tmp_path).pending()] == ["keep", "after"]
+
+    def test_resolved_threshold_triggers_compaction(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(journal_mod, "COMPACT_THRESHOLD", 4)
+        j = journal_at(tmp_path)
+        for i in range(4):
+            j.done(j.intent("nodeclaim.launch", uid=f"u{i}"))
+        assert j.frame()["compactions"] >= 1
+
+    def test_concurrent_writer_tmp_is_per_writer(self, tmp_path):
+        # two journals over the same dir (a crashed incarnation's handle
+        # still open while the successor compacts): os.replace keeps the
+        # log whole and neither writer's tmp file survives
+        a = journal_at(tmp_path)
+        b = Journal(str(tmp_path), clock=FakeClock())
+        a.intent("nodeclaim.launch", uid="a1")
+        a.compact()
+        b.compact()
+        assert not glob.glob(str(tmp_path / "*.tmp.*"))
+        blob = (tmp_path / JOURNAL_FILE).read_bytes()
+        assert blob.startswith(MAGIC)
+        a.close()
+        b.close()
+        journal_at(tmp_path)  # loads without truncation warnings
+        assert journal_at(tmp_path).frame()["truncated_frames"] == 0
+
+
+class TestReplayDeterminism:
+    def test_same_bytes_same_pending_list(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.intent("nodeclaim.launch", uid="u1", key="k1")
+        s2 = j.intent("disruption.command", candidates=["c1", "c2"])
+        j.intent("pod.bind", uid="u3")
+        j.failed(s2, error="rolled back")
+        j.close()
+        blob = (tmp_path / JOURNAL_FILE).read_bytes()
+        replicas = []
+        for sub in ("a", "b"):
+            d = tmp_path / sub
+            d.mkdir()
+            (d / JOURNAL_FILE).write_bytes(blob)
+            replicas.append(Journal(str(d), clock=FakeClock()).pending())
+        assert replicas[0] == replicas[1]
+        assert [r["action"] for r in replicas[0]] == [
+            "nodeclaim.launch", "pod.bind",
+        ]
+
+
+class TestCrashBarriers:
+    def test_post_intent_crash_is_one_shot_and_durable(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.arm_crash(BARRIER_POST_INTENT)
+        with pytest.raises(OperatorCrash) as exc:
+            j.intent("nodeclaim.launch", uid="u1")
+        assert exc.value.barrier == BARRIER_POST_INTENT
+        # the intent hit the disk BEFORE the crash: a restart replays it
+        j.close()
+        assert [r["uid"] for r in journal_at(tmp_path).pending()] == ["u1"]
+        # one-shot: the next intent sails through
+        j2 = journal_at(tmp_path)
+        j2.intent("nodeclaim.launch", uid="u2")
+
+    def test_pre_intent_crash_leaves_no_record(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.arm_crash(BARRIER_PRE_INTENT)
+        with pytest.raises(OperatorCrash):
+            j.intent("nodeclaim.launch", uid="u1")
+        assert j.pending() == []
+        assert j.frame()["appends"] == 0
+        j.close()
+        assert journal_at(tmp_path).pending() == []
+
+    def test_post_effect_crash_loses_the_done_record(self, tmp_path):
+        j = journal_at(tmp_path)
+        seq = j.intent("nodeclaim.launch", uid="u1", key="k1")
+        j.arm_crash(BARRIER_POST_EFFECT)
+        with pytest.raises(OperatorCrash):
+            j.done(seq, provider_id="kwok://n1")
+        j.close()
+        # the effect happened but its completion never landed: this is
+        # exactly the adoption work-list recovery must resolve by key
+        assert [r["key"] for r in journal_at(tmp_path).pending()] == ["k1"]
+
+    def test_recovery_resolutions_skip_the_barrier(self, tmp_path):
+        j = journal_at(tmp_path)
+        seq = j.intent("nodeclaim.launch", uid="u1")
+        j.arm_crash(BARRIER_POST_EFFECT)
+        j.done(seq, barrier=False, recovered=True)  # must NOT crash
+        assert j.pending() == []
+        # the armed crash is still pending for the next real mutation
+        s2 = j.intent("nodeclaim.launch", uid="u2")
+        with pytest.raises(OperatorCrash):
+            j.done(s2)
+
+    def test_action_filter(self, tmp_path):
+        j = journal_at(tmp_path)
+        j.arm_crash(BARRIER_POST_INTENT, action="nodeclaim.delete")
+        j.intent("nodeclaim.launch", uid="u1")  # different action: no crash
+        with pytest.raises(OperatorCrash) as exc:
+            j.intent("nodeclaim.delete", uid="u2")
+        assert exc.value.action == "nodeclaim.delete"
+
+    def test_failed_never_fires_a_barrier(self, tmp_path):
+        j = journal_at(tmp_path)
+        seq = j.intent("nodeclaim.launch", uid="u1")
+        j.arm_crash(BARRIER_POST_EFFECT)
+        j.failed(seq, error="create raised")  # the effect never happened
+        assert j.pending() == []
+
+    def test_unknown_barrier_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown journal barrier"):
+            journal_at(tmp_path).arm_crash("post-mortem")
+
+    def test_crash_is_not_an_exception(self):
+        # the reconciler harness isolates failures with `except Exception`;
+        # a simulated SIGKILL must never be absorbed by it
+        assert not issubclass(OperatorCrash, Exception)
+        assert issubclass(OperatorCrash, BaseException)
+
+
+class TestMetrics:
+    def test_journal_counters_round_trip_exposition(self, tmp_path):
+        appends = global_registry.get("karpenter_journal_appends_total")
+        truncations = global_registry.get("karpenter_journal_truncations_total")
+        before_intent = appends.value({"type": "intent"})
+        before_done = appends.value({"type": "done"})
+        before_trunc = truncations.value()
+        j = journal_at(tmp_path)
+        s1 = j.intent("nodeclaim.launch", uid="u1")
+        j.intent("nodeclaim.launch", uid="u2")
+        j.done(s1)
+        j.note_replay()
+        j.note_adoption()
+        j.note_orphan()
+        j.note_rollback()
+        j.close()
+        (tmp_path / JOURNAL_FILE).write_bytes(b"garbage")
+        journal_at(tmp_path)  # bad magic => one truncation
+        families = parse_exposition(global_registry.expose())
+        for name in (
+            "karpenter_journal_appends_total",
+            "karpenter_journal_replays_total",
+            "karpenter_journal_adoptions_total",
+            "karpenter_journal_orphans_total",
+            "karpenter_journal_rollbacks_total",
+            "karpenter_journal_truncations_total",
+        ):
+            assert families[name]["type"] == "counter", name
+        samples = families["karpenter_journal_appends_total"]["samples"]
+        assert samples[
+            ("karpenter_journal_appends_total", (("type", "intent"),))
+        ] == before_intent + 2
+        assert samples[
+            ("karpenter_journal_appends_total", (("type", "done"),))
+        ] == before_done + 1
+        assert families["karpenter_journal_truncations_total"]["samples"][
+            ("karpenter_journal_truncations_total", ())
+        ] == before_trunc + 1
+
+
+class TestSnapshot:
+    def test_snapshot_shape(self, tmp_path):
+        clock = FakeClock()
+        j = Journal(str(tmp_path), clock=clock)
+        j.set_pass(7)
+        j.intent("nodeclaim.launch", uid="u1", key="k1", nodeclaim="c1")
+        snap = j.snapshot()
+        assert snap["path"] == os.path.join(str(tmp_path), JOURNAL_FILE)
+        assert snap["depth"] == 1
+        [pending] = snap["pending"]
+        assert pending == {
+            "seq": 1, "action": "nodeclaim.launch", "uid": "u1",
+            "key": "k1", "pass": 7, "ts": round(clock.now(), 6),
+        }

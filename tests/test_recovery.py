@@ -1,0 +1,366 @@
+"""Crash recovery (operator/operator.py:recover): a killed operator's
+successor replays the write-ahead journal against observed cluster/cloud
+state — adopting acknowledged launches by idempotency key, relaunching
+unacknowledged ones under the same key, reaping orphans through an
+expedited GC sweep, and rolling back in-flight disruption — with zero
+double-launched instances. Plus the informer bootstrap a cold restart
+depends on, kwok's key-idempotent create, the ack-then-raise retry
+regression, /healthz degradation during recovery, and small-trace crash
+determinism."""
+
+import copy
+
+import pytest
+
+from karpenter_tpu.apis.nodeclaim import (
+    CONDITION_DISRUPTION_REASON,
+    CONDITION_LAUNCHED,
+    NodeClaim,
+)
+from karpenter_tpu.apis.core import ObjectMeta
+from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+from karpenter_tpu.operator.leaderelection import LEASE_DURATION
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.runtime.journal import (
+    IDEMPOTENCY_ANNOTATION,
+    Journal,
+    OperatorCrash,
+)
+from karpenter_tpu.runtime.store import Store
+from karpenter_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import StateInformer
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import node_claim_pair, nodepool, unschedulable_pod
+
+
+def make_operator(tmp_path, store=None, provider=None, clock=None):
+    clock = clock or FakeClock()
+    store = store or Store(clock=clock)
+    provider = provider or KwokCloudProvider(store, clock)
+    op = Operator(
+        store, provider, clock=clock,
+        options=Options(journal_dir=str(tmp_path)),
+    )
+    return clock, store, provider, op
+
+
+def settle(clock, op, passes=12, step=2.0):
+    for _ in range(passes):
+        clock.step(step)
+        op.run_once()
+
+
+def run_until_crash(clock, op, passes=20, step=2.0):
+    """Step passes until the armed barrier kills the operator; returns the
+    crash (the sim harness does the same dance in sim/harness.py)."""
+    for _ in range(passes):
+        clock.step(step)
+        try:
+            op.run_once()
+        except OperatorCrash as crash:
+            return crash
+    raise AssertionError("armed crash never fired")
+
+
+def restart(tmp_path, clock, store, provider, old_op):
+    """Cold restart onto the same store/journal: the successor waits out
+    the dead incumbent's lease, then recovers on its first leader pass."""
+    old_op.journal.close()
+    new_op = Operator(
+        store, provider, clock=clock,
+        options=Options(journal_dir=str(tmp_path)),
+    )
+    stats = {}
+    new_op.on_recover = stats.update
+    clock.step(LEASE_DURATION + 1.0)
+    return new_op, stats
+
+
+class TestCrashRestart:
+    def test_acknowledged_create_adopted_by_key(self, tmp_path):
+        """post-effect-pre-done: the cloud acked the launch but the done
+        record died with the operator — the successor finds the instance
+        by idempotency key and adopts it instead of launching again."""
+        clock, store, provider, op = make_operator(tmp_path)
+        store.create(nodepool("workers"))
+        for _ in range(2):
+            store.create(unschedulable_pod(requests={"cpu": "1"}))
+        op.journal.arm_crash("post-effect-pre-done", action="nodeclaim.launch")
+        crash = run_until_crash(clock, op)
+        assert crash.barrier == "post-effect-pre-done"
+        assert len(provider.list()) == 1  # the effect landed
+        assert op.journal.depth() == 1  # ...but its completion did not
+        op2, stats = restart(tmp_path, clock, store, provider, op)
+        settle(clock, op2)
+        assert stats["adoptions"] == 1
+        assert stats["replayed"] == 1
+        assert provider.double_launches() == 0
+        assert op2.journal.depth() == 0
+        claims = store.list("NodeClaim")
+        assert claims and all(
+            c.condition_is_true(CONDITION_LAUNCHED) for c in claims
+        )
+        # the run converges: every pod bound, every claim backed
+        assert all(p.spec.node_name for p in store.list("Pod"))
+
+    def test_unacknowledged_intent_relaunches_same_key(self, tmp_path):
+        """post-intent-pre-effect: the intent is durable, the create never
+        reached the cloud — recovery closes it as failed and the lifecycle
+        relaunches under the SAME key, so the ledger shows one launch."""
+        clock, store, provider, op = make_operator(tmp_path)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        op.journal.arm_crash("post-intent-pre-effect", action="nodeclaim.launch")
+        run_until_crash(clock, op)
+        assert provider.list() == []  # no effect before the intent's crash
+        [pending] = op.journal.pending()
+        key = pending["key"]
+        op2, stats = restart(tmp_path, clock, store, provider, op)
+        settle(clock, op2)
+        assert stats["replayed"] == 1
+        assert stats["adoptions"] == 0
+        [claim] = [
+            c for c in store.list("NodeClaim")
+            if c.metadata.annotations.get(IDEMPOTENCY_ANNOTATION) == key
+        ]
+        assert claim.condition_is_true(CONDITION_LAUNCHED)
+        assert provider.double_launches() == 0
+        assert provider._key_launches[key] == 1
+
+    def test_orphaned_instance_marked_and_reaped(self, tmp_path):
+        """Acknowledged instance, no surviving claim: recovery marks the
+        orphan and expedites GC, which reaps it on the first post-recovery
+        pass instead of after the 2-minute sweep period."""
+        clock, store, provider, op = make_operator(tmp_path)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        op.journal.arm_crash("post-effect-pre-done", action="nodeclaim.launch")
+        run_until_crash(clock, op)
+        [instance] = provider.list()
+        # the claim vanishes between the crash and the restart (etcd loss,
+        # operator of another cell cleaned it up, ...)
+        for claim in store.list("NodeClaim"):
+            claim.metadata.finalizers = []
+            store.delete(claim)
+        op2, stats = restart(tmp_path, clock, store, provider, op)
+        clock.step(2.0)
+        op2.run_once()  # recover marks the orphan; the expedited GC reaps it
+        assert stats["orphans"] == 1
+        assert instance.status.provider_id not in {
+            c.status.provider_id for c in provider.list()
+        }
+        assert op2.journal.depth() == 0
+        # ...and the stranded pod is eventually re-provisioned fresh
+        settle(clock, op2)
+        assert all(p.spec.node_name for p in store.list("Pod"))
+        assert provider.double_launches() == 0
+
+    def test_disruption_command_rolled_back(self, tmp_path):
+        """An in-flight disruption command dies with the operator: recovery
+        untaints the candidates and clears their disruption condition, so
+        budget headroom the command consumed is never leaked."""
+        clock = FakeClock()
+        store = Store(clock=clock)
+        node, claim = node_claim_pair("n1")
+        claim.set_condition(
+            CONDITION_DISRUPTION_REASON, "True", reason="Underutilized"
+        )
+        node.spec.taints = list(node.spec.taints) + [DISRUPTED_NO_SCHEDULE_TAINT]
+        store.create(node)
+        store.create(claim)
+        journal = Journal(str(tmp_path), clock=clock)
+        journal.intent(
+            "disruption.command",
+            candidates=[claim.metadata.name],
+            provider_ids=[claim.status.provider_id],
+            reason="underutilized",
+        )
+        journal.close()
+        clock2, _, provider, op = make_operator(tmp_path, store=store, clock=clock)
+        stats = {}
+        op.on_recover = stats.update
+        op.informer.bootstrap()
+        op.recover()
+        assert stats["rolled_back"] == 1
+        restored = store.get("NodeClaim", claim.metadata.name)
+        assert restored.get_condition(CONDITION_DISRUPTION_REASON) is None
+        untainted = store.get("Node", node.metadata.name)
+        assert not any(
+            t.match(DISRUPTED_NO_SCHEDULE_TAINT) for t in untainted.spec.taints
+        )
+        assert op.journal.depth() == 0
+
+    def test_healthz_degraded_until_recovery_runs(self, tmp_path):
+        journal = Journal(str(tmp_path), clock=FakeClock())
+        journal.intent("nodeclaim.launch", uid="ghost", key="launch/ghost")
+        journal.close()
+        clock, store, provider, op = make_operator(tmp_path)
+        assert op.journal.recovering()
+        snap = op.health_snapshot()
+        assert snap["status"] == "degraded"
+        assert "journal recovery in progress" in snap["degraded_reasons"]
+        clock.step(2.0)
+        op.run_once()  # first leader pass runs recover()
+        assert not op.journal.recovering()
+        assert "journal recovery in progress" not in op.health_snapshot()[
+            "degraded_reasons"
+        ]
+
+
+class TestIdempotentLaunch:
+    def test_kwok_create_is_key_idempotent(self, tmp_path):
+        clock, store, provider, op = make_operator(tmp_path)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        [claim] = store.list("NodeClaim")
+        key = claim.metadata.annotations[IDEMPOTENCY_ANNOTATION]
+        assert key
+        # a replayed create with the same key returns the SAME instance —
+        # kwok never even parses the retried claim's requirements
+        retry = NodeClaim(
+            metadata=ObjectMeta(
+                name="retry", annotations={IDEMPOTENCY_ANNOTATION: key}
+            )
+        )
+        echoed = provider.create(retry)
+        assert echoed.status.provider_id == claim.status.provider_id
+        assert provider.idempotent_hits == 1
+        assert provider.double_launches() == 0
+        assert len(provider.list()) == 1
+
+    def test_double_launch_ledger_spans_deletes(self, tmp_path):
+        """The ledger counts materializations per key ACROSS deletes: a key
+        that launches, terminates, and launches again really did double-
+        launch (claims never reuse keys — each claim derives its own)."""
+        clock, store, provider, op = make_operator(tmp_path)
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        settle(clock, op)
+        [claim] = store.list("NodeClaim")
+        provider.delete(claim)
+        relaunch = copy.deepcopy(claim)
+        relaunch.status.provider_id = ""
+        provider.create(relaunch)
+        assert provider.double_launches() == 1
+
+    def test_ack_then_raise_retry_converges_on_one_instance(self, tmp_path):
+        """The ambiguous failure the key exists for: create() lands but the
+        response is lost. The journaled retry next pass must adopt the
+        acknowledged instance, never materialize a second one."""
+        from random import Random
+
+        from karpenter_tpu.sim.faults import FaultyCloudProvider
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        kwok = KwokCloudProvider(store, clock)
+        faulty = FaultyCloudProvider(
+            kwok, Random(0), clock, ack_then_raise_rate=1.0
+        )
+        op = Operator(
+            store, faulty, clock=clock,
+            options=Options(journal_dir=str(tmp_path)),
+        )
+        store.create(nodepool("workers"))
+        store.create(unschedulable_pod(requests={"cpu": "1"}))
+        while faulty.ack_then_raise_failures == 0:
+            clock.step(2.0)
+            op.run_once()
+        assert len(kwok.list()) == 1  # the create LANDED
+        [claim] = store.list("NodeClaim")
+        assert not claim.condition_is_true(CONDITION_LAUNCHED)
+        faulty.ack_then_raise_rate = 0.0
+        settle(clock, op)
+        assert claim.condition_is_true(CONDITION_LAUNCHED)
+        assert kwok.idempotent_hits >= 1
+        assert kwok.double_launches() == 0
+        assert len(kwok.list()) == 1
+
+
+class TestInformerBootstrap:
+    def test_bootstrap_replays_populated_store(self):
+        """The watch subscription only carries events from construction
+        onward: an operator booted onto a populated store must bootstrap or
+        its scheduler plans against an empty world (the crash-restart bug
+        the sim caught: stranded pods, phantom re-provisioning)."""
+        clock = FakeClock()
+        store = Store(clock=clock)
+        node, claim = node_claim_pair("warm-1")
+        store.create(node)
+        store.create(claim)
+        pod = unschedulable_pod(requests={"cpu": "1"})
+        pod.spec.node_name = node.metadata.name
+        store.create(pod)
+        cluster = Cluster(clock, store, cloud_provider=None)
+        informer = StateInformer(store, cluster)
+        assert cluster.nodes == {}  # the gap: watch saw nothing
+        count = informer.bootstrap()
+        assert count == 3
+        [sn] = [
+            sn for sn in cluster.nodes.values()
+            if sn.node is not None and sn.node.metadata.name == node.metadata.name
+        ]
+        assert sn.node_claim is not None
+        # idempotent: a second replay (warm informer) changes nothing
+        informer.bootstrap()
+        assert len([
+            sn for sn in cluster.nodes.values()
+            if sn.node is not None and sn.node.metadata.name == node.metadata.name
+        ]) == 1
+
+
+class TestCrashSimDeterminism:
+    def _tiny_crash_trace(self):
+        from karpenter_tpu.sim import trace as tracemod
+
+        return tracemod.validate({
+            "version": tracemod.TRACE_VERSION,
+            "name": "tiny-crash",
+            "duration": 150.0,
+            "tick": 1.0,
+            "nodepools": [{"name": "workers", "consolidate_after": 15.0}],
+            "faults": {"ack_then_raise_rate": 0.3},
+            "events": [
+                {"at": 4.0, "kind": "submit", "group": "svc", "count": 3,
+                 "pod": {"cpu": "2", "memory": "2Gi"}, "replace": True},
+                {"at": 10.0, "kind": "operator-crash",
+                 "barrier": "post-effect-pre-done",
+                 "action": "nodeclaim.launch"},
+                {"at": 12.0, "kind": "submit", "group": "wave", "count": 3,
+                 "pod": {"cpu": "3", "memory": "4Gi"}, "replace": True},
+            ],
+        })
+
+    def test_same_seed_crash_runs_are_byte_identical(self):
+        from karpenter_tpu.sim.harness import run_scenario
+
+        a = run_scenario(copy.deepcopy(self._tiny_crash_trace()), 3)
+        b = run_scenario(copy.deepcopy(self._tiny_crash_trace()), 3)
+        assert a.digest == b.digest
+        assert a.log.to_jsonl() == b.log.to_jsonl()
+        assert a.report == b.report
+        recovery = a.report["recovery"]
+        assert recovery["crashes"] >= 1
+        assert recovery["double_launches"] == 0
+        assert recovery["orphans_leaked"] == 0
+        import json
+
+        events = [json.loads(line) for line in a.log.to_jsonl().splitlines()]
+        crashes = [e for e in events if e["ev"] == "operator-crash"]
+        assert crashes and all(e["barrier"] for e in crashes)
+        assert any(e["ev"] == "operator-recovered" for e in events)
+
+    def test_crash_free_run_reports_zero_recovery(self):
+        from karpenter_tpu.sim import scenarios
+        from karpenter_tpu.sim.harness import run_scenario
+
+        result = run_scenario(scenarios.resolve("steady-state", 7), 7)
+        assert result.report["recovery"] == {
+            "crashes": 0, "replayed_intents": 0, "adoptions": 0,
+            "orphans_marked": 0, "rolled_back": 0, "double_launches": 0,
+            "orphans_leaked": 0,
+        }

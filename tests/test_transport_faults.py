@@ -1,0 +1,181 @@
+"""solverd wire-framing faults (solverd/transport.py): corrupt, torn, and
+oversized frames surface as typed retryable TransportError — never a raw
+JSONDecodeError — the daemon survives a poisoned connection, and the
+client's reconnect-with-backoff replays through a corrupt reply."""
+
+import json
+import socket
+import struct
+import threading
+
+import pytest
+
+from karpenter_tpu.solverd import SocketClient, SolverDaemon, SolverService, TransportError
+from karpenter_tpu.solverd.transport import recv_frame, send_frame
+from karpenter_tpu.utils.clock import Clock
+
+
+class ScriptedSocket:
+    """A byte-level fault-injection 'socket': recv() drains a scripted
+    buffer, then reports EOF — exactly what a peer that wrote those bytes
+    and closed looks like."""
+
+    def __init__(self, data: bytes, chunk: int = 0):
+        self._buf = data
+        self._chunk = chunk  # 0 = serve whatever was asked
+
+    def recv(self, n: int) -> bytes:
+        if self._chunk:
+            n = min(n, self._chunk)
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+
+def frame(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+class TestRecvFrame:
+    def test_valid_frame_roundtrip(self):
+        msg = {"op": "stats", "v": 1}
+        sock = ScriptedSocket(frame(json.dumps(msg).encode()))
+        assert recv_frame(sock) == msg
+
+    def test_dribbling_peer_reassembled(self):
+        msg = {"op": "solve", "payload": "x" * 300}
+        sock = ScriptedSocket(frame(json.dumps(msg).encode()), chunk=7)
+        assert recv_frame(sock) == msg
+
+    def test_corrupt_payload_is_typed_not_jsondecodeerror(self):
+        # a bit-flipped frame: valid length prefix, garbage payload — the
+        # caller's retry loops catch (OSError, TransportError), so a raw
+        # JSONDecodeError would escape them and kill the operator pass
+        sock = ScriptedSocket(frame(b"\xff\xfe{not json at all"))
+        with pytest.raises(TransportError, match="malformed frame payload"):
+            recv_frame(sock)
+        try:
+            recv_frame(ScriptedSocket(frame(b"{truncated")))
+        except TransportError as e:
+            assert not isinstance(e, json.JSONDecodeError)
+
+    def test_clean_eof_between_frames_is_none(self):
+        assert recv_frame(ScriptedSocket(b"")) is None
+
+    def test_torn_header_mid_frame(self):
+        with pytest.raises(TransportError, match="closed mid-frame"):
+            recv_frame(ScriptedSocket(b"\x00\x00"))
+
+    def test_torn_payload_mid_frame(self):
+        blob = frame(b'{"op": "stats"}')[:-5]
+        with pytest.raises(TransportError, match="closed mid-frame"):
+            recv_frame(ScriptedSocket(blob))
+
+    def test_oversized_length_capped(self):
+        # desynced framing often reads garbage as a huge length; the cap
+        # turns that into an immediate typed error instead of an OOM recv
+        sock = ScriptedSocket(struct.pack(">I", (1 << 31) - 1) + b"x" * 64)
+        with pytest.raises(TransportError, match="exceeds cap"):
+            recv_frame(sock)
+
+
+class TestDaemonSurvivesCorruptFrames:
+    def _connect(self, daemon):
+        host, _, port = daemon.address.rpartition(":")
+        return socket.create_connection((host, int(port)), timeout=5.0)
+
+    def test_poisoned_connection_dropped_daemon_lives(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        try:
+            poison = self._connect(daemon)
+            poison.sendall(frame(b"\x00garbage that is not json"))
+            # the daemon drops the poisoned connection (EOF to us)...
+            assert poison.recv(4096) == b""
+            poison.close()
+            # ...and keeps serving fresh connections
+            client = SocketClient(daemon.address)
+            try:
+                assert client.stats()["transport"] == "socket"
+            finally:
+                client.close()
+        finally:
+            daemon.stop()
+            svc.close()
+
+    def test_torn_frame_then_disconnect_daemon_lives(self):
+        svc = SolverService(clock=Clock())
+        daemon = SolverDaemon(svc, address="127.0.0.1:0").start()
+        try:
+            torn = self._connect(daemon)
+            torn.sendall(struct.pack(">I", 4096) + b"only-part-of-it")
+            torn.close()  # mid-frame hangup
+            client = SocketClient(daemon.address)
+            try:
+                stats = client.stats()
+                assert stats.get("requests", 0) >= 0
+            finally:
+                client.close()
+        finally:
+            daemon.stop()
+            svc.close()
+
+
+class TestClientReplayThroughCorruptReply:
+    def _evil_then_honest_server(self, replies_ok: dict):
+        """One listener, two scripted connections: the first answers with a
+        corrupt frame and hangs up; the second answers honestly."""
+        srv = socket.create_server(("127.0.0.1", 0))
+        address = f"127.0.0.1:{srv.getsockname()[1]}"
+
+        def run():
+            conn, _ = srv.accept()
+            with conn:
+                recv_frame(conn)
+                conn.sendall(frame(b"\xde\xad corrupt reply"))
+            conn2, _ = srv.accept()
+            with conn2:
+                recv_frame(conn2)
+                send_frame(conn2, replies_ok)
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        return srv, address, thread
+
+    def test_rpc_redials_and_replays(self):
+        reply = {"ok": True, "stats": {"requests": 7}}
+        srv, address, thread = self._evil_then_honest_server(reply)
+        client = SocketClient(address, sleep=lambda s: None)
+        try:
+            got = client._rpc({"v": 1, "op": "stats"})
+            assert got == reply
+            assert client.reconnects == 1
+        finally:
+            client.close()
+            srv.close()
+            thread.join(timeout=5.0)
+
+    def test_exhausted_attempts_raise_typed_error(self):
+        srv = socket.create_server(("127.0.0.1", 0))
+        address = f"127.0.0.1:{srv.getsockname()[1]}"
+
+        def run():
+            for _ in range(3):
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                with conn:
+                    recv_frame(conn)
+                    conn.sendall(frame(b"\xff never json"))
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        client = SocketClient(address, reconnect_attempts=3, sleep=lambda s: None)
+        try:
+            with pytest.raises(TransportError, match="malformed frame payload"):
+                client._rpc({"v": 1, "op": "stats"})
+            assert client.reconnects == 2
+        finally:
+            client.close()
+            srv.close()
+            thread.join(timeout=5.0)
