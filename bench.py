@@ -1343,6 +1343,19 @@ def main() -> None:
     errors = len(results.pod_errors)
     assert claims > 0 and errors == 0, (claims, errors)
 
+    # Explain-off contract at bench scale: the provenance ledger defaults
+    # off, and every capture hook on the hot solve path must stay a cheap
+    # early-return — the p50 budgets below are measured with the ledger
+    # cold, and a ledger that warmed itself up would invalidate them
+    from karpenter_tpu.observability import explain as explmod
+
+    explain_rec = explmod.recorder()
+    assert not explain_rec.enabled, (
+        f"bench expects the explain ledger off (mode "
+        f"{explain_rec.mode or 'off'!r}); budgets are explain-off numbers"
+    )
+    explain_counters0 = explain_rec.counters()
+
     # Kernel observatory contract at bench scale: prewarm + the first batch
     # paid every compile this leg needs; the steady timing loop below must
     # dispatch ONLY warm executables — seal and let any compile trip the
@@ -1379,6 +1392,11 @@ def main() -> None:
     kernel_registry.unseal()
 
     p50 = float(np.percentile(times, 50))
+    assert explain_rec.counters() == explain_counters0, (
+        "explain ledger mutated during the explain-off p50 loop",
+        explain_counters0,
+        explain_rec.counters(),
+    )
 
     def leg(name, fn):
         before = _device_dispatches()
@@ -1659,6 +1677,13 @@ def main() -> None:
                     for row in kernel_registry.debug_snapshot()["kernels"]
                 },
                 "steady_recompiles": 0,  # asserted above
+                # provenance ledger state during the run (asserted off +
+                # untouched across the p50 loop: the budgets above are
+                # explain-off numbers)
+                "explain": {
+                    "mode": explain_rec.mode or "off",
+                    "committed": explain_rec.counters()["explain_committed"],
+                },
             }
         )
     )

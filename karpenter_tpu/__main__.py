@@ -49,6 +49,7 @@ def main(argv=None, max_passes: int | None = None, pass_interval: float = 1.0) -
             flight_snapshot=operator.flight_snapshot,
             device_profile=operator.device_profile_snapshot,
             journal_snapshot=operator.journal_snapshot,
+            explain_snapshot=operator.explain_snapshot,
         )
         if options.metrics_port > 0:
             servers.append(Server(options.metrics_port, serving).start())

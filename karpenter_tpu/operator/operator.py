@@ -139,6 +139,18 @@ class Operator:
         self.profiler = effmod.configure_profiler(
             clock=self.clock, profile_dir=self.options.profile_dir
         )
+        # decision provenance ledger (observability/explain.py): follows
+        # this operator's clock and ring capacity; the capture mode is
+        # process-global, so only an EXPLICIT --explain setting mutates it
+        # (a default-constructed Operator must not disable a sim-enabled
+        # ledger — the fused_solve discipline).
+        from karpenter_tpu.observability import explain as explainmod
+
+        self.explain = explainmod.configure(
+            clock=self.clock,
+            mode=self.options.explain or None,
+            capacity=self.options.explain_capacity,
+        )
         # reference: --memory-limit feeds GOMEMLIMIT (operator.go:115-118);
         # here it bounds the solver's interning/memo caches. The caps are
         # process-global, so only an EXPLICIT setting mutates them: -1 (the
@@ -820,6 +832,79 @@ class Operator:
         but not closed, i.e. what recovery would replay if the operator
         died right now."""
         return self.journal.snapshot()
+
+    def explain_snapshot(
+        self, pod: Optional[str] = None, what_if: Optional[str] = None
+    ) -> Optional[dict]:
+        """/debug/explain (operator/serving.py): the unschedulable-pod
+        triage table, a ``?pod=`` stage-by-stage drill-down, or a
+        ``?what_if=drop:<key>`` counterfactual probe — a single-pod
+        simulate-kind re-solve through the solverd coalescer against the
+        relaxed constraints, deadline-bounded and never on the serving hot
+        path. None => ledger disabled or unknown pod (404); the serving
+        layer validates the what_if syntax (400 on garbage)."""
+        if not self.explain.enabled:
+            return None
+        snap = self.explain.snapshot(pod=pod)
+        if snap is None or what_if is None:
+            return snap
+        snap["what_if"] = self._explain_probe(snap, what_if)
+        return snap
+
+    def _explain_probe(self, entry: dict, what_if: str) -> dict:
+        """Run one counterfactual: deep-copy the pod, drop the named
+        requirement, re-solve it alone (KIND_SIMULATE — the probe never
+        commits ledger entries or scheduling decisions)."""
+        import copy as _copy
+
+        from karpenter_tpu.observability import explain as explainmod
+        from karpenter_tpu.solverd import KIND_SIMULATE
+        from karpenter_tpu.state.statenode import active
+
+        key = what_if.split(":", 1)[1]
+        target = next(
+            (
+                p
+                for p in self.store.list("Pod")
+                if p.metadata.uid == entry["uid"]
+                or p.metadata.name == entry["pod"]
+            ),
+            None,
+        )
+        if target is None:
+            self.explain.note_probe("pod-gone")
+            return {"drop": key, "error": "pod no longer present in the store"}
+        probe = _copy.deepcopy(target)
+        if not explainmod.drop_requirement(probe, key):
+            self.explain.note_probe("no-op")
+            return {
+                "drop": key,
+                "error": f"pod carries no requirement on {key!r}",
+            }
+        try:
+            scheduler = self.provisioner.new_scheduler(
+                [probe], active(self.cluster.state_nodes())
+            )
+            results = self.provisioner.solver.solve(
+                KIND_SIMULATE, scheduler, [probe], timeout=2.0
+            )
+        except Exception as e:  # noqa: BLE001 — a probe failure is an answer
+            self.explain.note_probe("error")
+            return {"drop": key, "error": f"{type(e).__name__}: {e}"}
+        err = next(iter(results.pod_errors.values()), None)
+        if err is None:
+            placed = [nc.nodepool_name for nc in results.new_node_claims] + [
+                en.name() for en in results.existing_nodes if en.pods
+            ]
+            self.explain.note_probe("schedulable")
+            return {"drop": key, "schedulable": True, "placement": placed}
+        self.explain.note_probe("unschedulable")
+        return {
+            "drop": key,
+            "schedulable": False,
+            "error": str(err),
+            "stages": list(explainmod.classify(err)),
+        }
 
     def device_profile_snapshot(self, seconds: float) -> Optional[dict]:
         """/debug/profile/device (operator/serving.py): a synchronous

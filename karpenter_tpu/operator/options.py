@@ -96,6 +96,13 @@ class Options:
     # fuses every eligible batch, "auto" (default) fuses only on non-CPU
     # backends where dispatch round-trips dominate. env: KARPENTER_TPU_FUSED
     fused_solve: str = ""
+    # decision provenance ledger (observability/explain.py): "off"/"" no
+    # capture (default — nothing on the solve path changes), "on" every
+    # unschedulable pod commits an elimination ledger entry, "sampled" a
+    # deterministic ~25% (hash of the seeded pod uid). explain_capacity
+    # bounds the ledger ring. env: KARPENTER_TPU_EXPLAIN
+    explain: str = ""
+    explain_capacity: int = 256
     # consolidation frontier search (controllers/disruption + ops/frontier):
     # how many levels of the binary-search decision tree one coalesced
     # simulate batch evaluates speculatively. 1 = the sequential probe
@@ -204,6 +211,13 @@ class Options:
             help="one-dispatch fused FFD scan (default auto: fuse on "
             "non-CPU backends; env KARPENTER_TPU_FUSED)",
         )
+        parser.add_argument(
+            "--explain", choices=["off", "sampled", "on"],
+            help="decision provenance ledger (observability/explain.py): "
+            "per-pod elimination funnels served at /debug/explain "
+            "(default off; env KARPENTER_TPU_EXPLAIN)",
+        )
+        parser.add_argument("--explain-capacity", type=int)
         parser.add_argument("--compile-cache-dir")
         parser.add_argument("--aot-ladder")
         parser.add_argument("--slo-specs")
@@ -236,6 +250,7 @@ class Options:
             "solver_daemon_address": "SOLVER_DAEMON_ADDRESS",
             "solverd_tenant_quota": "SOLVERD_TENANT_QUOTA",
             "solverd_tenant_weights": "SOLVERD_TENANT_WEIGHTS",
+            "explain": "KARPENTER_TPU_EXPLAIN",
             "compile_cache_dir": "COMPILE_CACHE_DIR",
             "aot_ladder": "AOT_LADDER",
             "slo_specs": "SLO_SPECS",

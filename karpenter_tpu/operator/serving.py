@@ -35,6 +35,7 @@ class ServingConfig:
         flight_snapshot: Optional[Callable[..., Optional[dict]]] = None,
         device_profile: Optional[Callable[[float], Optional[dict]]] = None,
         journal_snapshot: Optional[Callable[[], Optional[dict]]] = None,
+        explain_snapshot: Optional[Callable[..., Optional[dict]]] = None,
     ):
         self.metrics_text = metrics_text
         self.healthy = healthy
@@ -72,6 +73,12 @@ class ServingConfig:
         # pending intent — what recovery would replay on a crash right now;
         # unwired => 404
         self.journal_snapshot = journal_snapshot
+        # decision provenance (operator.explain_snapshot): /debug/explain
+        # serves the unschedulable-pod triage table, ?pod= drill-down into
+        # one pod's stage-by-stage elimination funnel (404 when unknown),
+        # and ?pod=X&what_if=drop:<key> counterfactual probes (400 on
+        # malformed what_if); ledger disabled or unwired => 404
+        self.explain_snapshot = explain_snapshot
         # triggered device profiling (operator.device_profile_snapshot):
         # /debug/profile/device?seconds=N runs a synchronous jax.profiler
         # capture into --profile-dir. Returns None when profiling is off
@@ -291,6 +298,39 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 else:
                     self._respond(200, json.dumps(snap), "application/json")
+            elif url.path == "/debug/explain" and cfg.explain_snapshot is not None:
+                import json
+
+                q = parse_qs(url.query)
+                pod = q.get("pod", [None])[0]
+                what_if = q.get("what_if", [None])[0]
+                if what_if is not None and (
+                    pod is None
+                    or not what_if.startswith("drop:")
+                    or not what_if.split(":", 1)[1]
+                ):
+                    self._respond(
+                        400,
+                        json.dumps(
+                            {
+                                "error": "what_if requires ?pod= and the "
+                                "form drop:<requirement-key>"
+                            }
+                        ),
+                        "application/json",
+                    )
+                else:
+                    snap = cfg.explain_snapshot(pod=pod, what_if=what_if)
+                    if snap is None:
+                        self._respond(
+                            404,
+                            json.dumps(
+                                {"error": "explain ledger disabled or unknown pod"}
+                            ),
+                            "application/json",
+                        )
+                    else:
+                        self._respond(200, json.dumps(snap), "application/json")
             elif url.path == "/debug/journal" and cfg.journal_snapshot is not None:
                 import json
 

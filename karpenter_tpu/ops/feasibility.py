@@ -359,6 +359,74 @@ def uid_onehot_matrix(uid_of_type: np.ndarray, num_uniq: int) -> np.ndarray:
     return out
 
 
+# -- decision provenance (observability/explain.py) --------------------------
+#
+# The cube computes compat/fits/has_offering [P, I] before AND-ing them
+# into `feasible`; the stage plane keeps the provenance: one uint8 code per
+# (pod, instance-type) naming the FIRST stage that eliminated the pair, in
+# funnel order (requirements -> resources -> offerings; 0 = survived). The
+# math is elementwise over planes the sweep already materialized — no new
+# laddered kernel shapes, so capture cannot perturb the zero-recompile
+# seal. The serving path decodes host-side (`stage_plane_np` over the
+# fetched bool planes); the jit twin exists for device-resident pipelines.
+
+STAGE_OK = 0
+STAGE_REQUIREMENTS = 1
+STAGE_RESOURCES = 2
+STAGE_OFFERINGS = 3
+STAGE_NAMES = {
+    STAGE_REQUIREMENTS: "requirements",
+    STAGE_RESOURCES: "resources",
+    STAGE_OFFERINGS: "offerings",
+}
+
+
+@jax.jit
+def stage_plane(
+    compat: jnp.ndarray, fits: jnp.ndarray, has_offering: jnp.ndarray
+) -> jnp.ndarray:
+    """[..., I] uint8 first-failing-stage codes from the cube's planes."""
+    return jnp.where(
+        ~compat,
+        jnp.uint8(STAGE_REQUIREMENTS),
+        jnp.where(
+            ~fits,
+            jnp.uint8(STAGE_RESOURCES),
+            jnp.where(
+                ~has_offering, jnp.uint8(STAGE_OFFERINGS), jnp.uint8(STAGE_OK)
+            ),
+        ),
+    )
+
+
+def stage_plane_np(
+    compat: np.ndarray, fits: np.ndarray, has_offering: np.ndarray
+) -> np.ndarray:
+    """Host twin of stage_plane (identical codes, numpy)."""
+    return np.where(
+        ~compat,
+        np.uint8(STAGE_REQUIREMENTS),
+        np.where(
+            ~fits,
+            np.uint8(STAGE_RESOURCES),
+            np.where(
+                ~has_offering, np.uint8(STAGE_OFFERINGS), np.uint8(STAGE_OK)
+            ),
+        ),
+    ).astype(np.uint8)
+
+
+def stage_counts(plane: np.ndarray) -> dict[str, int]:
+    """Decode a stage plane into per-stage elimination counts (survivors
+    excluded) — the interned-vocabulary form the explain ledger records."""
+    counts = np.bincount(np.asarray(plane, dtype=np.uint8).ravel(), minlength=4)
+    return {
+        name: int(counts[code])
+        for code, name in STAGE_NAMES.items()
+        if counts[code]
+    }
+
+
 @jax.jit
 def offering_reduce(
     membership: jnp.ndarray,  # [P, R] bool

@@ -53,6 +53,7 @@ import numpy as np
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.core import Pod
 from karpenter_tpu.metrics import global_registry
+from karpenter_tpu.observability import explain as explmod
 from karpenter_tpu.scheduler.nodeclaim import InstanceTypeFilterError
 from karpenter_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
@@ -900,7 +901,8 @@ class _DeviceSolve:
         self.gheaps: list[list] = []
         self.gsynced: list[int] = []
         self.nptr: list[int] = []
-        self.gnewclaim_err: dict[int, tuple[int, Exception]] = {}
+        # gi -> (limits_version, error, staged explanation funnel or None)
+        self.gnewclaim_err: dict[int, tuple[int, Exception, Optional[list]]] = {}
         # (ti, gi) -> memoized LIMITLESS claim-opening data
         # (fam, candidate0, u_ids0, rem0_fit0, min_specs, min_relaxed) or
         # (-1,...) = permanent error; active nodepool limits are applied per
@@ -1647,9 +1649,15 @@ class _DeviceSolve:
     def _new_claim(self, pod: Pod, g: _Group, gi: int) -> Optional[Exception]:
         cached = self.gnewclaim_err.get(gi)
         if cached is not None and cached[0] == self.limits_version:
+            if cached[2] is not None:
+                # every pod of the group shares the cached diagnosis, but
+                # each stages its OWN funnel (commit is keyed by pod uid)
+                explmod.recorder().note_funnel(pod.metadata.uid, cached[2])
             return cached[1]
         s = self.s
-        errs: list[Exception] = []
+        # errs carries (nodepool, error): the pool attribution feeds the
+        # explanation funnel; the joined message is unchanged
+        errs: list[tuple[str, Exception]] = []
         for ti, nct in enumerate(s.nodeclaim_templates):
             remaining = self.remaining_resources.get(nct.nodepool_name)
             limits_mask = None
@@ -1669,7 +1677,7 @@ class _DeviceSolve:
                         )
                     )
                 if hit is not True:
-                    errs.append(hit)
+                    errs.append((nct.nodepool_name, hit))
                     continue
             tol = self.tg_tol.get((ti, gi))
             if tol is None:
@@ -1678,19 +1686,25 @@ class _DeviceSolve:
                 self.tg_tol[(ti, gi)] = tol
             if not tol:
                 errs.append(
-                    ValueError(str(Taints(nct.spec.taints).tolerates_pod(pod)))
+                    (
+                        nct.nodepool_name,
+                        ValueError(str(Taints(nct.spec.taints).tolerates_pod(pod))),
+                    )
                 )
                 continue
             tg = self._tg(ti, gi)
             if tg is None:
                 errs.append(
-                    ValueError(
-                        "incompatible requirements, "
-                        + str(
-                            nct.requirements.compatible(
-                                g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                    (
+                        nct.nodepool_name,
+                        ValueError(
+                            "incompatible requirements, "
+                            + str(
+                                nct.requirements.compatible(
+                                    g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                                )
                             )
-                        )
+                        ),
                     )
                 )
                 continue
@@ -1699,12 +1713,17 @@ class _DeviceSolve:
             okey = (ti, gi)
             if fam < 0:
                 if limits_mask is None:
-                    errs.append(self._open_errs[okey])
+                    errs.append((nct.nodepool_name, self._open_errs[okey]))
                 else:
                     # host diagnostics are over the LIMITED base; a limited
                     # set is a subset of the failed limitless one, so it
                     # still fails — recompute only the message bits
-                    errs.append(self._limited_open_error(ti, gi, g, limits_mask))
+                    errs.append(
+                        (
+                            nct.nodepool_name,
+                            self._limited_open_error(ti, gi, g, limits_mask),
+                        )
+                    )
                 continue
             if limits_mask is None:
                 self._open_claim(
@@ -1742,8 +1761,12 @@ class _DeviceSolve:
                 joint_tg, rows = tg
                 compat_v, offer_v = self._joint_masks(rows, joint_tg)
                 errs.append(
-                    self._filter_error(
-                        self.tmpl_mask[ti] & limits_mask, compat_v, offer_v, ti, g
+                    (
+                        nct.nodepool_name,
+                        self._filter_error(
+                            self.tmpl_mask[ti] & limits_mask, compat_v, offer_v,
+                            ti, g,
+                        ),
                     )
                 )
                 continue
@@ -1754,7 +1777,7 @@ class _DeviceSolve:
                     self.tmpl_mask[ti] & limits_mask, compat_v, offer_v, ti, g
                 )
                 err.min_values_incompatible = min_msg
-                errs.append(err)
+                errs.append((nct.nodepool_name, err))
                 continue
             self._open_claim(
                 ti,
@@ -1773,13 +1796,17 @@ class _DeviceSolve:
             self._subtract_max(nct, candidate & surv_u[self.uid_of_type])
             return None
         if not errs:
-            errs.append(ValueError("no nodepool can host the pod"))
+            errs.append(("", ValueError("no nodepool can host the pod")))
         err = (
-            errs[0]
+            errs[0][1]
             if len(errs) == 1
-            else ValueError("; ".join(str(e) for e in errs))
+            else ValueError("; ".join(str(e) for _, e in errs))
         )
-        self.gnewclaim_err[gi] = (self.limits_version, err)
+        rec = explmod.recorder()
+        funnel = explmod.funnel_from(errs) if rec.enabled else None
+        if funnel is not None:
+            rec.note_funnel(pod.metadata.uid, funnel)
+        self.gnewclaim_err[gi] = (self.limits_version, err, funnel)
         return err
 
     def _open_claim(
@@ -1972,6 +1999,14 @@ class _DeviceSolve:
         fits_v = self._fits_vec(self.usage0_f[ti] + g.req_f)
         m = base
         c, f, o = compat_v[m], fits_v[m], offer_v[m]
+        rec = explmod.recorder()
+        if rec.enabled:
+            # decode the cube's already-materialized planes into per-stage
+            # elimination counts (first-failing-stage attribution) — host
+            # numpy over fetched bools, zero extra device dispatches
+            from karpenter_tpu.ops import feasibility as feas
+
+            rec.note_plane_counts(feas.stage_counts(feas.stage_plane_np(c, f, o)))
         err = InstanceTypeFilterError()
         err.requirements_met = bool(c.any())
         err.fits = bool(f.any())

@@ -1233,7 +1233,9 @@ class _TopoSolve(_DeviceSolve):
                 return None
         s, topo = self.s, self.topology
         gp = self.g_ports[gi]
-        errs: list[Exception] = []
+        # (nodepool, error): the pool attribution feeds the explanation
+        # funnel (observability/explain.py); the joined message is unchanged
+        errs: list[tuple[str, Exception]] = []
         outcomes: list = []
         memo_ok = True
         # gens are captured at ENTRY: the memo is valid only while the
@@ -1254,9 +1256,12 @@ class _TopoSolve(_DeviceSolve):
                 limits_mask = self._limits_mask(nct.nodepool_name, remaining)
                 if not (limits_mask & self.tmpl_mask[ti]).any():
                     errs.append(
-                        ValueError(
-                            f"all available instance types exceed limits for "
-                            f"nodepool {nct.nodepool_name!r}"
+                        (
+                            nct.nodepool_name,
+                            ValueError(
+                                f"all available instance types exceed limits "
+                                f"for nodepool {nct.nodepool_name!r}"
+                            ),
                         )
                     )
                     continue
@@ -1270,14 +1275,22 @@ class _TopoSolve(_DeviceSolve):
                 self.tg_tol[(ti, gi)] = tol
             if not tol:
                 errs.append(
-                    ValueError(str(Taints(nct.spec.taints).tolerates_pod(pod)))
+                    (
+                        nct.nodepool_name,
+                        ValueError(
+                            str(Taints(nct.spec.taints).tolerates_pod(pod))
+                        ),
+                    )
                 )
                 continue
             if gp:
                 conflict = s.daemon_hostports[nct].conflicts(pod, gp)
                 if conflict is not None:
                     errs.append(
-                        ValueError(f"checking host port usage, {conflict}")
+                        (
+                            nct.nodepool_name,
+                            ValueError(f"checking host port usage, {conflict}"),
+                        )
                     )
                     continue
             if g.has_hostname:
@@ -1292,18 +1305,26 @@ class _TopoSolve(_DeviceSolve):
                     g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
                 )
                 if cerr is not None:
-                    errs.append(ValueError(f"incompatible requirements, {cerr}"))
+                    errs.append(
+                        (
+                            nct.nodepool_name,
+                            ValueError(f"incompatible requirements, {cerr}"),
+                        )
+                    )
                     continue
             tg = self._tg(ti, gi)
             if tg is None:
                 errs.append(
-                    ValueError(
-                        "incompatible requirements, "
-                        + str(
-                            nct.requirements.compatible(
-                                g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                    (
+                        nct.nodepool_name,
+                        ValueError(
+                            "incompatible requirements, "
+                            + str(
+                                nct.requirements.compatible(
+                                    g.reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                                )
                             )
-                        )
+                        ),
                     )
                 )
                 continue
@@ -1319,11 +1340,11 @@ class _TopoSolve(_DeviceSolve):
                     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
                 )
             except ValueError as e:
-                errs.append(e)
+                errs.append((nct.nodepool_name, e))
                 continue
             topo_err = joint.compatible(topo_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
             if topo_err is not None:
-                errs.append(ValueError(topo_err))
+                errs.append((nct.nodepool_name, ValueError(topo_err)))
                 continue
             joint.add(*topo_reqs.values())
             final_rows = self._rows_sans_hostname(joint)
@@ -1336,7 +1357,12 @@ class _TopoSolve(_DeviceSolve):
             rem0 = self.uniq_alloc[cand_u] - (self.usage0_f[ti] + g.req_f)
             fitrows = (rem0 >= -_EPS).all(axis=1)
             if not fitrows.any():
-                errs.append(self._filter_error(base, compat_v, offer_v, ti, g))
+                errs.append(
+                    (
+                        nct.nodepool_name,
+                        self._filter_error(base, compat_v, offer_v, ti, g),
+                    )
+                )
                 continue
             u_ids = cand_u[fitrows]
             final = self._final_types(candidate, u_ids)
@@ -1346,7 +1372,7 @@ class _TopoSolve(_DeviceSolve):
                 if msg is not None:
                     err = self._filter_error(base, compat_v, offer_v, ti, g)
                     err.min_values_incompatible = msg
-                    errs.append(err)
+                    errs.append((nct.nodepool_name, err))
                     continue
             if self.strict_res:
                 try:
@@ -1381,12 +1407,20 @@ class _TopoSolve(_DeviceSolve):
                 )
                 self._open_memo[gi] = (memo_toks, entry_gens, outcomes)
             return None
+        from karpenter_tpu.observability import explain as explmod
+
+        rec = explmod.recorder()
+        if rec.enabled and errs:
+            # stage the per-nodepool funnel, exactly as the host scheduler
+            # does (scheduler.py _add_to_new_node_claim) — the solve barrier
+            # commits it only if the pod stays failed
+            rec.note_funnel(pod.metadata.uid, explmod.funnel_from(errs))
         if not errs:
-            errs.append(ValueError("no nodepool can host the pod"))
+            errs.append(("", ValueError("no nodepool can host the pod")))
         return (
-            errs[0]
+            errs[0][1]
             if len(errs) == 1
-            else ValueError("; ".join(str(e) for e in errs))
+            else ValueError("; ".join(str(e) for _, e in errs))
         )
 
     def _restore_relaxed(self, pod: Pod) -> None:

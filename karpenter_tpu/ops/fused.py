@@ -88,6 +88,12 @@ FUSED_LIMITS_MAX_PODS = 8192
 def note_decline(reason: str) -> None:
     FUSED_DECLINES[reason] = FUSED_DECLINES.get(reason, 0) + 1
     _FUSED_DECLINES_CTR.inc({"reason": reason})
+    # fold the decline taxonomy into the provenance ledger (`fused:<reason>`
+    # stages): a decline reroutes the batch to the host walk, whose per-pod
+    # errors stage normally, so per-pod explanations stay path-identical
+    from karpenter_tpu.observability import explain as explmod
+
+    explmod.recorder().note_fused_decline(reason)
 
 
 def fused_counters() -> dict:

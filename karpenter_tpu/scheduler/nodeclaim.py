@@ -173,6 +173,21 @@ def filter_instance_types(
                 remaining = []
             # relax: keep remaining, record relaxed keys via unsatisfiable
     if not remaining:
+        from karpenter_tpu.observability import explain as explmod
+
+        rec = explmod.recorder()
+        if rec.enabled and triples:
+            # decode the per-type triple into first-failing-stage counts —
+            # the host-path twin of the device sweep's stage plane, so the
+            # elimination metric reads identically on either backend
+            import numpy as np
+
+            from karpenter_tpu.ops import feasibility as feas
+
+            t = np.asarray(triples, dtype=bool)
+            rec.note_plane_counts(
+                feas.stage_counts(feas.stage_plane_np(t[:, 0], t[:, 1], t[:, 2]))
+            )
         return [], unsatisfiable, err
     return remaining, unsatisfiable, None
 

@@ -104,11 +104,22 @@ class Results:
     timed_out: bool = False
 
     def record(self, recorder: Recorder, cluster: Cluster) -> None:
+        from karpenter_tpu.observability import explain as explmod
+
+        ledger = explmod.recorder()
         for p, err in self.pod_errors.items():
             if isinstance(err, ReservedOfferingError):
                 continue
+            message = f"Failed to schedule pod, {err}"
+            if ledger.enabled:
+                # provenance enrichment (--explain): the top eliminating
+                # stages replace squinting at the aggregated tuple string;
+                # gated on mode so default event streams stay byte-identical
+                reasons = ledger.top_reasons(p.metadata.uid, k=3)
+                if reasons:
+                    message += f" (top eliminations: {', '.join(reasons)})"
             recorder.publish(
-                Event(p, "Warning", "FailedScheduling", f"Failed to schedule pod, {err}")
+                Event(p, "Warning", "FailedScheduling", message)
             )
         for existing in self.existing_nodes:
             if existing.pods:
@@ -488,6 +499,9 @@ class Scheduler:
         errors propagate (scheduler.go:478-556)."""
         pod_data = self.cached_pod_data[pod.metadata.uid]
         errs = []
+        # parallel nodepool attribution for the provenance funnel
+        # (observability/explain.py); the raised error is unchanged
+        pools: list[str] = []
         reserved_err: Optional[ReservedOfferingError] = None
         for nct in self.nodeclaim_templates:
             its = nct.instance_type_options
@@ -501,6 +515,7 @@ class Scheduler:
                             f"nodepool {nct.nodepool_name!r}"
                         )
                     )
+                    pools.append(nct.nodepool_name)
                     continue
             nc = NodeClaim(
                 nct,
@@ -523,6 +538,7 @@ class Scheduler:
                 break  # earliest-index-wins: later templates can't override
             except Exception as e:  # noqa: BLE001
                 errs.append(e)
+                pools.append(nct.nodepool_name)
                 continue
             min_values_relaxed = any(
                 orig.min_values is not None
@@ -543,6 +559,15 @@ class Scheduler:
             return
         if reserved_err is not None:
             raise reserved_err
+        from karpenter_tpu.observability import explain as explmod
+
+        rec = explmod.recorder()
+        if rec.enabled and errs:
+            # stage the per-nodepool funnel; the solve-completion barrier
+            # (solverd coalescer) commits it only if the pod stays failed
+            rec.note_funnel(
+                pod.metadata.uid, explmod.funnel_from(list(zip(pools, errs)))
+            )
         raise errs[0] if len(errs) == 1 else ValueError(
             "; ".join(str(e) for e in errs) or "no nodepool can host the pod"
         )

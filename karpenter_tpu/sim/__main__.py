@@ -70,6 +70,14 @@ def main(argv=None) -> int:
         "on non-CPU backends (env KARPENTER_TPU_FUSED)",
     )
     parser.add_argument(
+        "--explain", choices=["off", "sampled", "on"], default="",
+        help="decision provenance ledger (observability/explain.py): "
+        "capture per-pod elimination funnels and fold them into "
+        "report['explain'] with a determinism digest; sampled keeps a "
+        "seeded ~25%% of pods; default leaves the process setting alone "
+        "(env KARPENTER_TPU_EXPLAIN)",
+    )
+    parser.add_argument(
         "--flight-dir",
         default="",
         help="flight-recorder bundle directory: SLO breaches during the "
@@ -124,6 +132,10 @@ def main(argv=None) -> int:
         from karpenter_tpu.ops import fused as fused_mod
 
         fused_mod.FUSED_MODE = args.fused_solve
+    if args.explain:
+        from karpenter_tpu.observability import explain as explain_mod
+
+        explain_mod.configure(mode=args.explain)
     options = None
     if (
         args.compile_cache_dir

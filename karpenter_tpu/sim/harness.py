@@ -149,6 +149,13 @@ def sim_globals(seed: int, clock: FakeClock):
     # report["slo"]/report["flight"] are pure functions of (scenario, seed)
     slomod.engine().reset()
     flightmod.recorder().reset()
+    # fresh provenance ledger per run (mode/capacity survive — they were
+    # configured at operator construction): ring, staging, and fused-decline
+    # taxonomy restart at zero so report["explain"] and its digest are pure
+    # functions of (scenario, seed)
+    from karpenter_tpu.observability import explain as explainmod
+
+    explainmod.recorder().reset()
     # device-profiler sequence + cooldowns restart so breach-armed capture
     # names (recorded in flight bundle contexts) are a pure function of
     # the run, not of process history
@@ -515,6 +522,14 @@ class Simulation:
         report["slo"]["breaches_total"] = engine_report["breaches_total"]
         report["slo"]["digest"] = engine_report["digest"]
         report["flight"] = flightmod.recorder().report()
+        # the provenance ledger's verdict — per-stage elimination totals,
+        # fused-decline taxonomy, and a sha256 digest over the canonical
+        # ledger entries. Inside the deterministic surface: funnels carry
+        # stages + error strings only (host/device parity-guaranteed), and
+        # entry timestamps are virtual time.
+        from karpenter_tpu.observability import explain as explainmod
+
+        report["explain"] = explainmod.recorder().report()
         return report
 
     @staticmethod

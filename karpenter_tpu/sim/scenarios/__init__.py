@@ -67,6 +67,12 @@ register(
     "TPU-slice training gangs: zone topology-spread, arm64-pinned, long-running",
 )
 register(
+    "capacity-pressure",
+    tracemod.capacity_pressure,
+    "limits-capped pool under overload + two exactly-unsatisfiable pods; the "
+    "/debug/explain provenance fixture",
+)
+register(
     "flaky-cloud",
     tracemod.flaky_cloud,
     "launch failures, capacity errors, API latency, solver rejection storm",

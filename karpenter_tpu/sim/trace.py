@@ -626,6 +626,61 @@ def crash_churn(rng: Random) -> dict:
     return trace
 
 
+def capacity_pressure(rng: Random) -> dict:
+    """The /debug/explain fixture: a limits-capped single pool under more
+    demand than it may hold, plus two deliberately unsatisfiable pods whose
+    eliminating stage is exact and distinct — a giant pod no instance type
+    can fit (resources) and a pod pinned to a zone no offering serves
+    (offerings). Fillers saturate the cpu limit so their overflow pends on
+    limits, then drain at t=60 — headroom returns, and the unsatisfiable
+    pods re-solve to their TRUE stages for the rest of the run (an
+    exhausted pool eliminates everything at the limits stage, which would
+    mask them). No faults: the triage table, the per-stage elimination
+    counters, and the ledger digest are pure functions of the seed."""
+    trace = _base("capacity-pressure", duration=180.0)
+    # pin the pool to 4-cpu boxes and cap it at 12 cpu (3 nodes): a 3-cpu
+    # filler owns a node, so any filler past the third pends on limits
+    trace["nodepools"][0]["requirements"] = [
+        {
+            "key": "karpenter.kwok.sh/instance-size",
+            "operator": "In",
+            "values": ["4x"],
+        }
+    ]
+    trace["nodepools"][0]["limits"] = {"cpu": "12"}
+    trace["events"] = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "filler",
+            "count": 5 + rng.randrange(2),
+            "pod": {"cpu": "3", "memory": "2Gi"},
+            "until": 60.0,
+            "replace": True,
+        },
+        # no 4x instance type holds 64 cpu: every nodepool eliminates this
+        # pod at the resources stage, forever
+        {
+            "at": 8.0,
+            "kind": "submit",
+            "group": "giant",
+            "count": 1,
+            "pod": {"cpu": "64", "memory": "4Gi"},
+            "replace": True,
+        },
+        # no offering serves this zone: eliminated at the offerings stage
+        {
+            "at": 8.0,
+            "kind": "submit",
+            "group": "lost-zone",
+            "count": 1,
+            "pod": {"cpu": "1", "memory": "1Gi", "zone": "kwok-zone-9"},
+            "replace": True,
+        },
+    ]
+    return trace
+
+
 def flaky_cloud(rng: Random) -> dict:
     """Steady demand against a misbehaving cloud: probabilistic launch
     failures, occasional capacity errors, API latency, a solver shedding

@@ -31,6 +31,7 @@ import time
 
 from karpenter_tpu import tracing
 from karpenter_tpu.metrics import global_registry, measure
+from karpenter_tpu.observability import explain as explmod
 from karpenter_tpu.observability import kernels as kobs
 from karpenter_tpu.tracing import kernel as ktime
 
@@ -81,6 +82,10 @@ class Coalescer:
                     self._solve_one(entry)
                     continue
                 base = ffd.solver_cache_counters()
+                # explain-off adds ZERO work and ZERO attrs to the solve
+                # span — the provenance ledger only meters when capturing
+                ledger = explmod.recorder()
+                explain_base = ledger.counters() if ledger.enabled else None
                 reg = kobs.registry()
                 recompiles_base = reg.steady_recompiles()
                 t0 = time.perf_counter()
@@ -121,6 +126,13 @@ class Coalescer:
                     device_live_array_bytes=mem_live[0],
                     **delta,
                 )
+                if explain_base is not None:
+                    now_ctr = ledger.counters()
+                    span.set_volatile(
+                        explain_committed=now_ctr["explain_committed"]
+                        - explain_base["explain_committed"],
+                        explain_ring_depth=now_ctr["explain_ring_depth"],
+                    )
 
     @staticmethod
     def _solve_one(entry):
@@ -130,6 +142,13 @@ class Coalescer:
         try:
             with measure(_SOLVE_LATENCY, {"kind": req.kind}):
                 entry.result = req.scheduler.solve(req.pods, timeout=req.timeout)
+            # solve-completion barrier for the provenance ledger: commit an
+            # entry per still-failed pod (provisioning solves only — the
+            # simulate kind clears staging without polluting the triage
+            # table). No-op when --explain is off.
+            explmod.recorder().commit_solve(
+                req.pods, entry.result.pod_errors, kind=req.kind
+            )
         except Exception as err:  # noqa: BLE001 — fail the one request
             entry.error = err
             return err

@@ -885,6 +885,137 @@ class TestFusedParity:
         assert fused_n == 1, f"fused mesh scan fell back: {declines}"
 
 
+def run_explain_case(seed: int, fused: bool = False, cluster: bool = False):
+    """run_case's twin-leg pattern with the explain recorder ON for both
+    legs. Two ride-along pods that cannot schedule anywhere guarantee
+    ledger rows; after each leg the staged funnels commit through the same
+    barrier the solverd coalescer uses. Returns (host_decisions,
+    device_decisions, host_ledger, device_ledger, device_ran) where a
+    ledger is the sorted per-failed-pod view of (name, error, stages,
+    per-nodepool funnel) — the /debug/explain payload must not depend on
+    which solve path ran."""
+    import copy
+
+    from karpenter_tpu.observability import explain as explmod
+    from karpenter_tpu.ops import fused as fused_mod
+
+    pools, nodes, bound, ds_pods, build_pods = build_case(
+        seed, False, False, cluster, False, fused
+    )
+
+    def env(engine):
+        return Env(
+            node_pools=copy.deepcopy(pools),
+            state_nodes=copy.deepcopy(nodes),
+            pods=copy.deepcopy(bound),
+            daemonset_pods=copy.deepcopy(ds_pods),
+            catalog=CATALOG,
+            engine=engine,
+        )
+
+    def unsat_pods():
+        giant = unschedulable_pod(name="xx-giant", requests={"cpu": "9999"})
+        giant.metadata.uid = "uid-xx-giant"
+        lost = unschedulable_pod(
+            name="xx-lost-zone",
+            requests={"cpu": "1"},
+            node_selector={"topology.kubernetes.io/zone": "zone-nowhere"},
+        )
+        lost.metadata.uid = "uid-xx-lost"
+        return [giant, lost]
+
+    rec = explmod.recorder()
+    old_mode = rec.mode or "off"
+
+    def leg(engine):
+        rec.reset()
+        ncmod._hostname_counter = itertools.count(1)
+        pods = build_pods() + unsat_pods()
+        results = env(engine).schedule(pods)
+        rec.commit_solve(pods, results.pod_errors, kind="solve")
+        ledger = []
+        for p in sorted(results.pod_errors, key=lambda p: p.metadata.name):
+            e = rec.entry(p.metadata.uid)
+            assert e is not None, f"no ledger entry for failed pod {p.metadata.name}"
+            ledger.append(
+                (
+                    e["pod"],
+                    e["error"],
+                    tuple(e["stages"]),
+                    tuple(
+                        (f["nodepool"], tuple(f["stages"]), f["error"])
+                        for f in e["funnel"]
+                    ),
+                )
+            )
+        return decisions(results), ledger
+
+    solves0 = ffd.DEVICE_SOLVES
+    old_strict = ffd.STRICT
+    old_fused = fused_mod.FUSED_MODE
+    try:
+        explmod.configure(mode="on")
+        host, host_ledger = leg(None)
+        ffd.STRICT = True
+        if fused:
+            fused_mod.FUSED_MODE = "on"
+        dev, dev_ledger = leg(CatalogEngine(CATALOG))
+    finally:
+        ffd.STRICT = old_strict
+        fused_mod.FUSED_MODE = old_fused
+        explmod.configure(mode=old_mode)
+        rec.reset()
+    return host, dev, host_ledger, dev_ledger, ffd.DEVICE_SOLVES > solves0
+
+
+class TestExplainParity:
+    """Decision provenance rides decision parity: the device leg and the
+    one-dispatch fused leg must NARRATE eliminations identically to the
+    host oracle — same per-pod funnel (nodepool walk order, stages, error
+    text) and same classified final stages — or /debug/explain's answer
+    would depend on which solve path happened to run."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_device_explanation_parity(self, seed):
+        host, dev, host_ledger, dev_ledger, ran = run_explain_case(seed)
+        assert host == dev
+        assert ran, "device path fell back to the host loop"
+        assert host_ledger == dev_ledger
+        names = {row[0] for row in host_ledger}
+        assert {"xx-giant", "xx-lost-zone"} <= names
+        stages = {s for row in host_ledger for s in row[2]}
+        assert stages <= set(explain_stage_vocab()), stages
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fused_explanation_parity(self, seed):
+        """The fused scan either solves the batch in one dispatch or
+        declines to the device loop — in BOTH cases the ledger must match
+        the host story exactly."""
+        host, dev, host_ledger, dev_ledger, ran = run_explain_case(
+            seed, fused=True
+        )
+        assert host == dev
+        assert ran
+        assert host_ledger == dev_ledger
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_cluster_explanation_parity(self, seed):
+        """Existing-node assignments engaged: failed pods still narrate
+        identically across legs."""
+        host, dev, host_ledger, dev_ledger, ran = run_explain_case(
+            seed, cluster=True
+        )
+        assert host == dev
+        assert ran
+        assert host_ledger == dev_ledger
+
+
+def explain_stage_vocab():
+    from karpenter_tpu.observability import explain as explmod
+
+    return explmod.STAGES
+
+
 def main(
     n_cases: int,
     topo: bool = False,

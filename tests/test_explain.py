@@ -1,0 +1,472 @@
+"""Decision provenance observatory (observability/explain.py): stage
+classification, funnel staging + the solve-completion commit barrier, ring
+bounds under churn, sampled-mode determinism, the report digest, event
+enrichment, what-if requirement dropping, and the operator's
+/debug/explain snapshot + counterfactual probe end to end."""
+
+import pytest
+
+from karpenter_tpu.apis.core import Condition, Container, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.observability import explain as explmod
+from karpenter_tpu.utils.clock import FakeClock
+
+from helpers import nodepool, unschedulable_pod
+
+
+@pytest.fixture(autouse=True)
+def clean_ledger():
+    """The recorder is process-global: every test starts disabled and
+    empty, and leaves it that way."""
+    rec = explmod.recorder()
+    rec.configure(mode="off", capacity=256)
+    rec.reset()
+    yield rec
+    rec.configure(mode="off", capacity=256)
+    rec.reset()
+
+
+def make_pod(name: str, uid: str) -> Pod:
+    pod = Pod(
+        metadata=ObjectMeta(name=name, uid=uid),
+        spec=PodSpec(containers=[Container()]),
+    )
+    pod.status.conditions.append(
+        Condition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return pod
+
+
+class TestClassify:
+    def test_typed_filter_error_individual_flags(self):
+        from karpenter_tpu.scheduler.nodeclaim import InstanceTypeFilterError
+
+        err = InstanceTypeFilterError(
+            requirements_met=True, fits=False, has_offering=True
+        )
+        assert explmod.classify(err) == ("resources",)
+        err = InstanceTypeFilterError(
+            requirements_met=False, fits=True, has_offering=False
+        )
+        assert explmod.classify(err) == ("requirements", "offerings")
+
+    def test_typed_filter_error_pairwise_blames_third(self):
+        from karpenter_tpu.scheduler.nodeclaim import InstanceTypeFilterError
+
+        base = dict(requirements_met=True, fits=True, has_offering=True)
+        assert explmod.classify(
+            InstanceTypeFilterError(**base, requirements_and_fits=True)
+        ) == ("offerings",)
+        assert explmod.classify(
+            InstanceTypeFilterError(**base, fits_and_offering=True)
+        ) == ("requirements",)
+        assert explmod.classify(
+            InstanceTypeFilterError(**base, requirements_and_offering=True)
+        ) == ("resources",)
+
+    def test_min_values_wins(self):
+        from karpenter_tpu.scheduler.nodeclaim import InstanceTypeFilterError
+
+        err = InstanceTypeFilterError(
+            fits=False, min_values_incompatible="minValues requirement ..."
+        )
+        assert explmod.classify(err) == ("min-values",)
+
+    def test_timeout(self):
+        assert explmod.classify(TimeoutError("solve timed out")) == ("timeout",)
+
+    def test_message_rules(self):
+        cases = {
+            "did not tolerate node taint gpu=true:NoSchedule": "taints",
+            "incompatible requirements, key foo": "requirements",
+            "all available instance types exceed limits for nodepool 'x'": "limits",
+            "checking host port usage conflict on 8080": "host-ports",
+            "would violate topology spread constraint": "topology",
+            "no nodepools found": "no-nodepools",
+        }
+        for message, stage in cases.items():
+            assert explmod.classify(ValueError(message)) == (stage,), message
+
+    def test_aggregated_message_classifies_per_part(self):
+        message = (
+            "incompatible requirements, key a; "
+            "all available instance types exceed limits for nodepool 'b'"
+        )
+        assert explmod.classify_message(message) == ("requirements", "limits")
+
+    def test_unknown_falls_through(self):
+        assert explmod.classify(ValueError("some novel failure")) == ("unknown",)
+
+    def test_every_stage_is_interned(self):
+        for stage in explmod.STAGES:
+            assert explmod._stage_order(stage) < len(explmod.STAGES)
+
+
+class TestLedger:
+    def test_disabled_hooks_are_noops(self, clean_ledger):
+        rec = clean_ledger
+        pod = make_pod("p", "u1")
+        rec.note_funnel("u1", [{"nodepool": "n", "stages": ["limits"], "error": "e"}])
+        rec.commit_solve([pod], {pod: ValueError("x")})
+        # nothing captured (the disabled->404 gate lives in the operator)
+        snap = rec.snapshot()
+        assert snap["mode"] == "off" and snap["ring_depth"] == 0
+        assert rec.snapshot(pod="u1") is None
+        assert rec.counters()["explain_committed"] == 0
+
+    def test_commit_only_on_solve_kind(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on")
+        pod = make_pod("p", "u1")
+        rec.note_funnel("u1", [{"nodepool": "n", "stages": ["limits"], "error": "e"}])
+        rec.commit_solve([pod], {pod: ValueError("x")}, kind="simulate")
+        assert rec.snapshot()["ring_depth"] == 0
+        assert rec.counters()["explain_staged"] == 0  # staging cleared
+        rec.note_funnel("u1", [{"nodepool": "n", "stages": ["limits"], "error": "e"}])
+        rec.commit_solve([pod], {pod: ValueError("x")}, kind="solve")
+        assert rec.snapshot()["ring_depth"] == 1
+
+    def test_scheduled_pod_drops_staging_without_entry(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on")
+        pod = make_pod("p", "u1")
+        rec.note_funnel("u1", [{"nodepool": "n", "stages": ["limits"], "error": "e"}])
+        rec.commit_solve([pod], {})  # the pod placed
+        assert rec.snapshot()["ring_depth"] == 0
+        assert rec.counters()["explain_staged"] == 0
+
+    def test_ring_eviction_and_recency_refresh(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on", capacity=2)
+        pods = {u: make_pod(f"p-{u}", u) for u in ("a", "b", "c")}
+        for u in ("a", "b"):
+            rec.commit_solve([pods[u]], {pods[u]: ValueError("x")})
+        # re-failing 'a' refreshes its recency: 'b' is now the oldest
+        rec.commit_solve([pods["a"]], {pods["a"]: ValueError("x")})
+        rec.commit_solve([pods["c"]], {pods["c"]: ValueError("x")})
+        snap = rec.snapshot()
+        assert snap["ring_depth"] == 2 and snap["evicted"] == 1
+        held = {row["uid"] for row in snap["pods"]}
+        assert held == {"a", "c"}
+        assert rec.entry("a")["solves"] == 2
+        assert rec.snapshot(pod="b") is None  # evicted -> 404
+
+    def test_staging_bounded_under_churn(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on", capacity=4)
+        for i in range(200):
+            rec.note_funnel(
+                f"uid-{i}", [{"nodepool": "n", "stages": ["limits"], "error": "e"}]
+            )
+        assert rec.counters()["explain_staged"] <= 4 * rec.capacity
+
+    def test_sampled_mode_is_deterministic(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="sampled")
+        uids = [f"uid-{i}" for i in range(400)]
+        picked = {u for u in uids if rec.want(u)}
+        assert picked == {u for u in uids if rec.want(u)}  # pure function
+        # ~25% draw: wide tolerance, zero flake (the set is fixed)
+        assert 40 < len(picked) < 180
+        other = explmod.ExplainRecorder()
+        other.configure(mode="sampled")
+        assert picked == {u for u in uids if other.want(u)}
+
+    def test_entry_lookup_by_name_and_namespaced_name(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on")
+        pod = make_pod("web-0", "u9")
+        rec.commit_solve([pod], {pod: ValueError("x")})
+        assert rec.entry("u9")["pod"] == "web-0"
+        assert rec.entry("web-0")["uid"] == "u9"
+        assert rec.entry("default/web-0")["uid"] == "u9"
+        assert rec.entry("missing") is None
+
+    def test_top_reasons_funnel_ordered(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on")
+        pod = make_pod("p", "u1")
+        rec.note_funnel(
+            "u1",
+            [
+                {"nodepool": "gpu", "stages": ["taints"], "error": "e1"},
+                {"nodepool": "workers", "stages": ["limits"], "error": "e2"},
+            ],
+        )
+        rec.commit_solve([pod], {pod: ValueError("did not tolerate taint")})
+        assert rec.top_reasons("u1", k=3) == ["taints(gpu)", "limits(workers)"]
+        assert rec.top_reasons("u1", k=1) == ["taints(gpu)"]
+        assert rec.top_reasons("nope") == []
+
+    def test_report_digest_reproduces(self, clean_ledger):
+        def build():
+            rec = explmod.ExplainRecorder(clock=FakeClock())
+            rec.configure(mode="on")
+            for u in ("a", "b"):
+                pod = make_pod(f"p-{u}", u)
+                rec.note_funnel(
+                    u, [{"nodepool": "n", "stages": ["limits"], "error": "e"}]
+                )
+                rec.commit_solve([pod], {pod: ValueError("exceed limits for nodepool 'n'")})
+            return rec.report()
+
+        one, two = build(), build()
+        assert one["digest"].startswith("sha256:")
+        assert one == two
+        assert one["stage_totals"] == {"limits": 2}
+
+    def test_reset_keeps_mode_and_capacity(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="sampled", capacity=7)
+        pod = make_pod("p", "u-keep")
+        rec.configure(mode="on")
+        rec.commit_solve([pod], {pod: ValueError("x")})
+        rec.configure(mode="sampled")
+        rec.reset()
+        assert rec.mode == "sampled" and rec.capacity == 7
+        assert rec.report()["ring_depth"] == 0
+
+    def test_fused_declines_fold_in(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on")
+        rec.note_fused_decline("topology")
+        rec.note_fused_decline("topology")
+        rec.note_fused_decline("reserved-offerings")
+        snap = rec.snapshot()
+        assert snap["fused_declines"] == {"reserved-offerings": 1, "topology": 2}
+        assert rec.report()["fused_declines"]["topology"] == 2
+
+
+class TestDropRequirement:
+    def test_drops_node_selector_key(self):
+        pod = unschedulable_pod(node_selector={"topology.kubernetes.io/zone": "z9"})
+        assert explmod.drop_requirement(pod, "topology.kubernetes.io/zone")
+        assert pod.spec.node_selector == {}
+
+    def test_drops_topology_spread_on_key(self):
+        from karpenter_tpu.apis.core import LabelSelector, TopologySpreadConstraint
+
+        pod = unschedulable_pod()
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"a": "b"}),
+            )
+        ]
+        assert explmod.drop_requirement(pod, "topology.kubernetes.io/zone")
+        assert pod.spec.topology_spread_constraints == []
+
+    def test_no_op_returns_false(self):
+        pod = unschedulable_pod(node_selector={"kubernetes.io/arch": "arm64"})
+        assert not explmod.drop_requirement(pod, "some.other/key")
+        assert pod.spec.node_selector == {"kubernetes.io/arch": "arm64"}
+
+
+class TestEventEnrichment:
+    """Satellite 1: unschedulable-pod Warning events embed the top
+    eliminating reasons when (and only when) the ledger is capturing."""
+
+    def _record(self, rec_events):
+        from karpenter_tpu.scheduler.scheduler import Results
+
+        pod = make_pod("pending-0", "u-ev")
+        results = Results(
+            new_node_claims=[],
+            existing_nodes=[],
+            pod_errors={pod: ValueError("exceed limits for nodepool 'workers'")},
+        )
+        results.record(rec_events, cluster=None)
+        return pod
+
+    class _Sink:
+        def __init__(self):
+            self.events = []
+
+        def publish(self, *events):
+            self.events.extend(events)
+
+    def test_default_event_stream_is_unchanged(self, clean_ledger):
+        sink = self._Sink()
+        self._record(sink)
+        (event,) = sink.events
+        assert event.reason == "FailedScheduling"
+        assert "top eliminations" not in event.message
+
+    def test_enabled_ledger_enriches_with_top_reasons(self, clean_ledger):
+        rec = clean_ledger
+        rec.configure(mode="on")
+        pod = make_pod("pending-0", "u-ev")
+        rec.note_funnel(
+            "u-ev",
+            [{"nodepool": "workers", "stages": ["limits"], "error": "e"}],
+        )
+        rec.commit_solve(
+            [pod], {pod: ValueError("exceed limits for nodepool 'workers'")}
+        )
+        sink = self._Sink()
+        self._record(sink)
+        (event,) = sink.events
+        assert "top eliminations: limits(workers)" in event.message
+
+    def test_enabled_but_unseen_pod_stays_plain(self, clean_ledger):
+        clean_ledger.configure(mode="on")
+        sink = self._Sink()
+        self._record(sink)  # nothing committed for this uid
+        (event,) = sink.events
+        assert "top eliminations" not in event.message
+
+
+class TestCoalescerBarrier:
+    """The solve-completion barrier lives in the solverd coalescer: commits
+    on provisioning solves, staging-only on simulations, and — satellite 6 —
+    explain-off adds zero work to the sampled solve span."""
+
+    class _Results:
+        def __init__(self, pod_errors):
+            self.pod_errors = pod_errors
+
+    class _Scheduler:
+        def __init__(self, results):
+            self._results = results
+
+        def solve(self, pods, timeout=None):
+            return self._results
+
+    class _Request:
+        def __init__(self, scheduler, pods, kind="solve"):
+            self.scheduler = scheduler
+            self.pods = pods
+            self.kind = kind
+            self.timeout = 1.0
+            self.trace_context = None
+
+    class _Entry:
+        def __init__(self, request):
+            self.request = request
+            self.result = None
+            self.error = None
+
+    def _execute(self, kind, fail):
+        from karpenter_tpu.solverd.coalescer import Coalescer
+
+        pod = make_pod("p", f"u-{kind}-{fail}")
+        errors = {pod: ValueError("no instance type has enough resources")} if fail else {}
+        entry = self._Entry(
+            self._Request(self._Scheduler(self._Results(errors)), [pod], kind=kind)
+        )
+        Coalescer().execute([entry])
+        assert entry.error is None
+        return pod
+
+    def test_solve_kind_commits_failed_pods(self, clean_ledger):
+        clean_ledger.configure(mode="on")
+        pod = self._execute("solve", fail=True)
+        entry = clean_ledger.entry(pod.metadata.uid)
+        assert entry["stages"] == ["resources"]
+
+    def test_simulate_kind_never_commits(self, clean_ledger):
+        clean_ledger.configure(mode="on")
+        self._execute("simulate", fail=True)
+        assert clean_ledger.snapshot()["ring_depth"] == 0
+
+    def test_explain_off_skips_span_metering(self, clean_ledger, monkeypatch):
+        calls = []
+        orig = explmod.ExplainRecorder.counters
+
+        def counting(self):
+            calls.append(1)
+            return orig(self)
+
+        monkeypatch.setattr(explmod.ExplainRecorder, "counters", counting)
+        from karpenter_tpu import tracing
+
+        tracing.configure(sample_rate=1.0)
+        try:
+            self._execute("solve", fail=False)
+            assert not calls, "explain off must not meter the solve span"
+            clean_ledger.configure(mode="on")
+            self._execute("solve", fail=False)
+            assert calls, "explain on must meter the sampled solve span"
+        finally:
+            tracing.configure(sample_rate=1.0)
+
+
+class TestOperatorExplain:
+    """/debug/explain end to end through a real Operator: triage, ?pod=
+    drill-down naming the exact eliminating stage, and the what-if probe
+    re-solving a relaxed copy through the solverd coalescer."""
+
+    def _operator(self):
+        from karpenter_tpu.cloudprovider.kwok.provider import KwokCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+
+        clock = FakeClock()
+        store = Store(clock=clock)
+        op = Operator(
+            store,
+            KwokCloudProvider(store, clock),
+            clock=clock,
+            options=Options(explain="on"),
+        )
+        return clock, store, op
+
+    def test_snapshot_names_exact_stage_and_probe_flips_it(self, clean_ledger):
+        clock, store, op = self._operator()
+        store.create(nodepool("workers"))
+        # deliberately unsatisfiable: no kwok offering serves this zone
+        store.create(
+            unschedulable_pod(
+                name="lost-zone",
+                node_selector={"topology.kubernetes.io/zone": "kwok-zone-9"},
+            )
+        )
+        for _ in range(3):
+            clock.step(2.0)
+            op.run_once()
+        snap = op.explain_snapshot()
+        assert snap["mode"] == "on" and snap["ring_depth"] >= 1
+        drill = op.explain_snapshot(pod="lost-zone")
+        assert drill["pod"] == "lost-zone"
+        assert drill["stages"], "the eliminating stage must be named"
+        assert set(drill["stages"]) <= {"requirements", "offerings"}
+        assert drill["funnel"] and drill["funnel"][0]["nodepool"] == "workers"
+        # the counterfactual: dropping the zone pin makes it schedulable
+        probed = op.explain_snapshot(
+            pod="lost-zone", what_if="drop:topology.kubernetes.io/zone"
+        )
+        assert probed["what_if"]["drop"] == "topology.kubernetes.io/zone"
+        assert probed["what_if"]["schedulable"] is True
+        assert probed["what_if"]["placement"]
+        # probing never committed a simulate entry for the relaxed twin
+        assert op.explain_snapshot()["ring_depth"] == snap["ring_depth"]
+
+    def test_probe_on_irrelevant_key_is_a_no_op_answer(self, clean_ledger):
+        clock, store, op = self._operator()
+        store.create(nodepool("workers"))
+        store.create(
+            unschedulable_pod(
+                name="lost-zone",
+                node_selector={"topology.kubernetes.io/zone": "kwok-zone-9"},
+            )
+        )
+        for _ in range(3):
+            clock.step(2.0)
+            op.run_once()
+        probed = op.explain_snapshot(pod="lost-zone", what_if="drop:not.a/key")
+        assert "no requirement" in probed["what_if"]["error"]
+
+    def test_unknown_pod_404s(self, clean_ledger):
+        clock, store, op = self._operator()
+        assert op.explain_snapshot(pod="never-seen") is None
+
+    def test_disabled_ledger_404s(self, clean_ledger):
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.runtime.store import Store
+
+        clock = FakeClock()
+        op = Operator(Store(clock=clock), FakeCloudProvider(), clock=clock)
+        assert op.explain_snapshot() is None

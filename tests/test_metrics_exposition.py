@@ -273,6 +273,119 @@ class TestRoundTrip:
         ]
         assert in_99 - in_90 >= 1.0
 
+    def test_explain_families_round_trip(self):
+        """Provenance-ledger conformance (ISSUE 19): the karpenter_explain_*
+        families on the REAL global registry — the per-stage elimination
+        counter (including dynamic fused:<reason> stage values, whose
+        colons must survive the quote round trip), the commit counter by
+        mode, the ring-depth gauge, the probe-outcome counter, and the
+        funnel-stage histogram's _bucket/+Inf/_sum/_count."""
+        from karpenter_tpu.metrics import global_registry
+        from karpenter_tpu.observability import explain as explmod
+
+        rec = explmod.recorder()
+        prior_mode = rec.mode
+        rec.configure(mode="on")
+        try:
+            # the registry is process-global and other suites feed these
+            # families too — every assertion below is a delta or floor,
+            # never an absence, so ordering can't break it
+            def sample(key, labels):
+                fam0 = parse_exposition(global_registry.expose())
+                family = fam0.get("karpenter_explain_eliminations_total")
+                if family is None:
+                    return 0.0
+                return family["samples"].get((key, labels), 0.0)
+
+            resources0 = sample(
+                "karpenter_explain_eliminations_total",
+                (("stage", "resources"),),
+            )
+            rec.note_plane_counts({"requirements": 3, "resources": 0})
+            rec.note_fused_decline("reserved-offerings")
+            rec.note_probe("schedulable")
+            pod_uid = "expo-explain-uid"
+
+            class _Meta:
+                name = "expo-pod"
+                namespace = "default"
+                uid = pod_uid
+
+            class _Pod:
+                metadata = _Meta()
+
+            pod = _Pod()
+            rec.note_funnel(
+                pod_uid,
+                [{"nodepool": "workers", "stages": ["limits"], "error": "e"}],
+            )
+            rec.commit_solve([pod], {pod: ValueError("exceed limits for nodepool")})
+            fam = parse_exposition(global_registry.expose())
+
+            elims = fam["karpenter_explain_eliminations_total"]
+            assert elims["type"] == "counter"
+            assert elims["samples"][
+                (
+                    "karpenter_explain_eliminations_total",
+                    (("stage", "requirements"),),
+                )
+            ] >= 3.0
+            # a zero-count stage never increments its sample
+            assert (
+                elims["samples"].get(
+                    (
+                        "karpenter_explain_eliminations_total",
+                        (("stage", "resources"),),
+                    ),
+                    0.0,
+                )
+                == resources0
+            )
+            # the dynamic fused stage (colon in the label value) round-trips
+            assert elims["samples"][
+                (
+                    "karpenter_explain_eliminations_total",
+                    (("stage", "fused:reserved-offerings"),),
+                )
+            ] >= 1.0
+
+            commits = fam["karpenter_explain_pods_total"]
+            assert commits["type"] == "counter"
+            assert commits["samples"][
+                ("karpenter_explain_pods_total", (("mode", "on"),))
+            ] >= 1.0
+
+            depth = fam["karpenter_explain_ring_depth"]
+            assert depth["type"] == "gauge"
+            assert depth["samples"][
+                ("karpenter_explain_ring_depth", ())
+            ] >= 1.0
+
+            probes = fam["karpenter_explain_probes_total"]
+            assert probes["samples"][
+                ("karpenter_explain_probes_total", (("outcome", "schedulable"),))
+            ] >= 1.0
+
+            funnel = fam["karpenter_explain_funnel_stages"]
+            assert funnel["type"] == "histogram"
+            inf = funnel["samples"][
+                ("karpenter_explain_funnel_stages_bucket", (("le", "+Inf"),))
+            ]
+            count = funnel["samples"][
+                ("karpenter_explain_funnel_stages_count", ())
+            ]
+            assert inf == count >= 1.0
+            # the single-stage commit lands in the le=1 bucket
+            assert funnel["samples"][
+                ("karpenter_explain_funnel_stages_bucket", (("le", "1"),))
+            ] >= 1.0
+            assert funnel["samples"][
+                ("karpenter_explain_funnel_stages_sum", ())
+            ] >= 1.0
+        finally:
+            rec.configure(mode=prior_mode or "off")
+            rec.reset()
+
     def test_every_emitted_line_is_parseable(self):
         """Feed the REAL global registry (whatever tests before us
         registered) through the parser: conformance must hold for the

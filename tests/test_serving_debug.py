@@ -12,7 +12,7 @@ from karpenter_tpu.operator.serving import Server, ServingConfig
 
 def make_server(
     enable_profiling=False, solverd_stats=None, heap_stats=None,
-    kernel_snapshot=None, device_profile=None,
+    kernel_snapshot=None, device_profile=None, explain_snapshot=None,
 ):
     cfg = ServingConfig(
         metrics_text=lambda: "karpenter_test_metric 1\n",
@@ -23,6 +23,7 @@ def make_server(
         heap_stats=heap_stats,
         kernel_snapshot=kernel_snapshot,
         device_profile=device_profile,
+        explain_snapshot=explain_snapshot,
     )
     return Server(0, cfg, host="127.0.0.1").start()
 
@@ -418,6 +419,113 @@ class TestDeviceProfileEndpoint:
             server.stop()
             eff.profiler().configure(profile_dir="")
             eff.profiler().reset()
+
+
+class TestExplainEndpoint:
+    """/debug/explain: the triage table, ?pod= drill-down, the what-if
+    validation (400), disabled/unknown (404), and unwired (404)."""
+
+    def _snapshot(self, pod=None, what_if=None):
+        if pod is None:
+            return {"mode": "on", "ring_depth": 1, "pods": [{"pod": "web-0"}]}
+        if pod != "web-0":
+            return None
+        out = {"pod": "web-0", "stages": ["resources"], "funnel": []}
+        if what_if:
+            out["what_if"] = {"drop": what_if.split(":", 1)[1], "schedulable": True}
+        return out
+
+    def test_triage_and_drilldown(self):
+        server = make_server(explain_snapshot=self._snapshot)
+        try:
+            code, body = get(server, "/debug/explain")
+            assert code == 200
+            table = json.loads(body)
+            assert table["mode"] == "on" and table["pods"][0]["pod"] == "web-0"
+            code, body = get(server, "/debug/explain?pod=web-0")
+            assert code == 200
+            assert json.loads(body)["stages"] == ["resources"]
+        finally:
+            server.stop()
+
+    def test_what_if_served(self):
+        server = make_server(explain_snapshot=self._snapshot)
+        try:
+            code, body = get(
+                server, "/debug/explain?pod=web-0&what_if=drop:kubernetes.io/arch"
+            )
+            assert code == 200
+            probe = json.loads(body)["what_if"]
+            assert probe["drop"] == "kubernetes.io/arch"
+            assert probe["schedulable"] is True
+        finally:
+            server.stop()
+
+    def test_malformed_what_if_400(self):
+        server = make_server(explain_snapshot=self._snapshot)
+        try:
+            for q in (
+                "what_if=drop:zone",  # no pod
+                "pod=web-0&what_if=add:zone",  # not drop:
+                "pod=web-0&what_if=drop:",  # empty key
+            ):
+                code, body = get(server, f"/debug/explain?{q}")
+                assert code == 400, q
+                assert "what_if" in body
+        finally:
+            server.stop()
+
+    def test_unknown_pod_404(self):
+        server = make_server(explain_snapshot=self._snapshot)
+        try:
+            code, body = get(server, "/debug/explain?pod=missing")
+            assert code == 404
+            assert "unknown pod" in body
+        finally:
+            server.stop()
+
+    def test_disabled_ledger_404(self):
+        server = make_server(explain_snapshot=lambda pod=None, what_if=None: None)
+        try:
+            code, body = get(server, "/debug/explain")
+            assert code == 404
+            assert "disabled" in body
+        finally:
+            server.stop()
+
+    def test_unwired_404(self, plain_server):
+        code, body = get(plain_server, "/debug/explain")
+        assert code == 404
+        assert "not found" in body
+
+    def test_from_operator(self):
+        """End-to-end over real HTTP: the operator's explain_snapshot
+        callable serves the live ledger (404 while disabled, the triage
+        table once a capture is configured and committed)."""
+        from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_tpu.observability import explain as explmod
+        from karpenter_tpu.operator.operator import Operator
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.runtime.store import Store
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        operator = Operator(
+            Store(clock=clock), FakeCloudProvider(), clock=clock,
+            options=Options(explain="on"),
+        )
+        server = make_server(explain_snapshot=operator.explain_snapshot)
+        try:
+            code, body = get(server, "/debug/explain")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["mode"] == "on" and snap["ring_depth"] == 0
+            code, _ = get(server, "/debug/explain?pod=never-committed")
+            assert code == 404
+        finally:
+            server.stop()
+            explmod.configure(mode="off")
+            explmod.recorder().reset()
 
 
 class TestSolverdEndpoint:
