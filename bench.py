@@ -216,6 +216,260 @@ def fused_bench(one_pass_with, engine, runs: int = 2) -> dict:
     return out
 
 
+DELTA_STEADY_TARGET_MS = 320.0
+
+
+def delta_churn_bench(
+    build_engine, solve_with, scales=(1500, 7500), churn=24, churn_passes=12
+) -> dict:
+    """BENCH_r09 (incremental delta solves): sustained shape-stable churn
+    against device-resident solver state. Per cluster scale: one cold pass
+    seeds the scan residency + encode cache, then `churn_passes` suffix
+    batches of `churn` uniform pods warm-resume the fused scan (self-check
+    cadence 5 re-solves from scratch and asserts decision identity inside
+    the solver). Floors asserted here, not eyeballed:
+
+    - every churn pass warm-resumes (exactly one residency miss per scale,
+      the cold seed);
+    - steady churn passes re-encode ZERO bytes at BOTH scales;
+    - the encode probe (the packer/group encode path, where the cross-pass
+      EncodeCache lives) re-encodes byte-identical totals for identical
+      shape-churn at 5x the pod count, and zero bytes when the same shape
+      contents are rebuilt as fresh objects — bytes scale with churn,
+      O(shapes), not cluster, O(pods);
+    - no self-check diverges;
+    - donated warm dispatches leave the live-array gauge FLAT across
+      identical re-solves and the residency byte gauge constant across the
+      whole churn run (zero loop-state copy growth).
+
+    Wall numbers are reported honestly for this host: the warm steady pass
+    is budgeted (<= DELTA_STEADY_TARGET_MS, structural-regression guard),
+    and host_stall_fraction + the zero-byte re-encode column locate the
+    remaining steady wall in the per-pass host Topology/Scheduler rebuild
+    — the part an accelerator-resident deployment amortizes differently —
+    not in encode or device state reload."""
+    import gc
+    import statistics
+
+    from karpenter_tpu.aot import compiler as aotc
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.core import Condition, Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.observability import kernels as kobs
+    from karpenter_tpu.ops import delta as delta_mod
+    from karpenter_tpu.ops import fused as fused_mod
+    from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    def uniform_pods(n: int, start: int, tag: str) -> list:
+        # one workload shape for base AND churn: warm scan resume requires
+        # requirement-stable churn (the host queue sorts cpu desc, mem
+        # desc, timestamp, uid — identical shapes with monotone timestamps
+        # and uids extend the previous stream as an exact suffix)
+        requests = parse_resource_list({"cpu": "1", "memory": "2Gi"})
+        out = []
+        for i in range(start, start + n):
+            pod = Pod(
+                metadata=ObjectMeta(
+                    name=f"churn-{tag}-{i:06d}", uid=f"churn-{tag}-{i:06d}"
+                ),
+                spec=PodSpec(
+                    node_selector={wk.LABEL_ARCH: "amd64"},
+                    containers=[Container(requests=dict(requests))],
+                ),
+            )
+            pod.metadata.creation_timestamp = float(i)
+            pod.status.conditions.append(
+                Condition(type="PodScheduled", status="False", reason="Unschedulable")
+            )
+            out.append(pod)
+        return out
+
+    def encode_probe() -> dict:
+        # isolates the encode layer: k novel shapes cycled over n pods on a
+        # FRESH engine + cache — bytes re-encoded must depend on k (shape
+        # churn), never on n (cluster scale)
+        from karpenter_tpu.ops import packer as packer_mod
+
+        zones = [f"kwok-zone-{z}" for z in range(1, 5)]
+        shape_specs = [("arch-zone", a, z) for a in ("amd64", "arm64") for z in zones]
+        shape_specs += [("spot-zone", "amd64", z) for z in zones]
+
+        def make_shape(k: int) -> Requirements:
+            kind, arch, zone = shape_specs[k % len(shape_specs)]
+            reqs = [
+                Requirement(wk.LABEL_ARCH, Operator.IN, [arch]),
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [zone]),
+            ]
+            if kind == "spot-zone":
+                reqs.append(
+                    Requirement(
+                        wk.CAPACITY_TYPE_LABEL_KEY,
+                        Operator.IN,
+                        [wk.CAPACITY_TYPE_SPOT],
+                    )
+                )
+            return Requirements(*reqs)
+
+        out = {"shapes": len(shape_specs)}
+        for label, n in (("small", scales[0]), ("big", scales[-1])):
+            probe_engine = build_engine()
+            cache = delta_mod.EncodeCache()
+            reqs_list = [make_shape(i) for i in range(n)]  # fresh objects
+            requests = np.ones((n, len(probe_engine.resource_dims)))
+            b0 = delta_mod.delta_counters()["delta_bytes_reencoded"]
+            packer_mod.encode_pods_for_packer(
+                probe_engine, reqs_list, requests, cache=cache
+            )
+            out[f"bytes_{label}"] = (
+                delta_mod.delta_counters()["delta_bytes_reencoded"] - b0
+            )
+            out[f"pods_{label}"] = n
+            # the watch-churn case: the SAME shape contents rebuilt as brand
+            # new objects (fresh Requirements every reconcile) must content-
+            # hit and re-encode nothing on the next pass
+            rebuilt = [make_shape(i) for i in range(n)]
+            b1 = delta_mod.delta_counters()["delta_bytes_reencoded"]
+            packer_mod.encode_pods_for_packer(
+                probe_engine, rebuilt, requests, cache=cache
+            )
+            out[f"bytes_{label}_rebuilt"] = (
+                delta_mod.delta_counters()["delta_bytes_reencoded"] - b1
+            )
+        assert out["bytes_small"] == out["bytes_big"] > 0, (
+            f"encode probe bytes must track shape churn, not cluster scale: {out}"
+        )
+        assert out["bytes_small_rebuilt"] == out["bytes_big_rebuilt"] == 0, (
+            f"rebuilt same-content shapes re-encoded bytes: {out}"
+        )
+        return out
+
+    old_mode = delta_mod.DELTA_MODE
+    old_every = delta_mod.RESOLVE_FULL_EVERY
+    old_fused = fused_mod.FUSED_MODE
+    delta_mod.invalidate_all("bench-delta-leg")
+    delta_mod.configure(mode="on", resolve_full_every=5)
+    fused_mod.FUSED_MODE = "on"
+    out = {"churn_per_pass": churn, "churn_passes": churn_passes, "scales": {}}
+    try:
+        engine = build_engine()
+        aotc.warm_start(engine)
+        pods = None
+        for scale in scales:
+            # drop the previous scale's residency: the scan state is
+            # catalog-dimensioned and the pod stream is chunked, so every
+            # scale lands on the SAME shape rung — without this reset the
+            # bigger cluster would (soundly, self-checked) warm-extend the
+            # smaller one's state and the cold-seed contrast would vanish
+            delta_mod.invalidate_all(f"bench-delta-scale-{scale}")
+            tag = f"s{scale}"
+            pods = uniform_pods(scale, 0, tag)
+            s0 = delta_mod.delta_counters()
+            gc.collect()
+            t0 = time.perf_counter()
+            solve_with(engine, pods)  # cold: seeds residency + encode cache
+            cold_ms = (time.perf_counter() - t0) * 1000.0
+            cold_bytes = (
+                delta_mod.delta_counters()["delta_bytes_reencoded"]
+                - s0["delta_bytes_reencoded"]
+            )
+            series, bytes_series, resident = [], [], set()
+            for p in range(churn_passes):
+                pods = pods + uniform_pods(churn, scale + p * churn, tag)
+                b0 = delta_mod.delta_counters()
+                gc.collect()
+                gc.disable()
+                try:
+                    t0 = time.perf_counter()
+                    solve_with(engine, pods)
+                    series.append((time.perf_counter() - t0) * 1000.0)
+                finally:
+                    gc.enable()
+                b1 = delta_mod.delta_counters()
+                bytes_series.append(
+                    b1["delta_bytes_reencoded"] - b0["delta_bytes_reencoded"]
+                )
+                resident.add(delta_mod.debug_view()["resident_bytes"])
+            s1 = delta_mod.delta_counters()
+            stats = {
+                "cluster_pods": scale,
+                "cold_ms": round(cold_ms, 2),
+                "cold_bytes_reencoded": cold_bytes,
+                "steady_p50_ms": round(statistics.median(series), 2),
+                "steady_ms_series": [round(v, 2) for v in series],
+                "bytes_reencoded_per_pass": bytes_series,
+                "scan_warm": s1["delta_scan_warm"] - s0["delta_scan_warm"],
+                "scan_miss": s1["delta_scan_miss"] - s0["delta_scan_miss"],
+                "selfchecks_identical": (
+                    s1["delta_selfchecks_identical"]
+                    - s0["delta_selfchecks_identical"]
+                ),
+                "selfchecks_divergent": (
+                    s1["delta_selfchecks_divergent"]
+                    - s0["delta_selfchecks_divergent"]
+                ),
+                "resident_bytes": sorted(resident),
+            }
+            out["scales"][str(scale)] = stats
+            assert stats["scan_miss"] == 1, (
+                f"@{scale}: expected exactly the cold seed to miss, got {stats}"
+            )
+            assert stats["scan_warm"] >= churn_passes, (
+                f"@{scale}: churn passes did not warm-resume: {stats}"
+            )
+            assert stats["selfchecks_identical"] >= 1, (
+                f"@{scale}: self-check cadence never fired: {stats}"
+            )
+            assert stats["selfchecks_divergent"] == 0, (
+                f"@{scale}: warm decisions diverged from from-scratch: {stats}"
+            )
+            # the FFD solve encodes through the engine's interned rows (the
+            # EncodeCache layer belongs to the packer/group encode, probed
+            # below) — shape-stable churn must meter zero bytes here at
+            # every scale either way
+            assert all(b == 0 for b in bytes_series), (
+                f"@{scale}: shape-stable churn re-encoded bytes: {bytes_series}"
+            )
+            assert len(resident) == 1, (
+                f"@{scale}: resident state bytes drifted across warm passes: "
+                f"{sorted(resident)}"
+            )
+            assert stats["steady_p50_ms"] <= DELTA_STEADY_TARGET_MS, (
+                f"@{scale}: steady warm pass {stats['steady_p50_ms']}ms exceeds "
+                f"the {DELTA_STEADY_TARGET_MS}ms single-chip budget"
+            )
+        # donated-dispatch gauge: identical warm re-solves must leave the
+        # process's live device arrays byte-flat (loop state is REPLACED in
+        # place via donation, never accumulated). Self-checks off so every
+        # gauge pass executes the identical warm-resume allocation pattern.
+        delta_mod.configure(resolve_full_every=0)
+        solve_with(engine, pods)  # settle caches for the repeat-solve shape
+        samples = []
+        for _ in range(3):
+            gc.collect()
+            solve_with(engine, pods)
+            gc.collect()
+            samples.append(kobs.sample_device_memory()["live_array_bytes"])
+        delta_mod.configure(resolve_full_every=5)
+        out["memory_gauge"] = {
+            "live_array_bytes_samples": samples,
+            "growth_bytes": max(samples) - min(samples),
+        }
+        assert out["memory_gauge"]["growth_bytes"] == 0, (
+            f"warm re-solves grew live device memory: {samples}"
+        )
+        # host-stall attribution for one more warm churn pass (the steady
+        # shape): where the remaining steady wall actually lives
+        probe_pods = pods + uniform_pods(churn, scales[-1] + churn_passes * churn, "probe")
+        out["efficiency"] = efficiency_probe(lambda: solve_with(engine, probe_pods))
+        out["encode_probe"] = encode_probe()
+        out["counters"] = delta_mod.delta_counters()
+    finally:
+        fused_mod.FUSED_MODE = old_fused
+        delta_mod.configure(mode=old_mode, resolve_full_every=old_every)
+        delta_mod.invalidate_all("bench-delta-leg")
+    return out
+
+
 def eight_pool_bench(engine, catalog, pods, runs: int = 5, probe_sink=None) -> float:
     """BASELINE.md's top config shape: 50k pods against 8 WEIGHTED NodePools
     with distinct requirements, limits, and catalog shards — the weighted-
@@ -1520,6 +1774,23 @@ def main() -> None:
         assert efficiency["aot_fused_8k"]["utilization"], (
             "no utilization rows joined cost tables with measured walls"
         )
+
+        # BENCH_r09 — incremental delta solves under sustained churn (runs
+        # inside the AOT block so the scan rungs warm-start from the
+        # executable cache; the leg flips fused+delta modes itself and
+        # restores + invalidates on exit)
+        def solve_pods_with(engine_, pods_):
+            state_nodes = cluster.state_nodes()
+            topology = Topology(
+                store, cluster, state_nodes, node_pools, instance_types, pods_
+            )
+            scheduler = Scheduler(
+                store, node_pools, cluster, state_nodes, topology,
+                instance_types, [], recorder, clock, engine=engine_,
+            )
+            return scheduler.solve(pods_)
+
+        delta = delta_churn_bench(build_engine, solve_pods_with)
     finally:
         aotrt.configure(None, None)
         aotrt.clear_executables()
@@ -1605,7 +1876,15 @@ def main() -> None:
                     f"(device-busy {efficiency['p50_50k']['device_busy_s']*1000:.0f}ms "
                     f"of {efficiency['p50_50k']['wall_s']*1000:.0f}ms wall — "
                     f"the FFD scan is a host-paced conversation, the ROADMAP "
-                    f"item 2 claim now measured per batch)"
+                    f"item 2 claim now measured per batch); incremental "
+                    f"delta solves under sustained churn: steady warm pass "
+                    f"{delta['scales'][str(max(int(k) for k in delta['scales']))]['steady_p50_ms']:.1f}ms "
+                    f"p50 @{max(int(k) for k in delta['scales'])} pods "
+                    f"(every churn pass warm-resumed, 0 bytes re-encoded "
+                    f"per steady pass at BOTH cluster scales, self-checks "
+                    f"identical, live-array gauge flat across donated warm "
+                    f"dispatches; encode probe re-encodes identical bytes "
+                    f"for identical shape churn at 5x pods — all asserted)"
                 ),
                 "value": round(p50, 2),
                 "unit": "ms",
@@ -1626,6 +1905,13 @@ def main() -> None:
                 # wall-clock wins require an RTT-bound accelerator, so on
                 # CPU the unfused native walk stays the default (auto mode)
                 "fused": fused,
+                # incremental delta solves (ISSUE 20, BENCH_r09): sustained
+                # shape-stable churn against device-resident solver state —
+                # warm-resume counts, per-pass re-encode bytes (zero at
+                # both scales), self-check identity, donated-dispatch
+                # memory-gauge flatness, and the encode probe's O(churn)-
+                # not-O(cluster) byte floor, all asserted in the leg
+                "delta": delta,
                 # per-leg efficiency columns (ISSUE 15): host-stall
                 # attribution per leg (one instrumented probe pass each —
                 # device_busy vs wall; 1.0 would mean fully host-paced)

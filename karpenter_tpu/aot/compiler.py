@@ -77,14 +77,21 @@ def _toolchain_fingerprint() -> str:
 
 def cache_key(
     catalog_hash: str, kernel: str, sig: str, ladder_version: int,
-    scope: str = "",
+    scope: str = "", donation: str = "",
 ) -> str:
     """`scope` folds the device layout of a sharded executable into its
     identity (ops/feasibility.mesh_scope) — sharded global shapes are
     mesh-size-invariant by design, so without the scope an executable
     compiled for an 8-way mesh could load into a 1-device process. An
     empty scope (every unsharded kernel) contributes NOTHING to the key,
-    so persistent caches filled by pre-mesh builds stay valid."""
+    so persistent caches filled by pre-mesh builds stay valid.
+
+    `donation` folds a kernel's buffer-donation signature into its
+    identity (packer.SCAN_RESUME_DONATE for the delta warm resume):
+    input-output aliasing is baked into the compiled executable, so a
+    cache entry serialized with donation must never load into a
+    non-donating call site or vice versa. Like scope, empty contributes
+    nothing — pre-delta caches stay valid."""
     fields = [
         catalog_hash,
         _toolchain_fingerprint(),
@@ -94,6 +101,8 @@ def cache_key(
     ]
     if scope:
         fields.append(scope)
+    if donation:
+        fields.append(donation)
     return hashlib.sha256("\n".join(fields).encode()).hexdigest()
 
 
@@ -294,6 +303,8 @@ def _solve_scan_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
     from karpenter_tpu.ops import fused as fused_mod
     from karpenter_tpu.ops import packer
 
+    from karpenter_tpu.ops import delta as delta_mod
+
     plans = []
     for bucket in ladder.buckets("packer.solve_scan"):
         if len(bucket) != 7:
@@ -303,6 +314,34 @@ def _solve_scan_plans(engine, ladder: ladder_mod.Ladder) -> list[tuple]:
         args = fused_mod.solve_scan_abstract_args(engine, bucket)
         plans.append(
             ("packer.solve_scan", _X64Lower(fn), args, _sig(args))
+        )
+        if not delta_mod.delta_enabled():
+            continue
+        # delta-solve twins of the rung: the cold scan that returns the
+        # full 23-component residency state, and the warm resume whose
+        # resident-state operands are donated. The donation signature is
+        # part of the resume executable's persistent identity (cache_key)
+        # — aliasing is compiled in, so a donating entry must never load
+        # into the non-donating kernels.
+        full = packer.solve_scan_full_fn(int(T), N > 0, L > 0)
+        plans.append(
+            ("packer.solve_scan_full", _X64Lower(full), args, _sig(args))
+        )
+        state = fused_mod.solve_scan_state_abstract_args(engine, bucket)
+        rargs = args + state + (_sds((), np.int32),)
+        resume = packer.solve_scan_resume_fn(int(T), N > 0, L > 0)
+        donation = "donate={}-{}".format(
+            packer.SCAN_RESUME_DONATE[0], packer.SCAN_RESUME_DONATE[-1]
+        )
+        plans.append(
+            (
+                "packer.solve_scan_resume",
+                _X64Lower(resume),
+                rargs,
+                _sig(rargs),
+                "",
+                donation,
+            )
         )
     return plans
 
@@ -355,6 +394,7 @@ def _ensure_executable(
 
     kernel, fn, abstract_args, sig = plan[:4]
     scope = plan[4] if len(plan) > 4 else ""
+    donation = plan[5] if len(plan) > 5 else ""
     summary["buckets"] += 1
     loaded = aotrt.lookup(kernel, sig, scope)
     if loaded is not None:
@@ -367,7 +407,10 @@ def _ensure_executable(
         return
     from jax.experimental import serialize_executable as se
 
-    key = cache_key(catalog_hash, kernel, sig, ladder.version, scope=scope)
+    key = cache_key(
+        catalog_hash, kernel, sig, ladder.version, scope=scope,
+        donation=donation,
+    )
     t0 = time.perf_counter()
     if cache is not None:
         body = cache.get(key)

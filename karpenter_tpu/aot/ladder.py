@@ -47,9 +47,11 @@ LADDER_KERNELS = {
     "feasibility.cube_sharded": 2,
     "packer.solve_block_sharded": 1,
     # the fused FFD scan: (pods, groups, claims, nodes, fams, templates,
-    # limited-pools). Its first dispatch arg is the pod axis alone, so
-    # from_observatory's first-shape heuristic skips it by arity — fused
-    # rungs are authored (here or in a ladder file), never derived.
+    # limited-pools). Its first dispatch arg is the pod axis alone, so the
+    # generic first-shape heuristic can't see the other six axes —
+    # from_observatory parses its full 27-segment signature instead
+    # (_scan_signature_dims), so observed scan telemetry derives trimmed
+    # rungs like every other laddered kernel.
     "packer.solve_scan": 7,
 }
 
@@ -207,6 +209,31 @@ def resolve(spec: str) -> Optional[Ladder]:
     return load(spec)
 
 
+def _scan_signature_dims(shape: str):
+    """Parse a fused-scan shape signature (27 comma-joined operand
+    segments, observability/kernels.shape_signature format) back into its
+    7 ladder axes (P, G, C, N, F, T, L), each rounded up to a power of
+    two. The variant selectors encode "absent" as 1x1 dummy operands
+    (fused.solve_scan_abstract_args), which map back to axis 0 — a rung
+    derived from a no-nodes dispatch stays a no-nodes rung."""
+    segs = shape.split(",")
+    if len(segs) < 27:
+        return None
+    try:
+        P = int(segs[0].split("x")[0])
+        C = int(segs[1].split("x")[0])
+        G = int(segs[2].split("x")[0])
+        T = int(segs[5].split("x")[0])
+        F = int(segs[10].split("x")[0])
+        n = [int(d) for d in segs[15].split("x")]
+        pool = [int(d) for d in segs[24].split("x")]
+    except ValueError:
+        return None
+    N = 0 if n == [1, 1] else n[0]
+    L = 0 if pool == [1, 1] else pool[0]
+    return tuple(_pow2(d) if d else 0 for d in (P, G, C, N, F, T, L))
+
+
 def from_observatory(counts_snapshot: dict, headroom: int = 1) -> Ladder:
     """Derive a ladder from observed shape-bucket telemetry — the
     drill-down loop /debug/kernels?view=ladder exists to feed. Each
@@ -223,6 +250,11 @@ def from_observatory(counts_snapshot: dict, headroom: int = 1) -> Ladder:
             # executables; only device dispatches shape the ladder
             if not (phases.get("warmup") or phases.get("steady")
                     or phases.get("aot-warm")):
+                continue
+            if name == "packer.solve_scan":
+                dims = _scan_signature_dims(shape)
+                if dims is not None:
+                    kernels[name].add(dims)
                 continue
             first = shape.split(",", 1)[0]
             try:

@@ -496,6 +496,9 @@ class Provisioner:
             self._warm_engine(engine)
         kobs.registry().on_recompile(self._on_kernel_recompiled, key="recorder")
         aotrt.on_off_ladder(self._on_off_ladder_dispatch, key="recorder")
+        from karpenter_tpu.ops import delta as delta_mod
+
+        delta_mod.on_divergence(self._on_delta_divergence, key="recorder")
 
     def _warm_engine(self, engine) -> Optional[dict]:
         """Warm one engine: the AOT compile service when a ladder is
@@ -547,6 +550,23 @@ class Provisioner:
                 f"bucket [{shape}] — the zero-recompile contract is "
                 "violated; check /debug/kernels for the bucket ladder",
                 dedupe_values=("kernel-recompile", kernel, shape),
+            )
+        )
+
+    def _on_delta_divergence(self, kernel: str, detail: str) -> None:
+        """A delta-solve self-check caught the warm result disagreeing with
+        the from-scratch re-solve (ops/delta.py): the residency was dropped
+        and the cold result won — correctness held, but the incremental
+        path has a soundness bug worth a bug report."""
+        self.recorder.publish(
+            Event(
+                None,
+                "Warning",
+                "DeltaSelfCheckDivergence",
+                f"incremental delta solve for {kernel} diverged from its "
+                f"from-scratch re-solve ({detail}); residency dropped, "
+                "full result used — see /debug/kernels?view=delta",
+                dedupe_values=("delta-divergence", kernel),
             )
         )
 

@@ -554,7 +554,8 @@ class KernelRegistry:
         the views — "ladder" (AOT ladder vs observed buckets), "cost"
         (cost-model tables joined with measured walls + utilization,
         ?kernel= drill-down), "timeline" (recent per-batch dispatch
-        timelines with host-stall attribution)."""
+        timelines with host-stall attribution), "delta" (incremental-solve
+        residencies: warm/miss counters, resident bytes, miss reasons)."""
         if view == "ladder":
             from karpenter_tpu.aot import runtime as aotrt
 
@@ -563,6 +564,10 @@ class KernelRegistry:
             from karpenter_tpu.observability import efficiency
 
             return efficiency.cost_view(kernel=kernel)
+        if view == "delta":
+            from karpenter_tpu.ops import delta
+
+            return delta.debug_view()
         if view == "timeline":
             with self._lock:
                 recent = [dict(b) for b in self._batches[-16:]]

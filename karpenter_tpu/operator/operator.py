@@ -108,6 +108,16 @@ class Operator:
             from karpenter_tpu.ops import fused as fused_mod
 
             fused_mod.FUSED_MODE = self.options.fused_solve
+        # incremental delta solves (ops/delta.py): only an EXPLICIT
+        # --delta-solve mutates the process-global mode (the fused_solve
+        # discipline); the self-check cadence rides along with it
+        if getattr(self.options, "delta_solve", ""):
+            from karpenter_tpu.ops import delta as delta_mod
+
+            delta_mod.configure(
+                mode=self.options.delta_solve,
+                resolve_full_every=self.options.resolve_full_every,
+            )
         # SLO engine + flight recorder (observability/slo.py, flight.py):
         # the process-global burn-rate evaluator follows this operator's
         # clock and objective set; the blackbox follows its clock and
@@ -468,6 +478,13 @@ class Operator:
         from karpenter_tpu.runtime.journal import IDEMPOTENCY_ANNOTATION
 
         stats = {"replayed": 0, "adoptions": 0, "orphans": 0, "rolled_back": 0}
+        # a crash restart resolves half-finished mutations out-of-band of
+        # the solve stream: any solver residency carried across the restart
+        # (engine factories outlive Operator rebuilds) describes the
+        # pre-crash world and must not seed a warm resume
+        from karpenter_tpu.ops import delta as delta_mod
+
+        delta_mod.invalidate_all("restart-recovery")
         pending = self.journal.pending()
         if not pending:
             self.journal.mark_recovered()

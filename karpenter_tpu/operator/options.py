@@ -96,6 +96,16 @@ class Options:
     # fuses every eligible batch, "auto" (default) fuses only on non-CPU
     # backends where dispatch round-trips dominate. env: KARPENTER_TPU_FUSED
     fused_solve: str = ""
+    # incremental delta solves (ops/delta.py): "off"/"" solves every pass
+    # from scratch (default), "on" keeps per-engine solver state resident
+    # on device between passes (encode row cache, group-solve residency,
+    # donated warm scan resumes). resolve_full_every is the self-check
+    # cadence: every Nth warm pass ALSO re-solves from scratch and asserts
+    # decision identity (divergence fires a typed event and drops the
+    # residency); 0 disables the check. env: KARPENTER_TPU_DELTA /
+    # KARPENTER_TPU_RESOLVE_FULL_EVERY
+    delta_solve: str = ""
+    resolve_full_every: int = 16
     # decision provenance ledger (observability/explain.py): "off"/"" no
     # capture (default — nothing on the solve path changes), "on" every
     # unschedulable pod commits an elimination ledger entry, "sampled" a
@@ -212,6 +222,18 @@ class Options:
             "non-CPU backends; env KARPENTER_TPU_FUSED)",
         )
         parser.add_argument(
+            "--delta-solve", choices=["off", "on"],
+            help="incremental delta solves (ops/delta.py): persistent "
+            "device-resident solver state with donated warm resumes "
+            "(default off; env KARPENTER_TPU_DELTA)",
+        )
+        parser.add_argument(
+            "--resolve-full-every", type=int,
+            help="self-check cadence for delta solves: every Nth warm "
+            "pass re-solves from scratch and asserts decision identity "
+            "(default 16; 0 disables; env KARPENTER_TPU_RESOLVE_FULL_EVERY)",
+        )
+        parser.add_argument(
             "--explain", choices=["off", "sampled", "on"],
             help="decision provenance ledger (observability/explain.py): "
             "per-pod elimination funnels served at /debug/explain "
@@ -251,6 +273,8 @@ class Options:
             "solverd_tenant_quota": "SOLVERD_TENANT_QUOTA",
             "solverd_tenant_weights": "SOLVERD_TENANT_WEIGHTS",
             "explain": "KARPENTER_TPU_EXPLAIN",
+            "delta_solve": "KARPENTER_TPU_DELTA",
+            "resolve_full_every": "KARPENTER_TPU_RESOLVE_FULL_EVERY",
             "compile_cache_dir": "COMPILE_CACHE_DIR",
             "aot_ladder": "AOT_LADDER",
             "slo_specs": "SLO_SPECS",

@@ -340,10 +340,26 @@ class CatalogEngine:
         )
         # FORCE_BACKEND="device" (the test/bench pin) must still reach the
         # device row kernel for small batches — only adaptive routing gates
-        # on the batch size
+        # on the batch size.
+        #
+        # With delta solves on AND warm device copies of the compat matrices
+        # resident, sub-DEVICE_MIN_ROW_BATCH batches also take the device
+        # kernel (padded to the same warm 32-rung executable) so the fresh
+        # rows can be APPENDED to the resident matrices below — routing tiny
+        # churn batches through the host twin would pop the device cache and
+        # force an O(cluster) re-upload on the next query.
+        from karpenter_tpu.ops import delta as delta_mod
+
+        delta_warm = (
+            delta_mod.delta_enabled()
+            and FORCE_BACKEND != "host"
+            and self.mesh is None
+            and "req_compat" in self._device_cache
+        )
         on_device = (
-            len(new_rows) >= DEVICE_MIN_ROW_BATCH or FORCE_BACKEND == "device"
-        ) and _use_device(host_cells, _HOST_ROW_CELLS_PER_S)
+            (len(new_rows) >= DEVICE_MIN_ROW_BATCH or FORCE_BACKEND == "device")
+            and _use_device(host_cells, _HOST_ROW_CELLS_PER_S)
+        ) or delta_warm
         cast = jnp.asarray if on_device else np.asarray
         if on_device:
             kernel = lambda *a: ktime.dispatch(  # noqa: E731 — dispatch shim
@@ -430,8 +446,28 @@ class CatalogEngine:
             ]
         )
         self._computed_rows = len(self._rows)
-        self._device_cache.pop("req_compat", None)
-        self._device_cache.pop("offer_compat", None)
+        if delta_warm and (
+            self._device_cache["req_compat"].shape[0] + len(new_rows)
+            != self._req_compat.shape[0]
+        ):
+            delta_warm = False  # resident copy out of step — full re-upload
+        if delta_warm:
+            # delta scatter path: ship ONLY the fresh rows and append them
+            # to the resident device matrices — O(churn) upload per pass
+            # instead of invalidating and re-uploading the whole catalog
+            self._device_cache["req_compat"] = jnp.concatenate(
+                [self._device_cache["req_compat"], jnp.asarray(new_inst)],
+                axis=0,
+            )
+            if "offer_compat" in self._device_cache:
+                self._device_cache["offer_compat"] = jnp.concatenate(
+                    [self._device_cache["offer_compat"], jnp.asarray(new_off)],
+                    axis=0,
+                )
+            delta_mod.note_rows("device_appended", len(new_rows))
+        else:
+            self._device_cache.pop("req_compat", None)
+            self._device_cache.pop("offer_compat", None)
 
     def _dev(self, name: str, host_array: np.ndarray) -> jnp.ndarray:
         """Device-resident copy of a catalog matrix, uploaded once per

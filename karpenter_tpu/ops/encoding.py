@@ -149,6 +149,30 @@ class EncodedReqs:
         return self.key.shape[0]
 
 
+def requirements_fingerprint(reqs: Requirements) -> bytes:
+    """Canonical content fingerprint of a requirement set: two sets with
+    the same semantics — same keys, complement flags, value sets, integer
+    bounds, min_values — hash identically regardless of object identity or
+    construction order. The incremental encode cache (ops/delta.py) keys
+    its cross-pass row cache on this, so churn that REBUILDS a workload's
+    Requirements every pass (watch events re-decode pod specs into fresh
+    objects) still reuses the interned rows instead of re-encoding."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for r in sorted(reqs, key=lambda r: r.key):
+        h.update(r.key.encode())
+        h.update(b"\x01" if r.complement else b"\x00")
+        for v in sorted(r.values):
+            h.update(b"\x1f")
+            h.update(v.encode())
+        h.update(b"\x1e")
+        h.update(str(getattr(r, "greater_than", None)).encode())
+        h.update(str(getattr(r, "less_than", None)).encode())
+        h.update(str(getattr(r, "min_values", None)).encode())
+    return h.digest()
+
+
 def encode_requirement_rows(
     vocab: Vocab, rows: Sequence[Requirement], word_capacity: Optional[int] = None
 ) -> EncodedReqs:

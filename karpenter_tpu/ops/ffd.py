@@ -133,6 +133,12 @@ def solver_cache_counters() -> dict:
     from karpenter_tpu.ops import fused as _fused
 
     out.update(_fused.fused_counters())
+    # incremental-solve residency accounting (ops/delta.py): warm/cold
+    # passes, bytes re-encoded, scan resume outcomes, self-check verdicts —
+    # snapshot-and-delta attributes one solve's delta behavior the same way
+    from karpenter_tpu.ops import delta as _delta
+
+    out.update(_delta.delta_counters())
     return out
 
 
@@ -234,7 +240,17 @@ def eligible(scheduler, pods: Sequence[Pod]) -> bool:
     if scheduler.engine is None:
         return False
     if len(pods) < DEVICE_MIN_PODS:
-        return False
+        # DEVICE_MIN_PODS is a dispatch-RTT heuristic, not a correctness
+        # gate. An operator that forced the fused path AND incremental
+        # delta solves has opted into device-resident state — tiny churn
+        # batches are exactly the traffic that mode exists for, and
+        # bouncing them to the host walk would both skip the warm
+        # scan-resume and force a host resync of the count tensors.
+        from karpenter_tpu.ops import delta as delta_mod
+        from karpenter_tpu.ops import fused as fused_mod
+
+        if not (delta_mod.delta_enabled() and fused_mod.FUSED_MODE == "on"):
+            return False
     if len(scheduler.existing_nodes) > DEVICE_MAX_EXISTING:
         return False
     # PreferNoSchedule pools extend the relax ladder with the wildcard

@@ -62,7 +62,7 @@ class GroupedPods:
     group_of_pod: np.ndarray  # [P] int32
 
 
-def _solve_block(
+def _solve_parts(
     group_bools,  # [G, R+K] bool — membership | key_present packed
     group_ints,  # [G, D+1] int32 — requests_q | counts packed
     req_compat,  # [R, I] bool
@@ -73,10 +73,10 @@ def _solve_block(
     alloc_q,  # [I, D] int32
     price,  # [I] float32 — cheapest available offering per type
 ):
-    """The fused per-shard solve: feasibility cube → cheapest-type argmin →
-    integer packing. Pure array math; runs under jit/shard_map. Group inputs
-    arrive packed (2 host->device transfers instead of 4 — the tunneled-TPU
-    round trip dominates at this problem size) and split on static shapes."""
+    """The count-INDEPENDENT solve math: feasibility cube → cheapest-type
+    argmin → pods-per-node. Shared verbatim by the full solve (`_solve_block`)
+    and the delta core (`_solve_block_core`), so the incremental path is
+    bit-identical by construction — same trace, different finalize."""
     R = req_compat.shape[0]
     D = alloc_q.shape[1]
     membership = group_bools[:, :R]
@@ -103,6 +103,12 @@ def _solve_block(
         jnp.iinfo(jnp.int32).max,
     )
     pods_per_node = jnp.maximum(jnp.min(per_dim, axis=-1), 0)  # [G]
+    return choice, feasible_any, pods_per_node, counts
+
+
+def _count_finalize(choice, feasible_any, pods_per_node, counts):
+    """Fold this pass's group counts over the count-independent core:
+    nodes via ceil division, unschedulable as the infeasible remainder."""
     nodes = jnp.where(
         feasible_any & (pods_per_node > 0),
         -(-counts // jnp.maximum(pods_per_node, 1)),  # ceil div
@@ -124,7 +130,82 @@ def _solve_block(
     )
 
 
+def _solve_block(
+    group_bools, group_ints, req_compat, offer_compat, custom_need,
+    available, owner_onehot, alloc_q, price,
+):
+    """The fused per-shard solve: feasibility cube → cheapest-type argmin →
+    integer packing. Pure array math; runs under jit/shard_map. Group inputs
+    arrive packed (2 host->device transfers instead of 4 — the tunneled-TPU
+    round trip dominates at this problem size) and split on static shapes."""
+    choice, feasible_any, pods_per_node, counts = _solve_parts(
+        group_bools, group_ints, req_compat, offer_compat, custom_need,
+        available, owner_onehot, alloc_q, price,
+    )
+    return _count_finalize(choice, feasible_any, pods_per_node, counts)
+
+
 solve_block_jit = jax.jit(_solve_block)
+
+
+# -- delta kernels: frontier core solve + donated scatter + finalize ----------
+#
+# The incremental group solve (ops/delta.py) keeps the count-INDEPENDENT
+# core results (choice, feasible, pods-per-node) device-resident keyed by
+# group content fingerprint. A churn pass solves only the perturbed frontier
+# through `_solve_block_core`, scatters the fresh rows into the resident
+# matrix with the RESIDENCY BUFFER DONATED (XLA writes in place — the
+# steady-state cost of holding the matrix is zero copies), then finalizes
+# nodes/unschedulable against this pass's counts.
+
+
+def _solve_block_core(
+    group_bools, group_ints, req_compat, offer_compat, custom_need,
+    available, owner_onehot, alloc_q, price,
+):
+    """[Gf, 3] int32 core rows (choice, feasible, pods-per-node) for the
+    perturbed frontier — `_solve_parts` verbatim, counts ignored."""
+    choice, feasible_any, pods_per_node, _ = _solve_parts(
+        group_bools, group_ints, req_compat, offer_compat, custom_need,
+        available, owner_onehot, alloc_q, price,
+    )
+    return jnp.stack(
+        [
+            choice.astype(jnp.int32),
+            feasible_any.astype(jnp.int32),
+            pods_per_node.astype(jnp.int32),
+        ],
+        axis=1,
+    )
+
+
+solve_block_core_jit = jax.jit(_solve_block_core)
+
+
+def _delta_scatter_rows(core, slots, rows):
+    """Scatter freshly-solved frontier rows into the resident core matrix.
+    `core` is DONATED — the update happens in place on device. Padding
+    entries duplicate the last slot with the same row values: same-value
+    scatter collisions are well-defined no-ops."""
+    return core.at[slots].set(rows)
+
+
+delta_scatter_rows = jax.jit(_delta_scatter_rows, donate_argnums=(0,))
+
+
+def _delta_finalize(core, order, counts):
+    """Gather this pass's group order from the resident core and fold in
+    its counts — the exact `_count_finalize` math, so a delta pass's packed
+    output is bit-identical to the full solve's. `core` is NOT donated (it
+    must survive for the next pass)."""
+    rows = core[order]
+    choice = rows[:, 0]
+    feasible_any = rows[:, 1].astype(bool)
+    pods_per_node = rows[:, 2]
+    return _count_finalize(choice, feasible_any, pods_per_node, counts)
+
+
+delta_finalize = jax.jit(_delta_finalize)
 
 # One jitted shard_map per (mesh, axis), shared by every GroupSolver on the
 # mesh AND by the AOT compiler's warm-start walk — the walk must pre-compile
@@ -248,9 +329,23 @@ class GroupSolver:
         timer so the solve span can split wall time into compile vs execute
         (tracing/kernel.py). With an AOT ladder attached to the engine, the
         group axis pads up to its bucket (zero rows: counts 0 → nodes 0,
-        sliced off) so the dispatch hits a warm-started executable."""
+        sliced off) so the dispatch hits a warm-started executable.
+
+        With delta solves on (--delta-solve / KARPENTER_TPU_DELTA), the
+        single-device path routes through the per-solver residency
+        (ops/delta.py): only the perturbed group frontier is re-solved and
+        scatter-applied into the device-resident core matrix."""
         if self.mesh is not None:
             return self.solve_sharded(grouped, self.mesh)
+        from karpenter_tpu.ops import delta as delta_mod
+
+        if delta_mod.delta_enabled():
+            return delta_mod.group_residency(self).solve(self, grouped)
+        return self._solve_full(grouped)
+
+    def _solve_full(self, grouped: GroupedPods):
+        """The from-scratch single-device solve — the delta path's seed,
+        fallback, and periodic self-check oracle."""
         args = self._catalog_args()
         group_bools, group_ints = _pack_groups(grouped)
         G = group_bools.shape[0]
@@ -396,10 +491,13 @@ def _scan_key(count, rank, ci):
 _SCAN_KEY_MAX = 1 << 62
 
 
-def _solve_scan_core(cfg: tuple, args: tuple):
-    """The while_loop program. `cfg` is the static trace config
-    (T, has_nodes, has_limits); `args` the array operands (see
-    fused.py's builder for the full layout contract)."""
+def _scan_program(cfg: tuple, args: tuple):
+    """The while_loop program as (cond, body) closures. `cfg` is the static
+    trace config (T, has_nodes, has_limits); `args` the array operands (see
+    fused.py's builder for the full layout contract). Factored out so the
+    classic solve, the full-state solve, and the donated warm resume all
+    trace the IDENTICAL loop — decision parity across variants is by
+    construction, not by test alone."""
     T, has_nodes, has_limits = cfg
     (
         pod_gi,      # [P] i32 — group per pod, host queue order (pad -1)
@@ -680,6 +778,22 @@ def _solve_scan_core(cfg: tuple, args: tuple):
         head, tail, stop, abort = st[0], st[1], st[2], st[3]
         return (head < tail) & (~stop) & (abort == SCAN_OK)
 
+    return cond, body
+
+
+def _scan_init(cfg: tuple, args: tuple):
+    """The cold-start loop state st0 — the 23-component tuple the body
+    carries. A completed zero-requeue pass's final state IS this init with
+    the prefix's work folded in, which is exactly why the resident state
+    can seed a warm resume bit-identically (ops/delta.py)."""
+    T, has_nodes, has_limits = cfg
+    pod_gi, claim_pad, g_req = args[0], args[1], args[2]
+    uniq_alloc, n_pods = args[4], args[13]
+    node_rem0, tmpl_mask, pool_rem0 = args[16], args[18], args[24]
+    P = pod_gi.shape[0]
+    G, D = g_req.shape
+    U = uniq_alloc.shape[0]
+    i32 = jnp.int32
     Qcap = 4 * P + 64
     C = claim_pad.shape[0]
     i32a = lambda n, v=0: jnp.full((n,), v, dtype=i32)  # noqa: E731
@@ -687,7 +801,7 @@ def _solve_scan_core(cfg: tuple, args: tuple):
     init_queue = jnp.concatenate(
         [jnp.arange(P, dtype=i32), i32a(Qcap - P, 0)]
     )
-    st0 = (
+    return (
         i32(0), n_pods.astype(i32), jnp.bool_(False), i32(SCAN_OK),
         i32(0), i32(0), i32(0),
         init_queue, i32a(P, -1), i32a(P, -1), i32a(P, -1), i32a(P, -1),
@@ -699,23 +813,77 @@ def _solve_scan_core(cfg: tuple, args: tuple):
         jnp.zeros((C, I), dtype=bool),
         pool_rem0 if has_limits else jnp.zeros((1, D)),
     )
-    out = lax.while_loop(cond, body, st0)
-    (
-        head, tail, stop, abort, seqc, done, nclaims,
-        queue, last_len, pod_claim, pod_node, pod_seq,
-        claim_ti, claim_fam, claim_count, claim_key,
-        u_valid, rem, cfit, nptr, node_rem, tm_st, pool_rem,
-    ) = out
-    return (
-        abort, nclaims, pod_claim, pod_node, pod_seq,
-        claim_ti, claim_fam, u_valid, tm_st, pool_rem,
+
+
+# final-state indices the classic 10-output solve exposes
+_SCAN_OUT_IDX = (3, 6, 9, 10, 11, 12, 13, 16, 21, 22)
+
+
+def _scan_finals(out: tuple):
+    """(abort, nclaims, pod_claim, pod_node, pod_seq, claim_ti, claim_fam,
+    u_valid, tm_st, pool_rem) — the decode subset of the full state."""
+    return tuple(out[i] for i in _SCAN_OUT_IDX)
+
+
+def _solve_scan_core(cfg: tuple, args: tuple):
+    cond, body = _scan_program(cfg, args)
+    return _scan_finals(lax.while_loop(cond, body, _scan_init(cfg, args)))
+
+
+def _solve_scan_full_core(cfg: tuple, args: tuple):
+    """Cold solve that returns the FULL 23-component final state — the
+    residency seed for incremental delta solves (ops/delta.py)."""
+    cond, body = _scan_program(cfg, args)
+    return lax.while_loop(cond, body, _scan_init(cfg, args))
+
+
+def _solve_scan_resume_core(cfg: tuple, args: tuple, st: tuple, p_lo):
+    """Warm resume: continue the scan from a resident final state with the
+    suffix pods [p_lo, n_pods) enqueued. Sound ONLY under the residency
+    eligibility contract (ops/delta.py): byte-identical verdict operands, a
+    pod stream extending the previous order as an exact prefix, and a
+    previous pass that drained with zero requeues — then the resident state
+    equals the cold scan's mid-state after the prefix, and resuming is
+    bit-identical to a cold solve of the full list. The 23 state operands
+    are DONATED (solve_scan_resume_fn): XLA reuses the resident buffers for
+    the loop carry instead of copying them — zero loop-state copy growth."""
+    cond, body = _scan_program(cfg, args)
+    n_pods = args[13]
+    (head, tail), rest = st[:2], st[2:]
+    queue = st[7]
+    i32 = jnp.int32
+    Qcap = queue.shape[0]
+    # Enqueue the suffix inside the kernel (one scalar operand, no
+    # unbounded-shape patch kernel): positions [tail, tail+nsuf) take pod
+    # ids p_lo+k. tail + nsuf <= P < Qcap, so clipped out-of-range lanes
+    # only rewrite their own current values — well-defined no-ops.
+    k = jnp.arange(Qcap, dtype=i32)
+    nsuf = jnp.maximum(n_pods.astype(i32) - p_lo.astype(i32), 0)
+    idx = jnp.clip(tail + k, 0, Qcap - 1)
+    queue = queue.at[idx].set(
+        jnp.where(k < nsuf, p_lo.astype(i32) + k, queue[idx])
     )
+    st2 = (head, tail + nsuf) + (rest[0], rest[1], rest[2], rest[3], rest[4],
+                                 queue) + rest[6:]
+    return lax.while_loop(cond, body, st2)
 
 
 # One jitted scan per static trace config (template count, node/limits
 # variants) — shared across engines and with the AOT warm-start walk.
 _SOLVE_SCAN_FNS: dict[tuple, object] = {}
+_SOLVE_SCAN_FULL_FNS: dict[tuple, object] = {}
+_SOLVE_SCAN_RESUME_FNS: dict[tuple, object] = {}
 _SHARDED_SCAN_FNS: dict[tuple, object] = {}
+_SHARDED_SCAN_FULL_FNS: dict[tuple, object] = {}
+_SHARDED_SCAN_RESUME_FNS: dict[tuple, object] = {}
+
+# operand layout constants for the scan variants: 27 verdict/stream
+# operands, 23 loop-state components, one p_lo scalar for the resume
+SCAN_N_ARGS = 27
+SCAN_N_STATE = 23
+# the donation signature: every resident state operand of the resume
+# variant is donated — carried by AOT plans and executable cache keys
+SCAN_RESUME_DONATE = tuple(range(SCAN_N_ARGS, SCAN_N_ARGS + SCAN_N_STATE))
 
 
 def solve_scan_fn(T: int, has_nodes: bool, has_limits: bool):
@@ -724,6 +892,38 @@ def solve_scan_fn(T: int, has_nodes: bool, has_limits: bool):
     if fn is None:
         fn = jax.jit(lambda *args: _solve_scan_core(cfg, args))
         _SOLVE_SCAN_FNS[cfg] = fn
+    return fn
+
+
+def solve_scan_full_fn(T: int, has_nodes: bool, has_limits: bool):
+    """Cold scan returning the full 23-component final state — seeds the
+    per-engine scan residency (ops/delta.py) when delta solves are on."""
+    cfg = (T, bool(has_nodes), bool(has_limits))
+    fn = _SOLVE_SCAN_FULL_FNS.get(cfg)
+    if fn is None:
+        fn = jax.jit(lambda *args: _solve_scan_full_core(cfg, args))
+        _SOLVE_SCAN_FULL_FNS[cfg] = fn
+    return fn
+
+
+def solve_scan_resume_fn(T: int, has_nodes: bool, has_limits: bool):
+    """Warm resume with the 23 resident state operands DONATED
+    (`donate_argnums` — XLA aliases the resident buffers into the loop
+    carry in place of a copy). Operand order: the 27 scan args, then the
+    23-component state, then the p_lo scalar."""
+    cfg = (T, bool(has_nodes), bool(has_limits))
+    fn = _SOLVE_SCAN_RESUME_FNS.get(cfg)
+    if fn is None:
+        fn = jax.jit(
+            lambda *ops: _solve_scan_resume_core(
+                cfg,
+                ops[:SCAN_N_ARGS],
+                ops[SCAN_N_ARGS : SCAN_N_ARGS + SCAN_N_STATE],
+                ops[SCAN_N_ARGS + SCAN_N_STATE],
+            ),
+            donate_argnums=SCAN_RESUME_DONATE,
+        )
+        _SOLVE_SCAN_RESUME_FNS[cfg] = fn
     return fn
 
 
@@ -738,17 +938,65 @@ def sharded_solve_scan(mesh: Mesh, T: int, has_nodes: bool, has_limits: bool):
     key = (mesh,) + cfg
     fn = _SHARDED_SCAN_FNS.get(key)
     if fn is None:
-        n_args = 27
         fn = jax.jit(
             shard_map(
                 lambda *args: _solve_scan_core(cfg, args),
                 mesh=mesh,
-                in_specs=tuple(P() for _ in range(n_args)),
+                in_specs=tuple(P() for _ in range(SCAN_N_ARGS)),
                 out_specs=tuple(P() for _ in range(10)),
                 **_SHARD_MAP_UNCHECKED,
             )
         )
         _SHARDED_SCAN_FNS[key] = fn
+    return fn
+
+
+def sharded_solve_scan_full(mesh: Mesh, T: int, has_nodes: bool, has_limits: bool):
+    """Mesh twin of solve_scan_full_fn: replicated like the classic scan
+    (the while_loop is sequential), returning the full 23-component state
+    so mesh engines keep the same residency contract."""
+    cfg = (T, bool(has_nodes), bool(has_limits))
+    key = (mesh,) + cfg
+    fn = _SHARDED_SCAN_FULL_FNS.get(key)
+    if fn is None:
+        fn = jax.jit(
+            shard_map(
+                lambda *args: _solve_scan_full_core(cfg, args),
+                mesh=mesh,
+                in_specs=tuple(P() for _ in range(SCAN_N_ARGS)),
+                out_specs=tuple(P() for _ in range(SCAN_N_STATE)),
+                **_SHARD_MAP_UNCHECKED,
+            )
+        )
+        _SHARDED_SCAN_FULL_FNS[key] = fn
+    return fn
+
+
+def sharded_solve_scan_resume(mesh: Mesh, T: int, has_nodes: bool, has_limits: bool):
+    """Mesh twin of solve_scan_resume_fn — the donation signature
+    (`SCAN_RESUME_DONATE`) carries over to the sharded executable, so warm
+    resumes on a mesh also update the replicated resident state in place."""
+    cfg = (T, bool(has_nodes), bool(has_limits))
+    key = (mesh,) + cfg
+    fn = _SHARDED_SCAN_RESUME_FNS.get(key)
+    if fn is None:
+        n_ops = SCAN_N_ARGS + SCAN_N_STATE + 1
+        fn = jax.jit(
+            shard_map(
+                lambda *ops: _solve_scan_resume_core(
+                    cfg,
+                    ops[:SCAN_N_ARGS],
+                    ops[SCAN_N_ARGS : SCAN_N_ARGS + SCAN_N_STATE],
+                    ops[SCAN_N_ARGS + SCAN_N_STATE],
+                ),
+                mesh=mesh,
+                in_specs=tuple(P() for _ in range(n_ops)),
+                out_specs=tuple(P() for _ in range(SCAN_N_STATE)),
+                **_SHARD_MAP_UNCHECKED,
+            ),
+            donate_argnums=SCAN_RESUME_DONATE,
+        )
+        _SHARDED_SCAN_RESUME_FNS[key] = fn
     return fn
 
 
@@ -805,11 +1053,23 @@ def merge_shard_group_counts(
 
 
 def encode_pods_for_packer(
-    engine: CatalogEngine, pods_requirements: Sequence[Requirements], requests: np.ndarray
+    engine: CatalogEngine,
+    pods_requirements: Sequence[Requirements],
+    requests: np.ndarray,
+    cache=None,
 ) -> GroupedPods:
     """Requirements → engine rows → groups (the host-side encode step).
     Requirements objects repeated by identity (one object per workload
-    shape) encode once."""
+    shape) encode once. With a delta `EncodeCache` (ops/delta.py), shapes
+    already encoded in PREVIOUS passes reuse their interned row ids,
+    membership rows, and key-presence rows — a churn pass re-encodes only
+    the shapes it has never seen, and bytes re-encoded are metered."""
+    from karpenter_tpu.ops import delta as delta_mod
+
+    if cache is None:
+        cache = delta_mod.encode_cache(engine)  # None unless --delta-solve on
+    if cache is not None:
+        return _encode_pods_delta(engine, pods_requirements, requests, cache)
     shape_of: dict[int, int] = {}
     distinct: list[Requirements] = []
     shape_ids = np.empty(len(pods_requirements), dtype=np.int64)
@@ -841,6 +1101,54 @@ def encode_pods_for_packer(
         membership=membership,
         requests_q=uniq[:, 1:],
         key_present=kp_distinct[uniq[:, 0].astype(np.int64)],
+        counts=counts.astype(np.int32),
+        group_of_pod=inverse.astype(np.int32),
+    )
+
+
+def _encode_pods_delta(
+    engine: CatalogEngine,
+    pods_requirements: Sequence[Requirements],
+    requests: np.ndarray,
+    cache,
+) -> GroupedPods:
+    """The incremental encode: per-shape lookups against the cross-pass
+    EncodeCache; only cache misses touch `engine.rows_for`/`key_presence`.
+    Output is bit-identical to the one-shot encode — the same dedup,
+    quantization, and np.unique grouping over the same interned rows."""
+    cache.begin_pass()
+    shape_of: dict[int, int] = {}
+    distinct: list[Requirements] = []
+    shape_ids = np.empty(len(pods_requirements), dtype=np.int64)
+    for p, reqs in enumerate(pods_requirements):
+        sid = shape_of.get(id(reqs))
+        if sid is None:
+            sid = len(distinct)
+            shape_of[id(reqs)] = sid
+            distinct.append(reqs)
+        shape_ids[p] = sid
+    entries = [cache.lookup(engine, reqs, engine.num_rows) for reqs in distinct]
+    engine._ensure_rows()
+
+    scales = feas.resource_scales(engine.resource_dims)
+    requests_q = feas.quantize_resources(requests, ceil=True, scales=scales)
+    combined = np.column_stack([shape_ids, requests_q])
+    uniq, inverse, counts = np.unique(
+        combined, axis=0, return_inverse=True, return_counts=True
+    )
+    G = uniq.shape[0]
+    R = max(1, engine.num_rows)
+    membership = np.zeros((G, R), dtype=bool)
+    key_present = np.zeros((G, entries[0][2].shape[0]) if entries else (G, 0), dtype=bool)
+    for g in range(G):
+        _, mrow, kp = entries[int(uniq[g, 0])]
+        membership[g, : mrow.shape[0]] = mrow[:R]
+        key_present[g] = kp
+    cache.end_pass()
+    return GroupedPods(
+        membership=membership,
+        requests_q=uniq[:, 1:],
+        key_present=key_present,
         counts=counts.astype(np.int32),
         group_of_pod=inverse.astype(np.int32),
     )

@@ -70,6 +70,19 @@ def main(argv=None) -> int:
         "on non-CPU backends (env KARPENTER_TPU_FUSED)",
     )
     parser.add_argument(
+        "--delta-solve", choices=["off", "on"], default="",
+        help="incremental delta solves (ops/delta.py): persistent "
+        "device-resident solver state between passes with donated warm "
+        "scan resumes; default leaves the process setting alone "
+        "(env KARPENTER_TPU_DELTA)",
+    )
+    parser.add_argument(
+        "--resolve-full-every", type=int, default=0,
+        help="delta self-check cadence: every Nth warm pass re-solves "
+        "from scratch and asserts decision identity (default: keep the "
+        "process setting, 16)",
+    )
+    parser.add_argument(
         "--explain", choices=["off", "sampled", "on"], default="",
         help="decision provenance ledger (observability/explain.py): "
         "capture per-pod elimination funnels and fold them into "
@@ -132,6 +145,13 @@ def main(argv=None) -> int:
         from karpenter_tpu.ops import fused as fused_mod
 
         fused_mod.FUSED_MODE = args.fused_solve
+    if args.delta_solve or args.resolve_full_every:
+        from karpenter_tpu.ops import delta as delta_mod
+
+        delta_mod.configure(
+            mode=args.delta_solve or None,
+            resolve_full_every=args.resolve_full_every or None,
+        )
     if args.explain:
         from karpenter_tpu.observability import explain as explain_mod
 

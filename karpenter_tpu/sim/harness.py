@@ -290,6 +290,15 @@ class Simulation:
             "device_solves": ffd.DEVICE_SOLVES,
             "device_fallbacks": ffd.DEVICE_FALLBACKS,
         }
+        # incremental-delta residencies (ops/delta.py) are process-global
+        # and keyed by engine identity; the solverd engine factory content-
+        # caches engines, so a second in-process run would otherwise
+        # warm-resume against state seeded by the PREVIOUS run. Drop them,
+        # then snapshot the counters for this run's report delta.
+        from karpenter_tpu.ops import delta as deltamod
+
+        deltamod.invalidate_all("sim-run-start")
+        self._delta_base = dict(deltamod.delta_counters())
         # kernel observatory: same delta discipline — report["kernels"] is
         # built from a counts_snapshot taken at run start (run() also
         # unseals, so this run's prewarm/first-batch dispatches land in the
@@ -501,6 +510,18 @@ class Simulation:
         from karpenter_tpu.observability import efficiency as effmod
 
         report["kernels"]["efficiency"] = effmod.report_section(self._eff_base)
+        # incremental-delta counters (warm/cold passes, rows reused vs
+        # re-encoded, bytes re-encoded, self-check verdicts, invalidations
+        # by reason): this run's deltas, OUTSIDE the digest like aot —
+        # residency is process history (the engine factory content-caches
+        # engines across runs), not a scenario fact. All zeros with
+        # --delta-solve off, so existing digests are untouched.
+        from karpenter_tpu.ops import delta as deltamod
+
+        cur = deltamod.delta_counters()
+        report["kernels"]["delta"] = {
+            key: cur.get(key, 0) - self._delta_base.get(key, 0) for key in cur
+        }
         # consolidation frontier search: this run's rounds/probes per
         # consolidation type plus the solverd frontier groups that
         # coalesced — deterministic (decision-path) facts

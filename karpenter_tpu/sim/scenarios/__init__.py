@@ -100,6 +100,12 @@ register(
     "recover from the write-ahead journal with zero double-launches",
 )
 register(
+    "sustained-churn",
+    tracemod.sustained_churn,
+    "shape-stable ~1% replace-churn under a diurnal envelope; the incremental "
+    "delta-solve scenario (decisions byte-identical with --delta-solve on/off)",
+)
+register(
     "consolidation-churn",
     tracemod.consolidation_churn,
     "fan-out waves drain into underutilized fleets; multi-node frontier consolidation folds them",

@@ -626,6 +626,59 @@ def crash_churn(rng: Random) -> dict:
     return trace
 
 
+def sustained_churn(rng: Random) -> dict:
+    """The steady-state the incremental delta solver exists for: a large,
+    SHAPE-STABLE service footprint with continuous ~1% replace-churn and a
+    diurnal arrival envelope. Every churn pod is the same shape and size as
+    the base fleet — the delta encode re-encodes nothing after the first
+    pass (all arrivals content-hit the row cache), and because the fused
+    FFD scan sorts by size, uniform arrivals always extend the previous pod
+    order as an exact suffix, keeping the warm scan-resume path engaged
+    pass after pass. Churn arrives as small short-lived groups at a steady
+    cadence (sinusoidally modulated: day peak, night trough) so every tick
+    has a perturbed frontier but the cluster-scale state never rebuilds.
+    No faults: with --delta-solve on the decisions must stay byte-identical
+    to --delta-solve off, and the CI churn-smoke job diffs exactly that."""
+    duration = 480.0
+    trace = _base("sustained-churn", duration=duration, tick=2.0)
+    # one uniform pod shape for base AND churn: shape-stability is the
+    # point — warm resume requires arrivals that don't re-sort the stream
+    pod = {"cpu": "1", "memory": "2Gi"}
+    events = [
+        {
+            "at": 4.0,
+            "kind": "submit",
+            "group": "base",
+            "count": 40 + rng.randrange(9),
+            "pod": dict(pod),
+            "replace": True,
+        }
+    ]
+    # continuous churn: a short-lived group every ~12s, 1-2 pods each —
+    # about 1% of the base footprint in flight per tick, modulated by a
+    # full diurnal cycle across the trace
+    at, i = 20.0, 0
+    while at < duration - 90.0:
+        phase = 2.0 * math.pi * (at / duration)
+        level = 0.5 * (1.0 - math.cos(phase))  # 0 at edges, 1 mid-trace
+        count = 1 + (1 if rng.random() < level else 0)
+        events.append(
+            {
+                "at": round(at, 3),
+                "kind": "submit",
+                "group": f"churn-{i}",
+                "count": count,
+                "pod": dict(pod),
+                "until": round(at + 50.0 + rng.randrange(25), 3),
+                "replace": True,
+            }
+        )
+        at += 10.0 + rng.randrange(5)
+        i += 1
+    trace["events"] = sorted(events, key=lambda e: e["at"])
+    return trace
+
+
 def capacity_pressure(rng: Random) -> dict:
     """The /debug/explain fixture: a limits-capped single pool under more
     demand than it may hold, plus two deliberately unsatisfiable pods whose

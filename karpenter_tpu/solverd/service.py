@@ -427,6 +427,12 @@ class SolverService:
     def close(self) -> None:
         with self._lock:
             self._closed = True
+        # the daemon is exiting: its device residencies (ops/delta.py) die
+        # with the process — drop them now so the resident-bytes gauge and
+        # a post-close /debug read never claim state that no longer serves
+        from karpenter_tpu.ops import delta as delta_mod
+
+        delta_mod.invalidate_all("service-close")
         # fail anything still queued rather than stranding its waiters
         ready, expired = self.queue.drain()
         for entry in ready + expired:
@@ -435,7 +441,7 @@ class SolverService:
             self._seal_dedup(entry)
 
     def stats(self) -> dict:
-        from karpenter_tpu.ops import ffd
+        from karpenter_tpu.ops import delta, ffd
 
         # snapshot under the stats lock: every counter in the result comes
         # from one atomic read, so invariants (executed <= requests,
@@ -466,4 +472,5 @@ class SolverService:
             "joint_sweeps": ffd.JOINT_SWEEPS,
             "device_solves": ffd.DEVICE_SOLVES,
             "device_fallbacks": ffd.DEVICE_FALLBACKS,
+            "delta": delta.delta_counters(),
         }

@@ -873,6 +873,12 @@ def _default_engine_factory(shard_devices: int = 0):
             from karpenter_tpu.observability import kernels as kobs
 
             kobs.reset_device_memory()
+            # the catalog changed: any solver residency (ops/delta.py) was
+            # stamped against the previous engine's row generation and must
+            # not seed a warm resume against the rebuilt one
+            from karpenter_tpu.ops import delta as delta_mod
+
+            delta_mod.invalidate_all("engine-rebuild")
             engine = CatalogEngine(
                 catalog, mesh=_build_solver_mesh(shard_devices)
             )

@@ -1,0 +1,503 @@
+"""Incremental delta solves (ops/delta.py): delta-vs-full identity + the
+invalidation pathologies.
+
+The contract under test is the ISSUE's acceptance line: with residency on,
+every pass's decisions, error strings, and counters are bit-identical to a
+from-scratch full solve — the delta path may be slower than designed,
+never wrong. Coverage: the content-fingerprinted encode cache (bytes
+re-encoded scale with churn, not cluster), seeded churn fuzz at the
+GroupSolver level, the warm scan-resume path end to end through the
+scheduler, the self-check cadence with an injected divergence (typed
+event + fallback + residency drop), and every invalidation rule
+(generation stamp, capacity overflow, engine rebuild, service close,
+invalidate_all)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider.kwok.instance_types import construct_instance_types
+from karpenter_tpu.ops import delta, fused
+from karpenter_tpu.ops.catalog import CatalogEngine
+from karpenter_tpu.ops.packer import GroupSolver, encode_pods_for_packer
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+
+from helpers import nodepool
+from test_fused import plain_pods
+from test_scheduler import Env
+
+CATALOG = construct_instance_types()
+ZONES = ["kwok-zone-1", "kwok-zone-2", "kwok-zone-3", "kwok-zone-4"]
+
+
+@pytest.fixture
+def delta_on():
+    old_mode, old_every = delta.DELTA_MODE, delta.RESOLVE_FULL_EVERY
+    delta.configure(mode="on", resolve_full_every=4)
+    delta.invalidate_all("test-setup")
+    yield
+    delta.configure(mode=old_mode, resolve_full_every=old_every)
+    delta.invalidate_all("test-teardown")
+
+
+@pytest.fixture
+def fused_on():
+    old = fused.FUSED_MODE
+    fused.FUSED_MODE = "on"
+    yield
+    fused.FUSED_MODE = old
+
+
+def build_shapes(n: int = 10):
+    """Value-stable requirement shapes, FRESH objects every call — the
+    watch-churn pattern the content fingerprint exists for."""
+    shapes = []
+    for i in range(n):
+        reqs = Requirements(Requirement(wk.LABEL_OS, Operator.IN, ["linux"]))
+        if i % 2:
+            reqs.add(Requirement(wk.LABEL_ARCH, Operator.IN, ["amd64"]))
+        if i % 3 == 0:
+            reqs.add(
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [ZONES[i % 4]])
+            )
+        shapes.append(reqs)
+    return shapes
+
+
+def churn_batch(engine, rng, shapes, pods: int):
+    picks = rng.randint(len(shapes), size=pods)
+    reqs_list = [shapes[i] for i in picks]
+    requests = np.zeros((pods, len(engine.resource_dims)), dtype=np.float64)
+    requests[:, engine.resource_dims[wk.RESOURCE_CPU]] = rng.choice(
+        [0.1, 0.5, 1.0, 2.0], size=pods
+    )
+    requests[:, engine.resource_dims[wk.RESOURCE_MEMORY]] = (
+        rng.choice([128, 512, 1024], size=pods) * 2**20
+    )
+    requests[:, engine.resource_dims[wk.RESOURCE_PODS]] = 1.0
+    return reqs_list, requests
+
+
+class TestEncodeCache:
+    def test_content_fingerprint_reuses_rebuilt_shapes(self, delta_on):
+        """Pass 2 rebuilds every Requirements object (same values) — all
+        shapes must content-hit with ZERO bytes re-encoded."""
+        engine = CatalogEngine(CATALOG)
+        rng = np.random.RandomState(11)
+        shapes1 = build_shapes()
+        reqs1, requests = churn_batch(engine, rng, shapes1, 200)
+        cold = None
+        # cold reference from a delta-off encode of the same batch
+        old = delta.DELTA_MODE
+        delta.configure(mode="off")
+        try:
+            cold = encode_pods_for_packer(engine, reqs1, requests)
+        finally:
+            delta.configure(mode=old)
+        g1 = encode_pods_for_packer(engine, reqs1, requests)
+        cache = delta.encode_cache(engine)
+        assert cache.last_pass_misses > 0 and cache.last_pass_bytes > 0
+        shapes2 = build_shapes()
+        assert all(a is not b for a, b in zip(shapes1, shapes2))
+        # same picks, fresh objects: rebuild the list against shapes2
+        id_of = {id(s): i for i, s in enumerate(shapes1)}
+        reqs2 = [shapes2[id_of[id(r)]] for r in reqs1]
+        g2 = encode_pods_for_packer(engine, reqs2, requests)
+        assert cache.last_pass_misses == 0
+        assert cache.last_pass_bytes == 0
+        assert cache.last_pass_hits > 0
+        for name in (
+            "membership", "requests_q", "key_present", "counts", "group_of_pod"
+        ):
+            np.testing.assert_array_equal(getattr(cold, name), getattr(g1, name))
+            np.testing.assert_array_equal(getattr(cold, name), getattr(g2, name))
+
+    def test_bytes_scale_with_churn_not_cluster(self, delta_on):
+        """Doubling the POD count re-encodes nothing new; adding one new
+        SHAPE re-encodes exactly that shape's rows."""
+        engine = CatalogEngine(CATALOG)
+        rng = np.random.RandomState(12)
+        shapes = build_shapes()
+        reqs, requests = churn_batch(engine, rng, shapes, 100)
+        encode_pods_for_packer(engine, reqs, requests)
+        cache = delta.encode_cache(engine)
+        # cluster doubles, zero new shapes -> zero bytes
+        reqs2, requests2 = churn_batch(engine, rng, shapes, 200)
+        encode_pods_for_packer(engine, reqs2, requests2)
+        assert cache.last_pass_bytes == 0
+        # one genuinely new shape -> small, nonzero
+        novel = Requirements(
+            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, Operator.IN, ["spot"])
+        )
+        reqs3 = list(reqs2) + [novel]
+        requests3 = np.vstack([requests2, requests2[-1:]])
+        encode_pods_for_packer(engine, reqs3, requests3)
+        assert cache.last_pass_misses == 1
+        assert 0 < cache.last_pass_bytes < 10_000
+
+    def test_capacity_overflow_resets_and_meters(self, delta_on, monkeypatch):
+        monkeypatch.setattr(delta.EncodeCache, "MAX_SHAPES", 4)
+        engine = CatalogEngine(CATALOG)
+        cache = delta.encode_cache(engine)
+        c0 = delta.delta_counters().get("delta_invalidations", 0)
+        cache.begin_pass()
+        for i in range(8):
+            reqs = Requirements(
+                Requirement(wk.LABEL_TOPOLOGY_ZONE, Operator.IN, [f"z-{i}"])
+            )
+            cache.lookup(engine, reqs, engine.num_rows)
+        cache.end_pass()
+        assert len(cache._by_content) <= 4
+        assert delta.delta_counters()["delta_invalidations"] > c0
+
+
+class TestGroupDeltaFuzz:
+    def test_churn_stream_bit_identical_to_full(self, delta_on):
+        """Seeded churn stream: every pass's delta result equals a
+        from-scratch _solve_full on the same grouped batch, bit for bit."""
+        engine = CatalogEngine(CATALOG)
+        solver = GroupSolver(engine)
+        rng = np.random.RandomState(21)
+        res = delta.group_residency(solver)
+        warm_seen = False
+        for p in range(7):
+            # churn: rebuild value-identical shapes each pass, vary batch
+            shapes = build_shapes(8 + (p % 3))
+            reqs, requests = churn_batch(engine, rng, shapes, 60 + 20 * p)
+            grouped = encode_pods_for_packer(engine, reqs, requests)
+            got = solver.solve(grouped)
+            full = solver._solve_full(grouped)
+            for a, b in zip(got, full):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            warm_seen = warm_seen or res.last_mode == "warm"
+        assert warm_seen, "churn stream never reached a warm group pass"
+        assert res.warm_passes > 0
+
+    def test_count_only_churn_solves_zero_groups(self, delta_on):
+        """Group COUNT changes (pods joining an existing shape — the
+        dominant churn) must touch no resident slot."""
+        engine = CatalogEngine(CATALOG)
+        solver = GroupSolver(engine)
+        rng = np.random.RandomState(22)
+        shapes = build_shapes()
+        reqs, requests = churn_batch(engine, rng, shapes, 120)
+        grouped = encode_pods_for_packer(engine, reqs, requests)
+        solver.solve(grouped)
+        c0 = dict(delta.delta_counters())
+        # identical shapes/requests, doubled counts
+        grouped2 = encode_pods_for_packer(
+            engine, reqs + reqs, np.vstack([requests, requests])
+        )
+        got = solver.solve(grouped2)
+        full = solver._solve_full(grouped2)
+        for a, b in zip(got, full):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c1 = delta.delta_counters()
+        assert c1["delta_groups_solved"] == c0.get("delta_groups_solved", 0)
+        assert c1["delta_groups_reused"] > c0.get("delta_groups_reused", 0)
+
+    def test_generation_bump_invalidates(self, delta_on):
+        engine = CatalogEngine(CATALOG)
+        solver = GroupSolver(engine)
+        rng = np.random.RandomState(23)
+        shapes = build_shapes()
+        reqs, requests = churn_batch(engine, rng, shapes, 80)
+        solver.solve(encode_pods_for_packer(engine, reqs, requests))
+        res = delta.group_residency(solver)
+        assert res.core is not None
+        gen0 = res.gen
+        # intern a NEW requirement row: the row generation stamp moves
+        novel = Requirements(
+            Requirement("example.com/delta-novel-row", Operator.EXISTS)
+        )
+        engine.rows_for(novel)
+        engine._ensure_rows()
+        c0 = delta.delta_counters().get("delta_invalidations", 0)
+        got = solver.solve(encode_pods_for_packer(engine, reqs, requests))
+        full = solver._solve_full(encode_pods_for_packer(engine, reqs, requests))
+        for a, b in zip(got, full):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert res.gen != gen0
+        assert delta.delta_counters()["delta_invalidations"] > c0
+
+    def test_slot_capacity_overflow_resets(self, delta_on, monkeypatch):
+        monkeypatch.setattr(delta, "MAX_GROUP_SLOTS", 4)
+        engine = CatalogEngine(CATALOG)
+        solver = GroupSolver(engine)
+        rng = np.random.RandomState(24)
+        shapes = build_shapes()
+        reqs, requests = churn_batch(engine, rng, shapes, 120)
+        grouped = encode_pods_for_packer(engine, reqs, requests)
+        got = solver.solve(grouped)
+        full = solver._solve_full(grouped)
+        for a, b in zip(got, full):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_injected_divergence_fires_event_and_falls_back(self, delta_on):
+        """Corrupt the resident core matrix, force the self-check every
+        warm pass: the check must catch it, fire the divergence callback,
+        drop the residency, and return the FULL result."""
+        delta.configure(resolve_full_every=1)
+        engine = CatalogEngine(CATALOG)
+        solver = GroupSolver(engine)
+        rng = np.random.RandomState(25)
+        shapes = build_shapes()
+        reqs, requests = churn_batch(engine, rng, shapes, 100)
+        grouped = encode_pods_for_packer(engine, reqs, requests)
+        solver.solve(grouped)
+        res = delta.group_residency(solver)
+        assert res.core is not None
+        import jax.numpy as jnp
+
+        # flip every resident choice to an absurd value
+        res.core = res.core.at[:, 0].set(jnp.int32(7))
+        fired = []
+        delta.on_divergence(lambda k, d: fired.append((k, d)), key="test")
+        try:
+            got = solver.solve(grouped)
+        finally:
+            delta.on_divergence(lambda k, d: None, key="test")
+        full = solver._solve_full(grouped)
+        for a, b in zip(got, full):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert fired and fired[0][0] == "packer.solve_block"
+        assert res.core is None  # residency dropped
+        assert delta.delta_counters()["delta_selfchecks_divergent"] >= 1
+
+
+class TestScanResidency:
+    def test_repeat_solve_warm_resumes_bit_identical(self, delta_on, fused_on):
+        """The coalescer shape: the same batch re-solved back to back warm-
+        resumes (empty suffix) with identical claims and zero errors."""
+        engine = CatalogEngine(CATALOG)
+        env = Env(node_pools=[nodepool("default")], engine=engine)
+
+        def canon(results):
+            return sorted(
+                (
+                    sorted(p.metadata.name for p in nc.pods),
+                    sorted(
+                        it.name for it in nc.instance_type_options
+                    ),
+                )
+                for nc in results.new_node_claims
+            )
+
+        r1 = env.schedule(plain_pods(96, cpus=("1",)))
+        assert not r1.pod_errors
+        res = delta.scan_residency(engine)
+        assert res.state is not None and res.extendable
+        c0 = dict(delta.delta_counters())
+        r2 = env.schedule(plain_pods(96, cpus=("1",)))
+        assert not r2.pod_errors
+        assert canon(r1) == canon(r2)
+        c1 = delta.delta_counters()
+        assert c1["delta_scan_warm"] > c0.get("delta_scan_warm", 0)
+        assert res.last_outcome == "warm"
+
+    def test_suffix_arrivals_extend_warm(self, delta_on, fused_on):
+        """Uniform-shape arrivals extend the previous stream as an exact
+        suffix — the shape-stable churn the warm path is built for."""
+        engine = CatalogEngine(CATALOG)
+        env = Env(node_pools=[nodepool("default")], engine=engine)
+        env.schedule(plain_pods(96, cpus=("1",)))
+        c0 = dict(delta.delta_counters())
+        r = env.schedule(plain_pods(128, cpus=("1",)))
+        assert not r.pod_errors
+        c1 = delta.delta_counters()
+        assert c1["delta_scan_warm"] > c0.get("delta_scan_warm", 0)
+
+    def test_mixed_size_arrival_misses_prefix_but_stays_correct(
+        self, delta_on, fused_on
+    ):
+        """A LARGER new pod sorts to the front of the FFD stream — the
+        prefix contract breaks, the pass must go cold, and the decisions
+        must still match a delta-off solve."""
+        engine = CatalogEngine(CATALOG)
+        env = Env(node_pools=[nodepool("default")], engine=engine)
+        env.schedule(plain_pods(96, cpus=("1",)))
+        res = delta.scan_residency(engine)
+        assert res.state is not None
+        pods2 = plain_pods(97, cpus=("4",))
+        r_delta = env.schedule(pods2)
+        assert res.last_outcome in ("prefix", "operands", "rung")
+        old = delta.DELTA_MODE
+        delta.configure(mode="off")
+        try:
+            r_off = env.schedule(plain_pods(97, cpus=("4",)))
+        finally:
+            delta.configure(mode=old)
+
+        def canon(results):
+            return sorted(
+                (
+                    sorted(p.metadata.name for p in nc.pods),
+                    sorted(it.name for it in nc.instance_type_options),
+                )
+                for nc in results.new_node_claims
+            )
+
+        assert canon(r_delta) == canon(r_off)
+        assert {k.metadata.name: str(v) for k, v in r_delta.pod_errors.items()} == {
+            k.metadata.name: str(v) for k, v in r_off.pod_errors.items()
+        }
+
+    def test_scan_selfcheck_divergence_drops_residency(self, delta_on, fused_on):
+        """Corrupt the resident scan state; the every-pass self-check must
+        fire the divergence event, fall back to the cold result, and drop
+        the residency."""
+        delta.configure(resolve_full_every=1)
+        engine = CatalogEngine(CATALOG)
+        env = Env(node_pools=[nodepool("default")], engine=engine)
+        r1 = env.schedule(plain_pods(96, cpus=("1",)))
+        res = delta.scan_residency(engine)
+        assert res.state is not None
+        import jax.numpy as jnp
+
+        # corrupt pod_node (state component 10, a _SCAN_OUT_IDX output)
+        state = list(res.state)
+        state[10] = jnp.asarray(np.asarray(state[10]) + 7)
+        res.state = tuple(state)
+        fired = []
+        delta.on_divergence(lambda k, d: fired.append((k, d)), key="test")
+        try:
+            r2 = env.schedule(plain_pods(96, cpus=("1",)))
+        finally:
+            delta.on_divergence(lambda k, d: None, key="test")
+        assert not r2.pod_errors
+
+        def canon(results):
+            return sorted(
+                (
+                    sorted(p.metadata.name for p in nc.pods),
+                    sorted(it.name for it in nc.instance_type_options),
+                )
+                for nc in results.new_node_claims
+            )
+
+        assert canon(r1) == canon(r2)
+        assert fired and fired[0][0] == "packer.solve_scan"
+        assert delta.delta_counters()["delta_selfchecks_divergent"] >= 1
+
+    def test_small_batches_route_to_device_when_forced(self, delta_on, fused_on):
+        """Satellite fix: below DEVICE_MIN_PODS, a forced fused+delta
+        operator still takes the device path (no host resync) — and the
+        decisions match the host walk."""
+        from karpenter_tpu.ops import ffd
+
+        engine = CatalogEngine(CATALOG)
+        env = Env(node_pools=[nodepool("default")], engine=engine)
+        d0 = ffd.DEVICE_SOLVES
+        r = env.schedule(plain_pods(8, cpus=("1",)))
+        assert not r.pod_errors
+        assert ffd.DEVICE_SOLVES > d0
+
+
+class TestInvalidationPathologies:
+    def _seed_residencies(self):
+        engine = CatalogEngine(CATALOG)
+        solver = GroupSolver(engine)
+        rng = np.random.RandomState(31)
+        shapes = build_shapes()
+        reqs, requests = churn_batch(engine, rng, shapes, 80)
+        solver.solve(encode_pods_for_packer(engine, reqs, requests))
+        sres = delta.scan_residency(engine)
+        sres.state = (np.zeros(4, np.int32),)  # fake resident scan state
+        return engine, solver
+
+    def test_invalidate_all_drops_everything(self, delta_on):
+        engine, solver = self._seed_residencies()
+        cache = delta.encode_cache(engine)
+        assert cache.stats()["shapes_cached"] > 0
+        delta.invalidate_all("test-pathology")
+        assert delta.group_residency(solver).core is None
+        assert delta.scan_residency(engine).state is None
+        assert cache.stats()["shapes_cached"] == 0
+
+    def test_solverd_engine_rebuild_invalidates(self, delta_on):
+        """A catalog change rebuilds the daemon engine — residencies
+        stamped against the old engine must drop."""
+        from karpenter_tpu.solverd.transport import _default_engine_factory
+
+        engine, solver = self._seed_residencies()
+        factory = _default_engine_factory()
+        factory(list(CATALOG))  # cache miss -> rebuild -> invalidate_all
+        assert delta.group_residency(solver).core is None
+        assert delta.scan_residency(engine).state is None
+
+    def test_service_close_invalidates(self, delta_on):
+        from karpenter_tpu.solverd.service import SolverService
+
+        engine, solver = self._seed_residencies()
+        svc = SolverService()
+        assert "delta" in svc.stats()
+        svc.close()
+        assert delta.group_residency(solver).core is None
+        assert delta.scan_residency(engine).state is None
+
+    def test_rollback_restore_invalidates(self, delta_on):
+        """Topology.restore_counts — the device-fallback abort rollback —
+        must drop residencies seeded by the aborted solve."""
+        engine, solver = self._seed_residencies()
+        env = Env(node_pools=[nodepool("default")], engine=engine)
+        from karpenter_tpu.scheduler.topology import Topology
+
+        topo = Topology(
+            env.store, env.cluster, env.cluster.state_nodes(), env.node_pools,
+            env.instance_types, [],
+        )
+        snap = topo.snapshot_counts()
+        topo.restore_counts(snap)
+        assert delta.group_residency(solver).core is None
+        assert delta.scan_residency(engine).state is None
+
+    def test_debug_view_surfaces_residencies(self, delta_on):
+        from karpenter_tpu.observability import kernels as kobs
+
+        engine, solver = self._seed_residencies()  # hold refs: the registry
+        # is weakref-swept, so dropping them would empty the view
+        view = kobs.registry().debug_snapshot(view="delta")
+        assert view["enabled"] is True
+        assert view["resolve_full_every"] == 4
+        assert "delta_passes_cold" in view["counters"]
+        assert view["group_residencies"], "seeded residency missing from view"
+        assert view["resident_bytes"] > 0
+
+    def test_ffd_counters_carry_delta_series(self, delta_on):
+        from karpenter_tpu.ops import ffd
+
+        snap = ffd.solver_cache_counters()
+        assert "delta_passes_warm" in snap
+        assert "delta_bytes_reencoded" in snap
+
+
+class TestLadderFromObservatory:
+    def test_scan_signature_roundtrip(self):
+        """A real observed solve_scan signature parses back into the exact
+        7-axis bucket that produced it."""
+        from karpenter_tpu.aot import ladder
+
+        sig = (
+            "512,256,64x4,64x4,36x4,1x4,1x64,1x64,1x64,1x64x36,64x64,64x64,"
+            "1x64x36,1,1,1x1,1x1,64x144,1x1,1x1x1,36x144,1,1x1,1,1x1,1x1,1"
+        )
+        dims = ladder._scan_signature_dims(sig)
+        assert dims is not None
+        P, G, C, N, F, T, L = dims
+        assert (P, G, C) == (512, 64, 256)
+        assert N == 0 and L == 0  # 1x1 dummies -> absent axes
+        assert T == 1 and F == 64
+
+    def test_from_observatory_buckets_scan(self):
+        from karpenter_tpu.aot import ladder
+
+        sig = (
+            "512,256,64x4,64x4,36x4,1x4,1x64,1x64,1x64,1x64x36,64x64,64x64,"
+            "1x64x36,1,1,1x1,1x1,64x144,1x1,1x1x1,36x144,1,1x1,1,1x1,1x1,1"
+        )
+        counts = {
+            "packer.solve_scan": {"shapes": {sig: {"steady": 5}}},
+        }
+        lad = ladder.from_observatory(counts, headroom=1)
+        buckets = lad.buckets("packer.solve_scan")
+        assert (512, 64, 256, 0, 64, 1, 0) in buckets
